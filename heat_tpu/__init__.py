@@ -1,0 +1,40 @@
+"""heat_tpu — a TPU-native distributed tensor and data-analytics framework.
+
+A ground-up rebuild of the capabilities of HeAT (the Helmholtz Analytics
+Toolkit, reference mounted at /root/reference) designed for TPU: global
+jax.Arrays sharded over a device mesh replace per-process torch tensors,
+XLA collectives over ICI/DCN replace MPI, and GSPMD replaces hand-written
+SPMD communication.  See SURVEY.md for the full architectural mapping.
+
+The flat ``ht.*`` namespace mirrors the reference (heat/__init__.py:1-12).
+"""
+
+import os as _os
+
+# float64/int64 support requires x64 mode; heat's API exposes 64-bit dtypes,
+# so enable it before any jax arrays exist.  Defaults everywhere remain
+# 32-bit (TPU-friendly); set HEAT_TPU_DISABLE_X64=1 to hard-disable.
+if _os.environ.get("HEAT_TPU_DISABLE_X64", "0") != "1":
+    import jax as _jax
+
+    # Force backend/plugin discovery before mutating config: with the
+    # experimental 'axon' TPU plugin, flipping x64 before the first backend
+    # init corrupts plugin registration and every later jax.devices() fails.
+    try:
+        _jax.devices()
+    except RuntimeError:
+        pass
+    _jax.config.update("jax_enable_x64", True)
+
+from .version import __version__
+from . import core
+from .core import *
+from .core import linalg, random
+from . import cluster
+from . import classification
+from . import graph
+from . import naive_bayes
+from . import regression
+from . import spatial
+from . import utils
+from . import datasets

@@ -1,0 +1,44 @@
+"""heat_tpu core: the distributed tensor layer
+(reference: heat/core/__init__.py)."""
+
+from .communication import *
+from .devices import *
+from . import types
+from .types import *
+from .constants import *
+from .stride_tricks import *
+from .memory import *
+from . import sanitation
+from .sanitation import *
+from .dndarray import *
+from . import factories
+from .factories import *
+from . import arithmetics
+from .arithmetics import *
+from . import relational
+from .relational import *
+from . import logical
+from .logical import *
+from . import exponential
+from .exponential import *
+from . import trigonometrics
+from .trigonometrics import *
+from . import rounding
+from .rounding import *
+from . import statistics
+from .statistics import *
+from . import manipulations
+from .manipulations import *
+from . import indexing
+from .indexing import *
+from . import printing
+from .printing import get_printoptions, set_printoptions
+from . import random
+from . import io
+from .io import *
+from . import tiling
+from .tiling import *
+from .base import *
+from . import linalg
+from .linalg import *
+from ..version import __version__

@@ -1,0 +1,205 @@
+"""Elementwise arithmetic, bit ops, and sum/prod/cum reductions.
+
+Reference: heat/core/arithmetics.py:42-924.  Every function routes through
+the generic engine in :mod:`_operations` exactly as the reference does; the
+``diff`` neighbor exchange (reference :286-370, manual Send/Recv of boundary
+slices along the split axis) is a single global ``jnp.diff`` here, with XLA
+providing the shard-boundary halo.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+
+from . import _operations
+from . import types
+from .dndarray import DNDarray
+from .stride_tricks import sanitize_axis
+
+__all__ = [
+    "add",
+    "bitwise_and",
+    "bitwise_not",
+    "bitwise_or",
+    "bitwise_xor",
+    "cumprod",
+    "cumproduct",
+    "cumsum",
+    "diff",
+    "div",
+    "divide",
+    "floordiv",
+    "floor_divide",
+    "fmod",
+    "invert",
+    "left_shift",
+    "mod",
+    "mul",
+    "multiply",
+    "pow",
+    "power",
+    "prod",
+    "right_shift",
+    "sub",
+    "subtract",
+    "sum",
+]
+
+
+def add(t1, t2, out=None):
+    """Elementwise addition (reference arithmetics.py:42-87)."""
+    return _operations.__binary_op(jnp.add, t1, t2, out)
+
+
+def sub(t1, t2, out=None):
+    """Elementwise subtraction (reference arithmetics.py:766-811)."""
+    return _operations.__binary_op(jnp.subtract, t1, t2, out)
+
+
+subtract = sub
+
+
+def mul(t1, t2, out=None):
+    """Elementwise multiplication (reference arithmetics.py:572-616)."""
+    return _operations.__binary_op(jnp.multiply, t1, t2, out)
+
+
+multiply = mul
+
+
+def div(t1, t2, out=None):
+    """Elementwise true division (reference arithmetics.py:345-390).
+    Promotes to floating like the reference."""
+
+    def _truediv(a, b):
+        return jnp.true_divide(a, b)
+
+    return _operations.__binary_op(_truediv, t1, t2, out)
+
+
+divide = div
+
+
+def floordiv(t1, t2, out=None):
+    """Elementwise floor division (reference arithmetics.py:432-477)."""
+    return _operations.__binary_op(jnp.floor_divide, t1, t2, out)
+
+
+floor_divide = floordiv
+
+
+def fmod(t1, t2, out=None):
+    """Elementwise C-semantics remainder (reference arithmetics.py:478-523)."""
+    return _operations.__binary_op(jnp.fmod, t1, t2, out)
+
+
+def mod(t1, t2, out=None):
+    """Elementwise python-semantics modulo (reference arithmetics.py:524-571)."""
+    return _operations.__binary_op(jnp.mod, t1, t2, out)
+
+
+def pow(t1, t2, out=None):
+    """Elementwise power (reference arithmetics.py:617-662)."""
+    return _operations.__binary_op(jnp.power, t1, t2, out)
+
+
+power = pow
+
+
+def bitwise_and(t1, t2, out=None):
+    """Elementwise AND for integers/booleans (reference arithmetics.py:88-140)."""
+    _check_int(t1, t2, "bitwise_and")
+    return _operations.__binary_op(jnp.bitwise_and, t1, t2, out)
+
+
+def bitwise_or(t1, t2, out=None):
+    """(reference arithmetics.py:141-193)"""
+    _check_int(t1, t2, "bitwise_or")
+    return _operations.__binary_op(jnp.bitwise_or, t1, t2, out)
+
+
+def bitwise_xor(t1, t2, out=None):
+    """(reference arithmetics.py:194-246)"""
+    _check_int(t1, t2, "bitwise_xor")
+    return _operations.__binary_op(jnp.bitwise_xor, t1, t2, out)
+
+
+def invert(t, out=None):
+    """Elementwise bitwise NOT (reference arithmetics.py:247-285)."""
+    if isinstance(t, DNDarray) and types.heat_type_is_inexact(t.dtype):
+        raise TypeError(f"Operation is not supported for float types, got {t.dtype.__name__}")
+    return _operations.__local_op(jnp.invert, t, out, no_cast=True)
+
+
+bitwise_not = invert
+
+
+def left_shift(t1, t2, out=None):
+    """Elementwise left shift (reference arithmetics.py:663-714)."""
+    _check_int_shift(t1, "left_shift")
+    return _operations.__binary_op(jnp.left_shift, t1, t2, out)
+
+
+def right_shift(t1, t2, out=None):
+    """Elementwise right shift (reference arithmetics.py:715-765)."""
+    _check_int_shift(t1, "right_shift")
+    return _operations.__binary_op(jnp.right_shift, t1, t2, out)
+
+
+def _check_int(t1, t2, name):
+    for t in (t1, t2):
+        if isinstance(t, DNDarray) and types.heat_type_is_inexact(t.dtype):
+            raise TypeError(f"Operation {name} not supported for float types, got {t.dtype.__name__}")
+        if isinstance(t, float):
+            raise TypeError(f"Operation {name} not supported for float scalars")
+
+
+def _check_int_shift(t1, name):
+    if isinstance(t1, DNDarray) and types.heat_type_is_inexact(t1.dtype):
+        raise TypeError(f"Operation {name} not supported for float types, got {t1.dtype.__name__}")
+
+
+def cumsum(a, axis, dtype=None, out=None):
+    """Cumulative sum along ``axis`` (reference arithmetics.py:cumsum via
+    __cum_op, _operations.py:173; the cross-shard Exscan is XLA's scan)."""
+    return _operations.__cum_op(jnp.cumsum, a, axis, out, dtype)
+
+
+def cumprod(a, axis, dtype=None, out=None):
+    """Cumulative product (reference arithmetics.py:cumprod)."""
+    return _operations.__cum_op(jnp.cumprod, a, axis, out, dtype)
+
+
+cumproduct = cumprod
+
+
+def diff(a, n: int = 1, axis: int = -1):
+    """n-th discrete difference along ``axis``
+    (reference arithmetics.py:286-344 — hand-written neighbor Send/Recv;
+    here one global jnp.diff)."""
+    if n == 0:
+        return a
+    if n < 0:
+        raise ValueError(f"diff requires that n be a positive number, got {n}")
+    from .sanitation import sanitize_in
+
+    sanitize_in(a)
+    axis = sanitize_axis(a.shape, axis)
+    result = jnp.diff(a.larray, n=n, axis=axis)
+    result = a.comm.apply_sharding(result, a.split)
+    return DNDarray(
+        result, tuple(result.shape), a.dtype, a.split, a.device, a.comm, a.balanced
+    )
+
+
+def sum(x, axis=None, out=None, keepdims=None):
+    """Sum reduction (reference arithmetics.py:878-924; the cross-split
+    Allreduce of _operations.py:425-429 is compiler-inserted here)."""
+    return _operations.__reduce_op(jnp.sum, x, axis, out, neutral=0, keepdims=keepdims)
+
+
+def prod(x, axis=None, out=None, keepdims=None):
+    """Product reduction (reference arithmetics.py:787-833)."""
+    return _operations.__reduce_op(jnp.prod, x, axis, out, neutral=1, keepdims=keepdims)

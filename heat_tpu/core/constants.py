@@ -1,0 +1,21 @@
+"""Mathematical constants (reference: heat/core/constants.py)."""
+
+import numpy as np
+
+__all__ = ["e", "Euler", "inf", "Inf", "Infty", "Infinity", "nan", "NaN", "pi"]
+
+e = float(np.e)
+"""Euler's number."""
+pi = float(np.pi)
+"""Archimedes' constant."""
+inf = float("inf")
+"""IEEE positive infinity."""
+nan = float("nan")
+"""IEEE not-a-number."""
+
+# aliases (numpy/reference parity)
+Euler = e
+Inf = inf
+Infty = inf
+Infinity = inf
+NaN = nan

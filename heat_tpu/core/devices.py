@@ -1,0 +1,143 @@
+"""Device abstraction for heat_tpu.
+
+Reference: heat/core/devices.py:9-135 — there, a ``Device`` names a torch
+device per MPI process, with GPUs assigned round-robin by rank
+(devices.py:66-74).  Here a :class:`Device` names a **JAX platform** whose
+entire device set forms the mesh; placement of individual shards is XLA's
+job, so there is no per-rank device arithmetic.  ``ht.cpu`` always exists,
+``ht.tpu`` exists when TPU hardware (or an emulated TPU platform) is
+present, and ``ht.gpu`` when CUDA/ROCm devices are visible.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Union
+
+import jax
+
+__all__ = ["Device", "cpu", "get_device", "sanitize_device", "use_device"]
+
+
+class Device:
+    """A logical compute platform binding arrays to a device mesh.
+
+    Parameters
+    ----------
+    device_type : str
+        Platform name understood by JAX: ``'cpu'``, ``'tpu'``, ``'gpu'``.
+
+    Reference: heat/core/devices.py:9-56 (``Device`` with device_type/
+    device_id/torch_device); the id is dropped because a single controller
+    addresses every device of the platform through the mesh.
+    """
+
+    def __init__(self, device_type: str):
+        self.__device_type = str(device_type).strip().lower()
+
+    @property
+    def device_type(self) -> str:
+        return self.__device_type
+
+    @property
+    def platform(self) -> str:
+        """JAX platform name (alias of :attr:`device_type`)."""
+        return self.__device_type
+
+    def jax_devices(self):
+        """All JAX devices of this platform (the mesh population)."""
+        return jax.devices(self.__device_type)
+
+    def __str__(self) -> str:
+        return self.__device_type
+
+    def __repr__(self) -> str:
+        return f"device({self.__device_type})"
+
+    def __eq__(self, other) -> bool:
+        if isinstance(other, Device):
+            return self.device_type == other.device_type
+        if isinstance(other, str):
+            return self.device_type == other.strip().lower()
+        return NotImplemented
+
+    def __hash__(self) -> int:
+        return hash(self.device_type)
+
+
+# ---------------------------------------------------------------------- #
+# platform singletons (reference devices.py:59-74)                        #
+# ---------------------------------------------------------------------- #
+cpu = Device("cpu")
+"""The CPU device — always available (reference devices.py:59)."""
+
+__registry = {"cpu": cpu}
+
+
+def __probe_platform(name: str) -> Optional[Device]:
+    try:
+        if jax.devices(name):
+            dev = Device(name)
+            __registry[name] = dev
+            return dev
+    except RuntimeError:
+        pass
+    return None
+
+
+tpu = __probe_platform("tpu")
+"""The TPU device, or None when no TPU platform is present (analogous to the
+conditional ``gpu`` singleton, reference devices.py:66-74)."""
+
+gpu = __probe_platform("gpu")
+"""The GPU device, or None when no GPU platform is present."""
+
+# the experimental 'axon' tunnel platform exposes TPU chips under a custom
+# platform name; surface it as `tpu` when the canonical name is absent
+if tpu is None:
+    for _plat in ("axon",):
+        _dev = __probe_platform(_plat)
+        if _dev is not None:
+            tpu = _dev
+            __registry["tpu"] = _dev
+            break
+
+__default_device: Device = None
+
+
+def _accelerator_or_cpu() -> Device:
+    if tpu is not None:
+        return tpu
+    if gpu is not None:
+        return gpu
+    return cpu
+
+
+def get_device() -> Device:
+    """The process-global default device (reference devices.py:80-89).
+    Defaults to the best available platform: tpu > gpu > cpu."""
+    global __default_device
+    if __default_device is None:
+        __default_device = _accelerator_or_cpu()
+    return __default_device
+
+
+def use_device(device: Optional[Union[str, Device]] = None) -> None:
+    """Set the process-global default device (reference devices.py:124-135)."""
+    global __default_device
+    __default_device = sanitize_device(device) if device is not None else _accelerator_or_cpu()
+
+
+def sanitize_device(device: Optional[Union[str, Device]]) -> Device:
+    """Normalize a device argument, substituting the default for None
+    (reference devices.py:92-121)."""
+    if device is None:
+        return get_device()
+    if isinstance(device, Device):
+        return device
+    name = str(device).strip().lower()
+    if name in __registry:
+        return __registry[name]
+    dev = __probe_platform(name)
+    if dev is not None:
+        return dev
+    raise ValueError(f"Unknown device or platform not available: {device!r}")

@@ -1,0 +1,53 @@
+"""Exponential and logarithmic elementwise maps.
+
+Reference: heat/core/exponential.py:8-222 — all ``__local_op`` maps; float
+promotion of exact types happens in the engine.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from . import _operations
+
+__all__ = ["exp", "expm1", "exp2", "log", "log2", "log10", "log1p", "sqrt"]
+
+
+def exp(x, out=None):
+    """e**x (reference exponential.py:8-38)."""
+    return _operations.__local_op(jnp.exp, x, out)
+
+
+def expm1(x, out=None):
+    """e**x - 1 (reference exponential.py:39-69)."""
+    return _operations.__local_op(jnp.expm1, x, out)
+
+
+def exp2(x, out=None):
+    """2**x (reference exponential.py:70-100)."""
+    return _operations.__local_op(jnp.exp2, x, out)
+
+
+def log(x, out=None):
+    """Natural logarithm (reference exponential.py:101-131)."""
+    return _operations.__local_op(jnp.log, x, out)
+
+
+def log2(x, out=None):
+    """Base-2 logarithm (reference exponential.py:132-162)."""
+    return _operations.__local_op(jnp.log2, x, out)
+
+
+def log10(x, out=None):
+    """Base-10 logarithm (reference exponential.py:163-192)."""
+    return _operations.__local_op(jnp.log10, x, out)
+
+
+def log1p(x, out=None):
+    """log(1 + x) (reference exponential.py:193-207)."""
+    return _operations.__local_op(jnp.log1p, x, out)
+
+
+def sqrt(x, out=None):
+    """Square root (reference exponential.py:208-222)."""
+    return _operations.__local_op(jnp.sqrt, x, out)

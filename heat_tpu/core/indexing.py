@@ -1,0 +1,52 @@
+"""Index discovery and conditional selection.
+
+Reference: heat/core/indexing.py:12-156 (``nonzero`` with global-offset
+correction on the split axis; ``where`` built on it).  On global arrays the
+offset correction vanishes; ``nonzero`` is data-dependent and therefore runs
+on host-visible shapes (eager, like the reference).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from . import factories, types
+from .dndarray import DNDarray
+from .sanitation import sanitize_in
+
+__all__ = ["nonzero", "where"]
+
+
+def nonzero(a: DNDarray) -> DNDarray:
+    """Indices of nonzero elements as an (nnz, ndim) array
+    (reference indexing.py:12-97: local nonzero + split-offset add; result
+    split=0)."""
+    sanitize_in(a)
+    idx = np.stack(np.nonzero(np.asarray(a.larray)), axis=1)
+    if a.ndim == 1:
+        idx = idx.reshape(-1)
+    split = 0 if a.split is not None else None
+    return factories.array(idx, dtype=types.int64, split=split, device=a.device, comm=a.comm)
+
+
+def where(cond: DNDarray, x=None, y=None) -> DNDarray:
+    """3-operand select / 1-operand nonzero (reference indexing.py:98-156)."""
+    if x is None and y is None:
+        return nonzero(cond)
+    if x is None or y is None:
+        raise TypeError("either both or neither of x and y should be given")
+    sanitize_in(cond)
+    ax = x.larray if isinstance(x, DNDarray) else jnp.asarray(x)
+    ay = y.larray if isinstance(y, DNDarray) else jnp.asarray(y)
+    garr = jnp.where(cond.larray != 0, ax, ay)
+    garr = cond.comm.apply_sharding(garr, cond.split if garr.ndim else None)
+    return DNDarray(
+        garr,
+        tuple(garr.shape),
+        types.canonical_heat_type(garr.dtype),
+        cond.split if garr.ndim else None,
+        cond.device,
+        cond.comm,
+        cond.balanced,
+    )

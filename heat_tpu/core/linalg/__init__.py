@@ -1,0 +1,7 @@
+"""Distributed linear algebra (reference: heat/core/linalg/__init__.py)."""
+
+from . import basics, solver
+from .basics import *
+from .qr import qr, QR
+from .solver import *
+from .svd import svd, SVD

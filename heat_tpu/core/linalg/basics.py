@@ -1,0 +1,234 @@
+"""Distributed linear algebra basics.
+
+Reference: heat/core/linalg/basics.py:16-1269.  The centerpiece there is a
+780-line hand-written block-distributed SUMMA ``matmul`` covering all four
+split combinations with Isend/Irecv block exchanges (:285-787).  On TPU the
+same computation is ``jnp.matmul`` on sharded global arrays: GSPMD's SPMD
+partitioner emits the SUMMA-equivalent collective schedule (all-gather or
+reduce-scatter per block) tuned for the MXU and ICI topology — beating a
+hand-rolled schedule is exactly what the compiler is for.  What this module
+keeps from the reference is the *semantics*: dtype promotion, the
+vector/matrix edge cases, and the result-split rules for every split
+combination (basics.py:168-283).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple, Union
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from .. import factories, types
+from ..communication import sanitize_comm
+from ..dndarray import DNDarray
+from ..sanitation import sanitize_in
+from ..stride_tricks import sanitize_axis
+
+__all__ = [
+    "dot",
+    "get_matmul_precision",
+    "matmul",
+    "matrix_norm",
+    "norm",
+    "outer",
+    "projection",
+    "set_matmul_precision",
+    "transpose",
+    "tril",
+    "triu",
+    "vector_norm",
+]
+
+# On TPU the MXU's default matmul precision is bfloat16-accumulate, which is
+# far below the reference's float32 torch numerics (observed: ||QR - A||
+# ~0.3 instead of ~1e-5 on a 1024×16 factorization).  This framework is a
+# numerics-parity analytics stack first, so linalg defaults to 'highest'
+# (fp32 accumulation via multiple MXU passes); benchmarks that want raw MXU
+# throughput can switch to 'default' (bf16) or 'float32' (3-pass).
+_MATMUL_PRECISION = "highest"
+
+
+def set_matmul_precision(precision: str) -> None:
+    """Set the MXU precision for all linalg matmuls:
+    'default' (bf16 inputs), 'float32', or 'highest'."""
+    global _MATMUL_PRECISION
+    if precision not in ("default", "float32", "highest"):
+        raise ValueError(f"invalid precision {precision!r}")
+    _MATMUL_PRECISION = precision
+
+
+def get_matmul_precision() -> str:
+    """The current MXU matmul precision for linalg ops."""
+    return _MATMUL_PRECISION
+
+
+def _precision():
+    return None if _MATMUL_PRECISION == "default" else _MATMUL_PRECISION
+
+
+def _result_split_matmul(a: DNDarray, b: DNDarray, out_ndim: int) -> Optional[int]:
+    """Result-split rule for matmul, mirroring reference basics.py:168-283:
+    split=0 @ anything → row-split result; anything @ split=1 → col-split;
+    a.split=1 @ b.split=0 contracts the split axis → split=None (the
+    all-reduce case)."""
+    if out_ndim == 0:
+        return None
+    if a.split == 0 and a.ndim > 1:
+        return 0
+    if b.split is not None and b.ndim > 1 and b.split == b.ndim - 1:
+        return out_ndim - 1
+    if a.split is not None or b.split is not None:
+        # contraction over the split axis (or vector operands): replicate,
+        # XLA will have inserted the psum
+        return None
+    return None
+
+
+def matmul(a: DNDarray, b: DNDarray, allow_resplit: bool = False) -> DNDarray:
+    """Matrix product of two DNDarrays (reference basics.py:71-787).
+
+    All four split combinations are supported; the compiler plans the block
+    exchanges that basics.py:420-745 performs manually.  Vector operands
+    follow numpy semantics (reference fast paths :168-283).
+    """
+    sanitize_in(a)
+    sanitize_in(b)
+    promoted = types.promote_types(a.dtype, b.dtype)
+    aa = a.larray.astype(promoted.jax_type())
+    ba = b.larray.astype(promoted.jax_type())
+    garr = jnp.matmul(aa, ba, precision=_precision())
+    split = _result_split_matmul(a, b, garr.ndim)
+    comm = a.comm
+    garr = comm.apply_sharding(garr, split)
+    return DNDarray(
+        garr, tuple(garr.shape), promoted, split, a.device, comm, True
+    )
+
+
+def dot(a: DNDarray, b: DNDarray, out: Optional[DNDarray] = None):
+    """Dot product (reference basics.py:16-70: 1-D = local dot + Allreduce;
+    2-D delegates to matmul; scalars multiply)."""
+    if isinstance(a, DNDarray) and isinstance(b, DNDarray):
+        if a.ndim == 0 or b.ndim == 0:
+            from .. import arithmetics
+
+            return arithmetics.mul(a, b)
+        if a.ndim == 1 and b.ndim == 1:
+            res = jnp.dot(a.larray, b.larray, precision=_precision())
+            result = DNDarray(
+                res, (), types.promote_types(a.dtype, b.dtype), None, a.device, a.comm, True
+            )
+            if out is not None:
+                out.larray = result.larray
+                return out
+            return result
+        ret = matmul(a, b)
+        if out is not None:
+            out.larray = ret.larray
+            return out
+        return ret
+    from .. import arithmetics
+
+    return arithmetics.mul(a, b)
+
+
+def matrix_norm(a: DNDarray, ord=None) -> DNDarray:
+    """Frobenius norm of a matrix (numpy-parity helper over the reference's
+    single ``norm``, basics.py:788-811)."""
+    sanitize_in(a)
+    res = jnp.linalg.norm(a.larray.astype(jnp.float32) if types.heat_type_is_exact(a.dtype) else a.larray, ord=ord)
+    return DNDarray(res, (), types.canonical_heat_type(res.dtype), None, a.device, a.comm, True)
+
+
+def norm(a: DNDarray) -> float:
+    """Frobenius/2-norm of the whole array
+    (reference basics.py:788-811: sqrt of distributed dot)."""
+    sanitize_in(a)
+    arr = a.larray
+    if types.heat_type_is_exact(a.dtype):
+        arr = arr.astype(jnp.float32)
+    return float(jnp.sqrt(jnp.sum(arr * arr)))
+
+
+def vector_norm(a: DNDarray, ord=2) -> DNDarray:
+    """Vector p-norm (numpy-parity helper)."""
+    sanitize_in(a)
+    arr = a.larray
+    if types.heat_type_is_exact(a.dtype):
+        arr = arr.astype(jnp.float32)
+    res = jnp.linalg.norm(arr.reshape(-1), ord=ord)
+    return DNDarray(res, (), types.canonical_heat_type(res.dtype), None, a.device, a.comm, True)
+
+
+def outer(a: DNDarray, b: DNDarray, out: Optional[DNDarray] = None, split: Optional[int] = None) -> DNDarray:
+    """Outer product of two vectors (reference basics.py:812-1050 — a ring
+    exchange of the smaller operand; here one sharded jnp.outer, with the
+    requested result split applied)."""
+    sanitize_in(a)
+    sanitize_in(b)
+    promoted = types.promote_types(a.dtype, b.dtype)
+    garr = jnp.outer(a.larray.astype(promoted.jax_type()), b.larray.astype(promoted.jax_type()))
+    if split is None:
+        split = 0 if (a.split is not None or b.split is not None) else None
+    split = sanitize_axis(garr.shape, split)
+    garr = a.comm.apply_sharding(garr, split)
+    result = DNDarray(garr, tuple(garr.shape), promoted, split, a.device, a.comm, True)
+    if out is not None:
+        out.larray = result.larray
+        return out
+    return result
+
+
+def projection(a: DNDarray, b: DNDarray) -> DNDarray:
+    """Projection of vector a onto vector b (reference basics.py:1051-1077)."""
+    sanitize_in(a)
+    sanitize_in(b)
+    if a.ndim != 1 or b.ndim != 1:
+        raise RuntimeError(f"projection requires 1-D vectors, got {a.ndim}-d and {b.ndim}-d")
+    from .. import arithmetics
+
+    scale = dot(a, b).item() / dot(b, b).item()
+    return arithmetics.mul(b, scale)
+
+
+def transpose(a: DNDarray, axes: Optional[List[int]] = None) -> DNDarray:
+    """Permute axes (reference basics.py:1078-1146: local permute + split
+    remap)."""
+    sanitize_in(a)
+    if axes is None:
+        axes = tuple(reversed(range(a.ndim)))
+    else:
+        axes = tuple(int(ax) % a.ndim for ax in axes)
+        if len(axes) != a.ndim or len(set(axes)) != a.ndim:
+            raise ValueError("axes do not match array")
+    garr = jnp.transpose(a.larray, axes)
+    split = axes.index(a.split) if a.split is not None else None
+    garr = a.comm.apply_sharding(garr, split)
+    return DNDarray(garr, tuple(garr.shape), a.dtype, split, a.device, a.comm, a.balanced)
+
+
+def __tri_op(m: DNDarray, k: int, op) -> DNDarray:
+    """Shared tril/triu core (reference basics.py:1147-1221 — per-rank
+    diagonal offsets; here one global masked op)."""
+    sanitize_in(m)
+    if m.ndim < 2:
+        # numpy semantics: a 1-D input becomes a 2-D matrix replicating the vector
+        garr = op(jnp.vstack([m.larray] * m.shape[0]), k=k)
+        split = m.split
+        garr = m.comm.apply_sharding(garr, split)
+        return DNDarray(garr, tuple(garr.shape), m.dtype, split, m.device, m.comm, True)
+    garr = op(m.larray, k=k)
+    garr = m.comm.apply_sharding(garr, m.split)
+    return DNDarray(garr, tuple(garr.shape), m.dtype, m.split, m.device, m.comm, m.balanced)
+
+
+def tril(m: DNDarray, k: int = 0) -> DNDarray:
+    """Lower-triangular part (reference basics.py:1222-1246)."""
+    return __tri_op(m, k, jnp.tril)
+
+
+def triu(m: DNDarray, k: int = 0) -> DNDarray:
+    """Upper-triangular part (reference basics.py:1247-1269)."""
+    return __tri_op(m, k, jnp.triu)

@@ -1,0 +1,127 @@
+"""Distributed QR decomposition.
+
+Reference: heat/core/linalg/qr.py:10-988 — a tiled CAQR over
+``SquareDiagTiles`` with per-tile Householder factorizations, pairwise tile
+row merges, async Q-factor shipping, and a column-cyclic split=1 loop.
+
+TPU-first design (per SURVEY.md §7 build plan, item 8): **TSQR**
+(communication-avoiding tall-skinny QR).  For a row-split matrix, each shard
+computes a local QR; the stacked R factors are QR'd again; one round of
+all-gather replaces the reference's point-to-point tile choreography.  The
+merge tree is expressed with ``shard_map`` when the row count divides the
+mesh, falling back to XLA's own lowering otherwise.  split=1 and replicated
+inputs use on-device ``jnp.linalg.qr`` directly (same as reference
+split=None, qr.py:70-94).
+"""
+
+from __future__ import annotations
+
+import collections
+from typing import Optional, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec
+
+from .. import factories, types
+from ..dndarray import DNDarray
+from ..sanitation import sanitize_in
+
+__all__ = ["qr"]
+
+QR = collections.namedtuple("QR", "Q, R")
+
+
+def _tsqr(a: DNDarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Two-stage TSQR on the mesh (replaces reference qr.py:303-816).
+
+    Stage 1: per-shard local QR inside shard_map (runs on every device in
+    parallel).  Stage 2: the (size·n, n) stack of R factors — tiny — is
+    QR'd once, and local Qs are corrected by the matching R-block.
+    """
+    comm = a.comm
+    mesh = comm.mesh
+    axis = comm.axis_name
+    m, n = a.shape
+    size = comm.size
+    arr = a.larray
+
+    if size == 1 or m % size != 0 or m // size < n:
+        # not shard-decomposable: one on-device QR (XLA distributes)
+        q, r = jnp.linalg.qr(arr)
+        return q, r
+
+    def _local_qr(block):
+        q, r = jnp.linalg.qr(block)
+        return q, r
+
+    local_qr = jax.shard_map(
+        _local_qr,
+        mesh=mesh,
+        in_specs=PartitionSpec(axis, None),
+        out_specs=(PartitionSpec(axis, None), PartitionSpec(axis, None)),
+    )
+    q1, r1 = jax.jit(local_qr)(arr)  # q1: (m, n) row-split; r1: (size*n, n)
+
+    # stage 2 on the gathered R stack (size*n × n — small, replicated)
+    r1_full = comm.allgather(r1)
+    q2, r = jnp.linalg.qr(r1_full)  # q2: (size*n, n)
+
+    # combine: each shard's Q_local @ Q2-block
+    from .basics import _precision
+
+    def _combine(q1_blk, q2_blk):
+        return jnp.matmul(q1_blk, q2_blk, precision=_precision())
+
+    combine = jax.shard_map(
+        _combine,
+        mesh=mesh,
+        in_specs=(PartitionSpec(axis, None), PartitionSpec(axis, None)),
+        out_specs=PartitionSpec(axis, None),
+    )
+    q = jax.jit(combine)(q1, q2)
+    return q, r
+
+
+def qr(
+    a: DNDarray,
+    tiles_per_proc: int = 1,
+    calc_q: bool = True,
+    overwrite_a: bool = False,
+) -> QR:
+    """Reduced QR factorization ``a = Q @ R`` (reference qr.py:10-302).
+
+    ``tiles_per_proc`` is accepted for API parity; the TSQR formulation has
+    no tile-count knob (the reference uses it to trade latency for
+    parallelism inside its tile grid, qr.py:31-36).
+    """
+    sanitize_in(a)
+    if not isinstance(tiles_per_proc, (int, np.integer)):
+        raise TypeError(f"tiles_per_proc must be an int, got {type(tiles_per_proc)}")
+    if a.ndim != 2:
+        raise ValueError(f"qr requires a 2-D DNDarray, got {a.ndim}-d")
+
+    dtype = a.dtype if types.heat_type_is_inexact(a.dtype) else types.float32
+    arr = a.larray.astype(dtype.jax_type())
+
+    if a.split == 0 and a.shape[0] >= a.shape[1]:
+        aa = a if a.dtype is dtype else a.astype(dtype)
+        q_g, r_g = _tsqr(aa if aa.larray is arr else DNDarray(arr, a.shape, dtype, a.split, a.device, a.comm, True))
+    else:
+        # replicated, split=1, or wide matrices: on-device QR, XLA plans
+        # the distribution (reference split=1 loop qr.py:817-988)
+        q_g, r_g = jnp.linalg.qr(arr)
+
+    comm, device = a.comm, a.device
+    if not calc_q:
+        r_split = a.split if a.split == 1 else None
+        r = DNDarray(comm.apply_sharding(r_g, r_split), tuple(r_g.shape), dtype, r_split, device, comm, True)
+        return QR(None, r)
+
+    q_split = 0 if a.split == 0 else a.split
+    q = DNDarray(comm.apply_sharding(q_g, q_split), tuple(q_g.shape), dtype, q_split, device, comm, True)
+    r_split = None if a.split != 1 else 1
+    r = DNDarray(comm.apply_sharding(r_g, r_split), tuple(r_g.shape), dtype, r_split, device, comm, True)
+    return QR(q, r)

@@ -1,0 +1,80 @@
+"""Singular value decomposition.
+
+Reference: heat/core/linalg/svd.py:1 — a **stub** (one commented line); SVD
+does not exist in HeAT 0.5.1.  Implemented here because the rebuild's
+baseline configs exercise it (BASELINE.md target 5: "linalg.qr + SVD on
+tall-skinny split DNDarray").
+
+Algorithm: always reduce via QR first (TSQR when row-split — see qr.py),
+then factor the small triangular R on the host.  This is the standard
+communication-avoiding SVD and it also sidesteps a hard constraint of the
+current TPU toolchain: lowering ``jnp.linalg.svd`` crashes the XLA TPU
+compiler (TransposeFolding CHECK failure → SIGABRT, observed on
+libtpu/v5e), so no SVD is ever compiled for the accelerator — only QR and
+matmul are, both of which the MXU handles natively.  Wide matrices factor
+transposed and swap U/V.
+"""
+
+from __future__ import annotations
+
+import collections
+
+import numpy as np
+import jax.numpy as jnp
+
+from .. import types
+from ..dndarray import DNDarray
+from ..sanitation import sanitize_in
+from .qr import qr as _qr
+
+__all__ = ["svd"]
+
+SVD = collections.namedtuple("SVD", "U, S, V")
+
+
+def _reduced_svd_factors(a: DNDarray, dtype):
+    """QR-reduce then host-SVD the small R: returns (Q, Ur, S, Vt) with
+    Q on-device and the rest as numpy arrays."""
+    q, r = _qr(a if a.dtype is dtype else a.astype(dtype))
+    ur, s, vt = np.linalg.svd(np.asarray(r.larray), full_matrices=False)
+    return q, ur, s, vt
+
+
+def svd(a: DNDarray, full_matrices: bool = False, compute_uv: bool = True):
+    """Reduced SVD ``a = U @ diag(S) @ V.T``.
+
+    Returns the namedtuple ``SVD(U, S, V)``; with ``compute_uv=False`` only
+    ``S`` (as a DNDarray).
+    """
+    sanitize_in(a)
+    if a.ndim != 2:
+        raise ValueError(f"svd requires a 2-D DNDarray, got {a.ndim}-d")
+    if full_matrices:
+        raise NotImplementedError("full_matrices=True is not supported (reduced SVD only)")
+
+    dtype = a.dtype if types.heat_type_is_inexact(a.dtype) else types.float32
+    comm, device = a.comm, a.device
+    m, n = a.shape
+
+    if m < n:
+        # wide: factor the transpose, swap U and V
+        if not compute_uv:
+            return svd(a.T, compute_uv=False)
+        res = svd(a.T, compute_uv=True)
+        return SVD(res.V, res.S, res.U)
+
+    if not compute_uv:
+        _, r = _qr(a if a.dtype is dtype else a.astype(dtype))
+        s = np.linalg.svd(np.asarray(r.larray), compute_uv=False)
+        s_arr = jnp.asarray(s, dtype=dtype.jax_type())
+        return DNDarray(s_arr, tuple(s_arr.shape), dtype, None, device, comm, True)
+
+    q, ur, s, vt = _reduced_svd_factors(a, dtype)
+    from .basics import _precision
+
+    u = jnp.matmul(q.larray, jnp.asarray(ur, dtype=dtype.jax_type()), precision=_precision())
+    u = comm.apply_sharding(u, a.split if a.split == 0 else None)
+    U = DNDarray(u, (m, n), dtype, a.split if a.split == 0 else None, device, comm, True)
+    S = DNDarray(jnp.asarray(s, dtype=dtype.jax_type()), (n,), dtype, None, device, comm, True)
+    V = DNDarray(jnp.asarray(vt.T, dtype=dtype.jax_type()), (n, n), dtype, None, device, comm, True)
+    return SVD(U, S, V)

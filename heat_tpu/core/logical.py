@@ -1,0 +1,73 @@
+"""Logical reductions and elementwise logical ops.
+
+Reference: heat/core/logical.py:24-350 — ``all``/``any`` are reductions with
+MPI.LAND/LOR; ``allclose``/``isclose`` and the elementwise logicals route
+through the generic engines.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from . import _operations, types
+from .dndarray import DNDarray
+
+__all__ = [
+    "all",
+    "allclose",
+    "any",
+    "isclose",
+    "logical_and",
+    "logical_not",
+    "logical_or",
+    "logical_xor",
+]
+
+
+def all(x, axis=None, out=None, keepdims=None):
+    """True where all elements (along axis) are nonzero
+    (reference logical.py:24-86; the MPI.LAND Allreduce is XLA's)."""
+    return _operations.__reduce_op(jnp.all, x, axis, out, neutral=1, keepdims=keepdims)
+
+
+def any(x, axis=None, out=None, keepdims=False):
+    """True where any element (along axis) is nonzero
+    (reference logical.py:133-180)."""
+    return _operations.__reduce_op(jnp.any, x, axis, out, neutral=0, keepdims=keepdims)
+
+
+def allclose(x, y, rtol: float = 1e-05, atol: float = 1e-08, equal_nan: bool = False) -> bool:
+    """Scalar closeness verdict (reference logical.py:87-132: local allclose
+    + LAND Allreduce)."""
+    ax = x.larray if isinstance(x, DNDarray) else jnp.asarray(x)
+    ay = y.larray if isinstance(y, DNDarray) else jnp.asarray(y)
+    return bool(jnp.allclose(ax, ay, rtol=rtol, atol=atol, equal_nan=equal_nan))
+
+
+def isclose(x, y, rtol: float = 1e-05, atol: float = 1e-08, equal_nan: bool = False):
+    """Elementwise closeness (reference logical.py:181-230)."""
+
+    def _isclose(a, b):
+        return jnp.isclose(a, b, rtol=rtol, atol=atol, equal_nan=equal_nan)
+
+    return _operations.__binary_op(_isclose, x, y)
+
+
+def logical_and(t1, t2):
+    """(reference logical.py:231-260)"""
+    return _operations.__binary_op(jnp.logical_and, t1, t2)
+
+
+def logical_or(t1, t2):
+    """(reference logical.py:261-290)"""
+    return _operations.__binary_op(jnp.logical_or, t1, t2)
+
+
+def logical_xor(t1, t2):
+    """(reference logical.py:291-320)"""
+    return _operations.__binary_op(jnp.logical_xor, t1, t2)
+
+
+def logical_not(t, out=None):
+    """(reference logical.py:321-350)"""
+    return _operations.__local_op(jnp.logical_not, t, out, no_cast=True)

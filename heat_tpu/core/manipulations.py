@@ -1,0 +1,440 @@
+"""Shape and layout manipulations.
+
+Reference: heat/core/manipulations.py:141-3386.  The reference hand-rolls
+redistribution for nearly every function here (``concatenate`` moves
+boundary chunks, ``reshape`` routes through a global-index Alltoallv
+(:1756-1776), ``sort`` is a full distributed sample-sort with pivot
+exchange (:2040-2160), ``unique`` merges per-rank uniques via Allgatherv
+(:2685+), ``topk`` needs a custom MPI reduction op (:3346-3386)).
+
+On global arrays each of these is its jnp equivalent — XLA plans the data
+movement — plus split bookkeeping.  The result-split rules follow the
+reference; performance-sensitive resharding stays explicit via
+``resplit``.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple, Union
+
+import numpy as np
+
+import jax.numpy as jnp
+from jax import lax
+
+from . import factories, types
+from .dndarray import DNDarray
+from .sanitation import sanitize_in
+from .stride_tricks import sanitize_axis, sanitize_shape
+
+__all__ = [
+    "balance",
+    "column_stack",
+    "concatenate",
+    "diag",
+    "diagonal",
+    "dsplit",
+    "expand_dims",
+    "flatten",
+    "flip",
+    "fliplr",
+    "flipud",
+    "hsplit",
+    "hstack",
+    "pad",
+    "redistribute",
+    "repeat",
+    "reshape",
+    "resplit",
+    "rot90",
+    "row_stack",
+    "sort",
+    "split",
+    "squeeze",
+    "stack",
+    "topk",
+    "unique",
+    "vsplit",
+    "vstack",
+]
+
+
+def _rewrap(x: DNDarray, garr, split, dtype=None) -> DNDarray:
+    """Apply layout + wrap a result derived from ``x``."""
+    if garr.ndim == 0:
+        split = None
+    garr = x.comm.apply_sharding(garr, split)
+    return DNDarray(
+        garr,
+        tuple(garr.shape),
+        dtype or types.canonical_heat_type(garr.dtype),
+        split,
+        x.device,
+        x.comm,
+        True,
+    )
+
+
+def balance(x: DNDarray, copy: bool = False) -> DNDarray:
+    """Return a load-balanced copy (reference dndarray.balance_,
+    dndarray.py:900 — a no-op under the canonical GSPMD layout)."""
+    sanitize_in(x)
+    from .memory import copy as _copy
+
+    return _copy(x) if copy else x
+
+
+def redistribute(x: DNDarray, lshape_map=None, target_map=None) -> DNDarray:
+    """Out-of-place redistribute (reference dndarray.redistribute_,
+    dndarray.py:2560).  Canonical layout is maintained; see
+    ``DNDarray.redistribute_``."""
+    sanitize_in(x)
+    x.redistribute_(lshape_map, target_map)
+    return x
+
+
+def concatenate(arrays, axis: int = 0) -> DNDarray:
+    """Join arrays along an existing axis
+    (reference manipulations.py:141-470 — there, boundary chunks are
+    re-chunked and exchanged; here a global jnp.concatenate)."""
+    if not isinstance(arrays, (list, tuple)) or len(arrays) < 1:
+        raise TypeError("arrays must be a non-empty sequence of DNDarrays")
+    for a in arrays:
+        sanitize_in(a)
+    a0 = arrays[0]
+    axis = sanitize_axis(a0.shape, axis)
+    out_type = a0.dtype
+    for a in arrays[1:]:
+        out_type = types.promote_types(out_type, a.dtype)
+    garr = jnp.concatenate(
+        [a.larray.astype(out_type.jax_type()) for a in arrays], axis=axis
+    )
+    split = a0.split if a0.split is not None else next(
+        (a.split for a in arrays if a.split is not None), None
+    )
+    return _rewrap(a0, garr, split, out_type)
+
+
+def diag(a: DNDarray, offset: int = 0) -> DNDarray:
+    """Extract/construct a diagonal (reference manipulations.py:471-548)."""
+    sanitize_in(a)
+    if a.ndim == 1:
+        garr = jnp.diag(a.larray, k=offset)
+        return _rewrap(a, garr, a.split, a.dtype)
+    return diagonal(a, offset=offset)
+
+
+def diagonal(a: DNDarray, offset: int = 0, dim1: int = 0, dim2: int = 1) -> DNDarray:
+    """Extract a diagonal from an n-D array (reference manipulations.py:549-706)."""
+    sanitize_in(a)
+    dim1 = sanitize_axis(a.shape, dim1)
+    dim2 = sanitize_axis(a.shape, dim2)
+    if dim1 == dim2:
+        raise ValueError("dim1 and dim2 need to be different dimensions")
+    garr = jnp.diagonal(a.larray, offset=offset, axis1=dim1, axis2=dim2)
+    split = None if a.split in (dim1, dim2) else a.split
+    if split is not None:
+        split = split - sum(1 for d in (dim1, dim2) if d < split)
+        split = min(max(split, 0), garr.ndim - 1)
+    return _rewrap(a, garr, split, a.dtype)
+
+
+def expand_dims(a: DNDarray, axis: int) -> DNDarray:
+    """Insert a size-1 axis (reference manipulations.py:707-765)."""
+    sanitize_in(a)
+    if not isinstance(axis, (int, np.integer)):
+        raise TypeError(f"axis must be an int, got {type(axis)}")
+    if axis < -(a.ndim + 1) or axis > a.ndim:
+        raise ValueError(f"axis {axis} out of bounds for expanding {a.ndim}-d array")
+    axis = axis % (a.ndim + 1)
+    garr = jnp.expand_dims(a.larray, axis)
+    split = a.split if a.split is None or a.split < axis else a.split + 1
+    return _rewrap(a, garr, split, a.dtype)
+
+
+def flatten(a: DNDarray) -> DNDarray:
+    """1-D view of the global array (reference manipulations.py:766-800 —
+    there an Alltoallv-backed reshape; here XLA's)."""
+    sanitize_in(a)
+    garr = a.larray.reshape(-1)
+    split = 0 if a.split is not None else None
+    return _rewrap(a, garr, split, a.dtype)
+
+
+def flip(a: DNDarray, axis=None) -> DNDarray:
+    """Reverse element order along axes (reference manipulations.py:801-866 —
+    there a rank-reversal Send/Recv; here jnp.flip + reshard)."""
+    sanitize_in(a)
+    axis = sanitize_axis(a.shape, axis)
+    garr = jnp.flip(a.larray, axis=axis)
+    return _rewrap(a, garr, a.split, a.dtype)
+
+
+def fliplr(a: DNDarray) -> DNDarray:
+    """(reference manipulations.py:867-893)"""
+    if a.ndim < 2:
+        raise IndexError("fliplr requires at least 2 dimensions")
+    return flip(a, 1)
+
+
+def flipud(a: DNDarray) -> DNDarray:
+    """(reference manipulations.py:894-920)"""
+    return flip(a, 0)
+
+
+def pad(array: DNDarray, pad_width, mode: str = "constant", constant_values=0) -> DNDarray:
+    """Pad an array (reference manipulations.py:1049-1394)."""
+    sanitize_in(array)
+    # normalize pad_width to numpy form
+    if isinstance(pad_width, (int, np.integer)):
+        np_pad = pad_width
+    else:
+        np_pad = tuple(
+            tuple(p) if isinstance(p, (list, tuple)) else p for p in pad_width
+        )
+    if mode != "constant":
+        raise NotImplementedError(f"pad mode {mode!r} not implemented (reference supports constant only)")
+    garr = jnp.pad(array.larray, np_pad, mode=mode, constant_values=constant_values)
+    return _rewrap(array, garr, array.split, array.dtype)
+
+
+def repeat(a, repeats, axis: Optional[int] = None) -> DNDarray:
+    """Repeat elements (reference manipulations.py:1395-1650)."""
+    if not isinstance(a, DNDarray):
+        a = factories.array(a)
+    if isinstance(repeats, DNDarray):
+        repeats = np.asarray(repeats.larray)
+    axis = sanitize_axis(a.shape, axis)
+    garr = jnp.repeat(a.larray, repeats, axis=axis)
+    split = a.split if axis is not None else (0 if a.split is not None else None)
+    if garr.ndim == 1:
+        split = 0 if a.split is not None else None
+    return _rewrap(a, garr, split, a.dtype)
+
+
+def reshape(a: DNDarray, shape, new_split: Optional[int] = None, **kwargs) -> DNDarray:
+    """Reshape to a new global shape (reference manipulations.py:1651-1775 —
+    there, a global-index chunk mask + Alltoallv exchange; here XLA's
+    reshape partitioning)."""
+    sanitize_in(a)
+    if isinstance(shape, (int, np.integer)):
+        shape = (int(shape),)
+    shape = tuple(int(s) for s in shape)
+    # resolve a single -1
+    if any(s == -1 for s in shape):
+        known = int(np.prod([s for s in shape if s != -1]))
+        missing = a.size // max(known, 1)
+        shape = tuple(missing if s == -1 else s for s in shape)
+    if int(np.prod(shape)) != a.size:
+        raise ValueError(f"cannot reshape array of size {a.size} into shape {shape}")
+    garr = a.larray.reshape(shape)
+    if new_split is None:
+        new_split = a.split if (a.split is not None and a.split < len(shape)) else (
+            0 if a.split is not None and len(shape) > 0 else None
+        )
+    else:
+        new_split = sanitize_axis(shape, new_split)
+    return _rewrap(a, garr, new_split, a.dtype)
+
+
+def resplit(arr: DNDarray, axis: Optional[int] = None) -> DNDarray:
+    """Out-of-place reshard along ``axis``
+    (reference manipulations.py:2969-3060: split→None = Allgatherv path
+    :3023; here a single XLA reshard)."""
+    sanitize_in(arr)
+    axis = sanitize_axis(arr.shape, axis)
+    if axis == arr.split:
+        return DNDarray(
+            arr.larray, arr.shape, arr.dtype, axis, arr.device, arr.comm, arr.balanced
+        )
+    garr = arr.comm.resplit(arr.larray, axis)
+    return DNDarray(garr, arr.shape, arr.dtype, axis, arr.device, arr.comm, True)
+
+
+def rot90(m: DNDarray, k: int = 1, axes=(0, 1)) -> DNDarray:
+    """Rotate in the plane of two axes (reference manipulations.py:1776-1892)."""
+    sanitize_in(m)
+    axes = tuple(sanitize_axis(m.shape, ax) for ax in axes)
+    if len(set(axes)) != 2:
+        raise ValueError("axes must be different")
+    garr = jnp.rot90(m.larray, k=k, axes=axes)
+    split = m.split
+    if split in axes and k % 2 == 1:
+        split = axes[0] if split == axes[1] else axes[1]
+    return _rewrap(m, garr, split, m.dtype)
+
+
+def sort(a: DNDarray, axis: int = -1, descending: bool = False, out=None):
+    """Sort along an axis, returning (values, original indices)
+    (reference manipulations.py:1893-2160 — a distributed sample-sort with
+    pivot Gatherv/Bcast and Alltoallv of values+indices; XLA's sort handles
+    the cross-shard exchange here)."""
+    sanitize_in(a)
+    axis = sanitize_axis(a.shape, axis)
+    if axis is None:
+        axis = a.ndim - 1
+    arr = a.larray
+    indices = jnp.argsort(-arr if descending else arr, axis=axis, stable=True)
+    values = jnp.take_along_axis(arr, indices, axis=axis)
+    vals = _rewrap(a, values, a.split, a.dtype)
+    idx = _rewrap(a, indices.astype(jnp.int32), a.split, types.int32)
+    if out is not None:
+        out.larray = vals.larray
+        return out, idx
+    return vals, idx
+
+
+def split(x: DNDarray, indices_or_sections, axis: int = 0) -> List[DNDarray]:
+    """Split into sub-arrays (reference manipulations.py:2162-2318)."""
+    sanitize_in(x)
+    axis = sanitize_axis(x.shape, axis)
+    if isinstance(indices_or_sections, (int, np.integer)):
+        if x.shape[axis] % int(indices_or_sections) != 0:
+            raise ValueError("array split does not result in an equal division")
+    if isinstance(indices_or_sections, DNDarray):
+        indices_or_sections = np.asarray(indices_or_sections.larray)
+    parts = jnp.split(x.larray, indices_or_sections, axis=axis)
+    return [_rewrap(x, p, x.split, x.dtype) for p in parts]
+
+
+def dsplit(x: DNDarray, indices_or_sections) -> List[DNDarray]:
+    """(reference manipulations.py:2319-2347)"""
+    return split(x, indices_or_sections, axis=2)
+
+
+def hsplit(x: DNDarray, indices_or_sections) -> List[DNDarray]:
+    """(reference manipulations.py:2348-2380)"""
+    if x.ndim < 2:
+        return split(x, indices_or_sections, axis=0)
+    return split(x, indices_or_sections, axis=1)
+
+
+def vsplit(x: DNDarray, indices_or_sections) -> List[DNDarray]:
+    """(reference manipulations.py:2381-2413)"""
+    return split(x, indices_or_sections, axis=0)
+
+
+def squeeze(x: DNDarray, axis=None) -> DNDarray:
+    """Remove size-1 axes (reference manipulations.py:2414-2519)."""
+    sanitize_in(x)
+    axis = sanitize_axis(x.shape, axis)
+    if axis is not None:
+        axes = (axis,) if isinstance(axis, int) else axis
+        for ax in axes:
+            if x.shape[ax] != 1:
+                raise ValueError(f"cannot select an axis to squeeze out which has size not equal to one, axis {ax}")
+    else:
+        axes = tuple(i for i, s in enumerate(x.shape) if s == 1)
+    garr = jnp.squeeze(x.larray, axis=axes)
+    split = x.split
+    if split is not None:
+        if split in axes:
+            split = None
+        else:
+            split = split - sum(1 for ax in axes if ax < split)
+    return _rewrap(x, garr, split, x.dtype)
+
+
+def stack(arrays: Sequence[DNDarray], axis: int = 0, out=None) -> DNDarray:
+    """Join along a new axis (reference manipulations.py:2520-2605)."""
+    if len(arrays) < 2:
+        raise ValueError("stack expects a sequence of at least 2 DNDarrays")
+    for a in arrays:
+        sanitize_in(a)
+    a0 = arrays[0]
+    for a in arrays[1:]:
+        if a.shape != a0.shape:
+            raise ValueError(f"all input arrays must have the same shape, {a.shape} != {a0.shape}")
+    axis = axis % (a0.ndim + 1)
+    out_type = a0.dtype
+    for a in arrays[1:]:
+        out_type = types.promote_types(out_type, a.dtype)
+    garr = jnp.stack([a.larray.astype(out_type.jax_type()) for a in arrays], axis=axis)
+    split = a0.split
+    if split is not None and axis <= split:
+        split += 1
+    result = _rewrap(a0, garr, split, out_type)
+    if out is not None:
+        out.larray = result.larray
+        return out
+    return result
+
+
+def column_stack(arrays) -> DNDarray:
+    """Stack 1-D/2-D arrays as columns (reference manipulations.py:2606-2645)."""
+    reshaped = []
+    for a in arrays:
+        sanitize_in(a)
+        reshaped.append(a.expand_dims(1) if a.ndim == 1 else a)
+    return concatenate(reshaped, axis=1)
+
+
+def row_stack(arrays) -> DNDarray:
+    """Stack arrays as rows (reference manipulations.py:2646-2684)."""
+    reshaped = []
+    for a in arrays:
+        sanitize_in(a)
+        reshaped.append(a.expand_dims(0) if a.ndim == 1 else a)
+    return concatenate(reshaped, axis=0)
+
+
+def hstack(arrays) -> DNDarray:
+    """(reference manipulations.py: hstack)"""
+    arrays = list(arrays)
+    if all(a.ndim == 1 for a in arrays):
+        return concatenate(arrays, axis=0)
+    return concatenate(arrays, axis=1)
+
+
+def vstack(arrays) -> DNDarray:
+    """(reference manipulations.py: vstack)"""
+    return row_stack(list(arrays))
+
+
+def unique(a: DNDarray, sorted: bool = False, return_inverse: bool = False, axis=None):
+    """Unique elements (reference manipulations.py:2685-2968 — per-rank
+    unique + Allgatherv + merge; here one global jnp/np.unique; runs on host
+    shapes because uniqueness is data-dependent)."""
+    sanitize_in(a)
+    arr = np.asarray(a.larray)
+    if axis is not None:
+        axis = sanitize_axis(a.shape, axis)
+    res = np.unique(arr, return_inverse=return_inverse, axis=axis)
+    if return_inverse:
+        uniques, inverse = res
+    else:
+        uniques, inverse = res, None
+    uniques = jnp.asarray(uniques)
+    split = 0 if a.split is not None and uniques.ndim > 0 else None
+    result = _rewrap(a, uniques, split, a.dtype)
+    if return_inverse:
+        inv = factories.array(inverse.reshape(arr.shape) if axis is None else inverse,
+                              dtype=types.int64, device=a.device, comm=a.comm)
+        return result, inv
+    return result
+
+
+def topk(a: DNDarray, k: int, dim: int = -1, largest: bool = True, sorted: bool = True, out=None):
+    """k largest/smallest elements and their indices
+    (reference manipulations.py:3201-3345 + the custom MPI_TOPK reduction op
+    :3346-3386; here jax.lax.top_k — a native TPU sort network)."""
+    sanitize_in(a)
+    dim = sanitize_axis(a.shape, dim)
+    if dim is None:
+        dim = a.ndim - 1
+    arr = a.larray
+    moved = jnp.moveaxis(arr, dim, -1)
+    if largest:
+        vals, idx = lax.top_k(moved, k)
+    else:
+        vals, idx = lax.top_k(-moved, k)
+        vals = -vals
+    vals = jnp.moveaxis(vals, -1, dim)
+    idx = jnp.moveaxis(idx, -1, dim)
+    values = _rewrap(a, vals, a.split if a.split != dim else None, a.dtype)
+    indices = _rewrap(a, idx.astype(jnp.int64), a.split if a.split != dim else None, types.int64)
+    if out is not None:
+        out[0].larray = values.larray
+        out[1].larray = indices.larray
+        return out
+    return values, indices

@@ -1,0 +1,42 @@
+"""Memory layout helpers.
+
+Reference: heat/core/memory.py:9-76 (``copy``, ``sanitize_memory_layout``).
+XLA manages physical layout itself (tiling for the MXU/VPU makes C-vs-F
+stride order meaningless on TPU), so ``sanitize_memory_layout`` validates
+the argument for API parity and returns the array unchanged for both
+orders — documented divergence: ``order='F'`` does not change the stride
+pattern of the backing buffer.
+"""
+
+from __future__ import annotations
+
+__all__ = ["copy", "sanitize_memory_layout"]
+
+
+def copy(x):
+    """Physical copy of a DNDarray (reference memory.py:9-27)."""
+    from .dndarray import DNDarray
+    import jax.numpy as jnp
+
+    if not isinstance(x, DNDarray):
+        raise TypeError(f"input needs to be a DNDarray, but was {type(x)}")
+    return DNDarray(
+        jnp.array(x.larray, copy=True),
+        x.gshape,
+        x.dtype,
+        x.split,
+        x.device,
+        x.comm,
+        x.balanced,
+    )
+
+
+def sanitize_memory_layout(x, order: str = "C"):
+    """Validate a memory-order flag (reference memory.py:29-76).
+
+    On TPU, XLA chooses physical tilings; the order flag is accepted for
+    API compatibility but does not alter the buffer.
+    """
+    if order not in ("C", "F"):
+        raise ValueError(f"invalid memory layout {order!r}, expected 'C' or 'F'")
+    return x

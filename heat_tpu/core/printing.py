@@ -1,0 +1,66 @@
+"""Global string representations for DNDarrays.
+
+Reference: heat/core/printing.py:20-164 — there, a full print gathers via
+``resplit_(None)`` and a summarized print has each rank extract edge items
+followed by a rank-0 gather (:77-135).  In the single-controller model the
+global array is directly addressable, so printing is numpy formatting of
+(a summary of) the global array; XLA fetches only the shards the host
+touches.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["get_printoptions", "set_printoptions"]
+
+# torch-style default print options (reference printing.py:10-18)
+__PRINT_OPTIONS = {
+    "precision": 4,
+    "threshold": 1000,
+    "edgeitems": 3,
+    "linewidth": 120,
+    "sci_mode": None,
+}
+
+
+def get_printoptions() -> dict:
+    """View of the current print options."""
+    return dict(__PRINT_OPTIONS)
+
+
+def set_printoptions(
+    precision=None, threshold=None, edgeitems=None, linewidth=None, profile=None, sci_mode=None
+):
+    """Configure printing (reference printing.py:20-57)."""
+    if profile == "default":
+        __PRINT_OPTIONS.update(precision=4, threshold=1000, edgeitems=3, linewidth=120)
+    elif profile == "short":
+        __PRINT_OPTIONS.update(precision=2, threshold=1000, edgeitems=2, linewidth=120)
+    elif profile == "full":
+        __PRINT_OPTIONS.update(precision=4, threshold=float("inf"), edgeitems=3, linewidth=120)
+    for key, val in (
+        ("precision", precision),
+        ("threshold", threshold),
+        ("edgeitems", edgeitems),
+        ("linewidth", linewidth),
+        ("sci_mode", sci_mode),
+    ):
+        if val is not None:
+            __PRINT_OPTIONS[key] = val
+
+
+def __str__(x) -> str:
+    """Format a DNDarray (reference printing.py:58-163)."""
+    arr = np.asarray(x.larray)
+    opts = __PRINT_OPTIONS
+    body = np.array2string(
+        arr,
+        precision=opts["precision"],
+        threshold=opts["threshold"],
+        edgeitems=opts["edgeitems"],
+        max_line_width=opts["linewidth"],
+        separator=", ",
+    )
+    tail = [f"dtype=ht.{x.dtype.__name__}", f"device={x.device}", f"split={x.split}"]
+    return f"DNDarray({body}, {', '.join(tail)})"

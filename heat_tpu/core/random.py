@@ -1,0 +1,194 @@
+"""Reproducible counter-based random number generation.
+
+Reference: heat/core/random.py:25-822 — a stateless Threefry-2x32/64
+counter-based RNG whose outputs are identical regardless of process count:
+a global 128-bit (seed, counter) state maps each rank's chunk of the global
+index space to counter vectors, which Threefry encrypts (:638-798).
+
+JAX's PRNG **is** threefry counter-based — the same design (this is the
+"RNG is a gift" correspondence noted in SURVEY.md §7).  The global (seed,
+counter) state lives here; each draw folds the counter into the key and
+advances it by the number of elements drawn, so results are reproducible
+and independent of the mesh size — the reference's defining RNG property —
+while generation itself runs sharded on device.
+
+Divergence (documented): normal sampling uses JAX's native algorithm, not
+the reference's Kundu transform (random.py:218); moments and distribution
+are equivalent, exact streams differ.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple, Union
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from . import devices as _devices
+from . import factories, types
+from .communication import comm_for_device, sanitize_comm
+from .dndarray import DNDarray
+from .stride_tricks import sanitize_axis, sanitize_shape
+
+__all__ = [
+    "get_state",
+    "permutation",
+    "rand",
+    "randint",
+    "randn",
+    "random",
+    "randperm",
+    "sample",
+    "seed",
+    "set_state",
+    "uniform",
+]
+
+# global RNG state: (seed, counter) — reference random.py:16-24
+__seed: int = 0
+__counter: int = 0
+
+
+def seed(new_seed: Optional[int] = None) -> None:
+    """(Re-)seed the global generator (reference random.py:588-605)."""
+    global __seed, __counter
+    if new_seed is None:
+        new_seed = int(np.random.SeedSequence().entropy % (2**63))
+    __seed = int(new_seed)
+    __counter = 0
+
+
+def get_state() -> Tuple[str, int, int, int, float]:
+    """Return the generator state tuple
+    (reference random.py:163-179: ('Threefry', seed, counter, 0, 0.0))."""
+    return ("Threefry", __seed, __counter, 0, 0.0)
+
+
+def set_state(state: Tuple) -> None:
+    """Restore a state tuple (reference random.py:606-637)."""
+    global __seed, __counter
+    if not isinstance(state, tuple) or len(state) not in (3, 5):
+        raise ValueError("state needs to be a 3- or 5-tuple")
+    if state[0] != "Threefry":
+        raise ValueError("algorithm must be 'Threefry'")
+    __seed = int(state[1])
+    __counter = int(state[2])
+
+
+def _consume(n: int) -> jax.Array:
+    """Fold the current counter into the key and advance it by ``n``
+    elements (the counter-advancement contract of reference
+    random.py:25-163)."""
+    global __counter
+    key = jax.random.fold_in(jax.random.PRNGKey(__seed), __counter % (2**31))
+    __counter += int(n)
+    return key
+
+
+def _finalize(garr, dtype, split, device, comm) -> DNDarray:
+    device = _devices.sanitize_device(device)
+    comm = comm_for_device(device.platform) if comm is None else sanitize_comm(comm)
+    garr = comm.apply_sharding(garr, split if garr.ndim else None)
+    return DNDarray(garr, tuple(garr.shape), dtype, split, device, comm, True)
+
+
+def rand(*args, dtype=types.float32, split=None, device=None, comm=None) -> DNDarray:
+    """Uniform [0, 1) samples (reference random.py:319-382)."""
+    shape = sanitize_shape(args) if args else ()
+    dtype = types.canonical_heat_type(dtype)
+    if dtype not in (types.float32, types.float64, types.bfloat16, types.float16):
+        raise ValueError(f"Unsupported dtype {dtype.__name__} for rand")
+    split = sanitize_axis(shape, split)
+    n = int(np.prod(shape)) if shape else 1
+    key = _consume(n)
+    garr = jax.random.uniform(key, shape, dtype=dtype.jax_type())
+    return _finalize(garr, dtype, split, device, comm)
+
+
+def random(shape=None, dtype=types.float32, split=None, device=None, comm=None) -> DNDarray:
+    """numpy-style alias for :func:`rand` taking a shape tuple."""
+    shape = () if shape is None else sanitize_shape(shape)
+    return rand(*shape, dtype=dtype, split=split, device=device, comm=comm)
+
+
+sample = random
+
+
+def uniform(low=0.0, high=1.0, size=None, dtype=types.float32, split=None, device=None, comm=None) -> DNDarray:
+    """Uniform [low, high) samples (reference random.py: uniform wrapper)."""
+    size = () if size is None else sanitize_shape(size)
+    r = rand(*size, dtype=dtype, split=split, device=device, comm=comm)
+    if low != 0.0 or high != 1.0:
+        from . import arithmetics
+
+        r = arithmetics.add(arithmetics.mul(r, high - low), low)
+    return r
+
+
+def randint(
+    low,
+    high=None,
+    size=None,
+    dtype=types.int32,
+    split=None,
+    device=None,
+    comm=None,
+) -> DNDarray:
+    """Uniform random integers in [low, high) (reference random.py:383-462)."""
+    if high is None:
+        low, high = 0, low
+    if size is None:
+        size = ()
+    elif isinstance(size, (int, np.integer)):
+        size = (int(size),)
+    size = sanitize_shape(size)
+    if low >= high:
+        raise ValueError(f"low >= high ({low} >= {high})")
+    dtype = types.canonical_heat_type(dtype)
+    if dtype not in (types.int64, types.int32, types.int16, types.int8, types.uint8):
+        raise ValueError(f"Unsupported dtype {dtype.__name__} for randint")
+    split = sanitize_axis(size, split)
+    n = int(np.prod(size)) if size else 1
+    key = _consume(n)
+    garr = jax.random.randint(key, size, int(low), int(high), dtype=dtype.jax_type())
+    return _finalize(garr, dtype, split, device, comm)
+
+
+def randn(*args, dtype=types.float32, split=None, device=None, comm=None) -> DNDarray:
+    """Standard-normal samples (reference random.py:463-510; Kundu transform
+    :218-241 replaced by JAX's native normal — documented divergence)."""
+    shape = sanitize_shape(args) if args else ()
+    dtype = types.canonical_heat_type(dtype)
+    split = sanitize_axis(shape, split)
+    n = int(np.prod(shape)) if shape else 1
+    key = _consume(n)
+    garr = jax.random.normal(key, shape, dtype=dtype.jax_type())
+    return _finalize(garr, dtype, split, device, comm)
+
+
+def randperm(n: int, dtype=types.int64, split=None, device=None, comm=None) -> DNDarray:
+    """Random permutation of arange(n) (reference random.py:511-555)."""
+    if not isinstance(n, (int, np.integer)):
+        raise TypeError(f"n must be an integer, got {type(n)}")
+    dtype = types.canonical_heat_type(dtype)
+    key = _consume(int(n))
+    garr = jax.random.permutation(key, int(n)).astype(dtype.jax_type())
+    split = sanitize_axis((int(n),), split)
+    return _finalize(garr, dtype, split, device, comm)
+
+
+def permutation(x, split=None, device=None, comm=None) -> DNDarray:
+    """Permute a sequence or shuffle an array along axis 0
+    (reference random.py:242-318)."""
+    if isinstance(x, (int, np.integer)):
+        return randperm(int(x), split=split, device=device, comm=comm)
+    if isinstance(x, DNDarray):
+        key = _consume(x.shape[0] if x.ndim else 1)
+        garr = jax.random.permutation(key, x.larray, axis=0)
+        return _finalize(garr, x.dtype, x.split if split is None else split, device or x.device, comm or x.comm)
+    arr = jnp.asarray(np.asarray(x))
+    key = _consume(arr.shape[0] if arr.ndim else 1)
+    garr = jax.random.permutation(key, arr, axis=0)
+    return _finalize(garr, types.canonical_heat_type(garr.dtype), split, device, comm)

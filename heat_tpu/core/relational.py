@@ -1,0 +1,62 @@
+"""Elementwise comparisons.
+
+Reference: heat/core/relational.py:9-254 — all via ``__binary_op``; results
+are uint8 there (torch legacy); here they are ``ht.bool`` (numpy semantics),
+a documented divergence.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from . import _operations
+from .dndarray import DNDarray
+
+__all__ = ["eq", "equal", "ge", "gt", "le", "lt", "ne"]
+
+
+def eq(t1, t2):
+    """Elementwise == (reference relational.py:9-54)."""
+    return _operations.__binary_op(jnp.equal, t1, t2)
+
+
+def equal(t1, t2) -> bool:
+    """True iff both arrays are identical in shape and value
+    (reference relational.py:55-94: local equal + MPI LAND)."""
+    if isinstance(t1, DNDarray):
+        a1 = t1.larray
+    else:
+        a1 = jnp.asarray(t1)
+    if isinstance(t2, DNDarray):
+        a2 = t2.larray
+    else:
+        a2 = jnp.asarray(t2)
+    if tuple(a1.shape) != tuple(a2.shape):
+        return False
+    return bool(jnp.all(a1 == a2))
+
+
+def ge(t1, t2):
+    """Elementwise >= (reference relational.py:95-140)."""
+    return _operations.__binary_op(jnp.greater_equal, t1, t2)
+
+
+def gt(t1, t2):
+    """Elementwise > (reference relational.py:141-186)."""
+    return _operations.__binary_op(jnp.greater, t1, t2)
+
+
+def le(t1, t2):
+    """Elementwise <= (reference relational.py:187-212)."""
+    return _operations.__binary_op(jnp.less_equal, t1, t2)
+
+
+def lt(t1, t2):
+    """Elementwise < (reference relational.py:213-238)."""
+    return _operations.__binary_op(jnp.less, t1, t2)
+
+
+def ne(t1, t2):
+    """Elementwise != (reference relational.py:239-254)."""
+    return _operations.__binary_op(jnp.not_equal, t1, t2)

@@ -1,0 +1,98 @@
+"""Rounding, clipping, and sign-structure elementwise ops.
+
+Reference: heat/core/rounding.py:11-315 — all ``__local_op`` maps except
+``clip`` (ternary) and ``modf`` (two outputs).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax.numpy as jnp
+
+from . import _operations, types
+from .dndarray import DNDarray
+
+__all__ = ["abs", "absolute", "ceil", "clip", "fabs", "floor", "modf", "round", "sign", "trunc"]
+
+
+def abs(x, out=None, dtype=None):
+    """Elementwise absolute value (reference rounding.py:11-56)."""
+    if dtype is not None and not issubclass(types.canonical_heat_type(dtype), types.generic):
+        raise TypeError("dtype must be a heat data type")
+    result = _operations.__local_op(jnp.abs, x, out, no_cast=True)
+    if dtype is not None:
+        result = result.astype(types.canonical_heat_type(dtype), copy=False)
+    return result
+
+
+absolute = abs
+
+
+def fabs(x, out=None):
+    """Float absolute value, no int casting (reference rounding.py:57-86)."""
+    return _operations.__local_op(jnp.abs, x, out)
+
+
+def ceil(x, out=None):
+    """Ceiling (reference rounding.py:87-117)."""
+    return _operations.__local_op(jnp.ceil, x, out)
+
+
+def clip(a, a_min, a_max, out=None):
+    """Clamp values to [a_min, a_max] (reference rounding.py:118-156)."""
+    from .sanitation import sanitize_in
+
+    sanitize_in(a)
+    if a_min is None and a_max is None:
+        raise ValueError("either a_min or a_max must be set")
+
+    def _clip(arr):
+        return jnp.clip(arr, a_min, a_max)
+
+    return _operations.__local_op(_clip, a, out, no_cast=True)
+
+
+def floor(x, out=None):
+    """Floor (reference rounding.py:157-187)."""
+    return _operations.__local_op(jnp.floor, x, out)
+
+
+def modf(x, out=None) -> Tuple[DNDarray, DNDarray]:
+    """Split into fractional and integral parts (reference rounding.py:188-236)."""
+    from .sanitation import sanitize_in
+
+    sanitize_in(x)
+    frac, integ = jnp.modf(x.larray.astype(jnp.float32) if jnp.issubdtype(x.larray.dtype, jnp.integer) else x.larray)
+    dtype = types.canonical_heat_type(frac.dtype)
+    fr = DNDarray(x.comm.apply_sharding(frac, x.split), x.shape, dtype, x.split, x.device, x.comm, x.balanced)
+    it = DNDarray(x.comm.apply_sharding(integ, x.split), x.shape, dtype, x.split, x.device, x.comm, x.balanced)
+    if out is not None:
+        if not isinstance(out, tuple) or len(out) != 2:
+            raise TypeError("out must be a 2-tuple of DNDarrays")
+        out[0].larray = fr.larray
+        out[1].larray = it.larray
+        return out
+    return fr, it
+
+
+def round(x, decimals: int = 0, out=None, dtype=None):
+    """Round to ``decimals`` places (reference rounding.py:237-284)."""
+
+    def _round(arr):
+        return jnp.round(arr, decimals)
+
+    result = _operations.__local_op(_round, x, out)
+    if dtype is not None:
+        result = result.astype(types.canonical_heat_type(dtype), copy=False)
+    return result
+
+
+def sign(x, out=None):
+    """Elementwise sign (numpy-parity; reference provides via torch.sign)."""
+    return _operations.__local_op(jnp.sign, x, out, no_cast=True)
+
+
+def trunc(x, out=None):
+    """Truncate toward zero (reference rounding.py:285-315)."""
+    return _operations.__local_op(jnp.trunc, x, out)

@@ -1,0 +1,93 @@
+"""Input/output validation helpers for ops.
+
+Reference: heat/core/sanitation.py:24-180 (``sanitize_in``, ``sanitize_out``,
+``sanitize_in_tensor``, ``sanitize_sequence``, ``scalar_to_1d``).  The
+``out=`` semantics here rebind the output DNDarray's backing jax.Array
+(arrays are immutable in XLA), preserving the reference's user-visible
+contract: after ``ht.add(a, b, out=c)``, ``c`` holds the result with its own
+split/device checked for compatibility.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Sequence, Union
+
+import numpy as np
+import jax.numpy as jnp
+
+__all__ = [
+    "sanitize_in",
+    "sanitize_infinity",
+    "sanitize_in_tensor",
+    "sanitize_out",
+    "sanitize_sequence",
+    "scalar_to_1d",
+]
+
+
+def sanitize_in(x: Any) -> None:
+    """Verify ``x`` is a DNDarray (reference sanitation.py:24-40)."""
+    from .dndarray import DNDarray
+
+    if not isinstance(x, DNDarray):
+        raise TypeError(f"input needs to be a DNDarray, but was {type(x)}")
+
+
+def sanitize_in_tensor(x: Any) -> "jnp.ndarray":
+    """Coerce to a local jax array (reference sanitation.py helper)."""
+    from .dndarray import DNDarray
+
+    if isinstance(x, DNDarray):
+        return x.larray
+    return jnp.asarray(x)
+
+
+def sanitize_infinity(x) -> Union[int, float]:
+    """Largest representable value for ``x``'s dtype (used by norms/clip)."""
+    from . import types
+
+    dt = x.dtype if hasattr(x, "dtype") else types.heat_type_of(x)
+    dt = types.canonical_heat_type(dt)
+    if types.heat_type_is_exact(dt):
+        return types.iinfo(dt).max
+    return float("inf")
+
+
+def sanitize_out(out: Any, output_shape, output_split, output_device, output_comm=None) -> None:
+    """Validate an ``out=`` target against the result geometry
+    (reference sanitation.py:110-170)."""
+    from .dndarray import DNDarray
+
+    if not isinstance(out, DNDarray):
+        raise TypeError(f"expected out to be None or a DNDarray, but was {type(out)}")
+    if tuple(out.shape) != tuple(output_shape):
+        raise ValueError(f"Expecting output buffer of shape {tuple(output_shape)}, got {out.shape}")
+    if output_device is not None and out.device != output_device:
+        raise ValueError(f"Expecting output buffer on device {output_device}, got {out.device}")
+
+
+def sanitize_sequence(seq: Union[Sequence, "np.ndarray"]) -> List:
+    """Normalize a sequence-like to a python list (reference sanitation.py)."""
+    from .dndarray import DNDarray
+
+    if isinstance(seq, list):
+        return seq
+    if isinstance(seq, tuple):
+        return list(seq)
+    if isinstance(seq, np.ndarray):
+        return seq.tolist()
+    if isinstance(seq, DNDarray):
+        return np.asarray(seq.larray).tolist()
+    raise TypeError(f"seq must be a list, tuple, numpy.ndarray or DNDarray, got {type(seq)}")
+
+
+def scalar_to_1d(x):
+    """Turn a scalar DNDarray into a 1-element 1-D DNDarray
+    (reference sanitation.py:171-180)."""
+    from .dndarray import DNDarray
+
+    if x.ndim == 1:
+        return x
+    return DNDarray(
+        x.larray.reshape(1), (1,), x.dtype, split=None, device=x.device, comm=x.comm, balanced=True
+    )

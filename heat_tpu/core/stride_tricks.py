@@ -1,0 +1,87 @@
+"""Shape/axis normalization helpers shared by every op.
+
+Reference: heat/core/stride_tricks.py:5-192 (``broadcast_shape``,
+``sanitize_axis``, ``sanitize_shape``, ``sanitize_slice``).  Pure shape
+logic — identical semantics here; only the error messages and the numpy
+implementation differ.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple, Union
+
+import numpy as np
+
+__all__ = ["broadcast_shape", "sanitize_axis", "sanitize_shape", "sanitize_slice"]
+
+
+def broadcast_shape(shape_a: Sequence[int], shape_b: Sequence[int]) -> Tuple[int, ...]:
+    """NumPy-semantics broadcast of two shapes (reference stride_tricks.py:5-53).
+
+    Raises ValueError when the shapes are incompatible.
+    """
+    try:
+        return tuple(np.broadcast_shapes(tuple(shape_a), tuple(shape_b)))
+    except ValueError:
+        raise ValueError(
+            f"operands could not be broadcast, input shapes {tuple(shape_a)} {tuple(shape_b)}"
+        )
+
+
+def sanitize_axis(
+    shape: Sequence[int], axis: Union[int, None, Sequence[int]]
+) -> Union[int, None, Tuple[int, ...]]:
+    """Normalize (possibly negative, possibly multiple) axes against ``shape``
+    (reference stride_tricks.py:55-116)."""
+    ndim = len(shape)
+    if axis is None:
+        return None
+    if isinstance(axis, (list, tuple, np.ndarray)):
+        axes = tuple(int(a) for a in axis)
+        out = []
+        for a in axes:
+            if not isinstance(a, (int, np.integer)):
+                raise TypeError(f"axis must be None or int or tuple of ints, got {type(a)}")
+            if a < -ndim or a >= max(ndim, 1):
+                raise ValueError(f"axis {a} is out of bounds for {ndim}-dimensional shape")
+            out.append(a % ndim if ndim else 0)
+        if len(set(out)) != len(out):
+            raise ValueError("duplicate axes given")
+        return tuple(out)
+    if not isinstance(axis, (int, np.integer)):
+        raise TypeError(f"axis must be None or int or tuple of ints, got {type(axis)}")
+    axis = int(axis)
+    if ndim == 0 and axis in (-1, 0):
+        return None  # scalars ignore the axis (numpy semantics)
+    if axis < -ndim or axis >= ndim:
+        raise ValueError(f"axis {axis} is out of bounds for {ndim}-dimensional shape")
+    return axis % ndim
+
+
+def sanitize_shape(shape: Union[int, Sequence[int]], lval: int = 0) -> Tuple[int, ...]:
+    """Normalize a shape argument to a tuple of non-negative ints
+    (reference stride_tricks.py:118-161).  ``lval`` is the lowest legal
+    entry (0 by default)."""
+    if isinstance(shape, (int, np.integer)):
+        shape = (int(shape),)
+    elif isinstance(shape, (list, tuple, np.ndarray)):
+        shape = tuple(shape)
+    else:
+        raise TypeError(f"expected sequence object or single int, got {type(shape)}")
+    out = []
+    for s in shape:
+        if not isinstance(s, (int, np.integer)):
+            raise TypeError(f"expected int dimensions, got {type(s)}")
+        s = int(s)
+        if s < lval:
+            raise ValueError(f"negative dimensions are not allowed, got {s}")
+        out.append(s)
+    return tuple(out)
+
+
+def sanitize_slice(sl: slice, max_dim: int) -> slice:
+    """Resolve a slice against a dimension length into non-negative
+    start/stop/step (reference stride_tricks.py:163-192)."""
+    if not isinstance(sl, slice):
+        raise TypeError("can only be applied to slice objects")
+    return slice(*sl.indices(max_dim))

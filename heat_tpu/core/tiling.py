@@ -1,0 +1,162 @@
+"""Tile decompositions of sharded arrays.
+
+Reference: heat/core/tiling.py:9-1258 — ``SplitTiles`` (one tile per
+rank × split-slab, used by ``resplit_``) and ``SquareDiagTiles``
+(diagonal-aligned tiles driving the tiled QR).
+
+In the TPU design both consumers are gone: ``resplit`` is a single XLA
+reshard and QR is TSQR (see linalg/qr.py).  What remains useful — and what
+this module provides — is the *geometry*: a queryable map from mesh
+positions to global index ranges, used by IO, diagnostics, and tests.
+``SplitTiles`` is fully functional; ``SquareDiagTiles`` provides the
+diagonal-aligned tile grid geometry (without the QR-internal caching
+machinery the reference couples it to).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+__all__ = ["SplitTiles", "SquareDiagTiles"]
+
+
+class SplitTiles:
+    """One tile per (mesh position × split slab)
+    (reference tiling.py:9-302).
+
+    For an array split along one axis over ``size`` positions, the tile
+    grid is the cartesian product of each dimension's shard boundaries.
+    """
+
+    def __init__(self, arr):
+        self.__arr = arr
+        comm, shape = arr.comm, arr.shape
+        size = comm.size
+        # per-dimension cut points: the split axis uses the shard boundaries,
+        # other axes are a single slab (reference tile_ends_g, tiling.py:36-60)
+        ends = []
+        for dim, n in enumerate(shape):
+            if dim == arr.split:
+                cuts = []
+                for r in range(size):
+                    off, lshape, _ = comm.chunk(shape, dim, rank=r)
+                    cuts.append(off + lshape[dim])
+                ends.append(np.asarray(cuts, dtype=np.int64))
+            else:
+                ends.append(np.asarray([n], dtype=np.int64))
+        self.__tile_ends = ends
+
+    @property
+    def arr(self):
+        return self.__arr
+
+    @property
+    def tile_ends_g(self) -> List[np.ndarray]:
+        """Global end index of every tile along every dimension."""
+        return self.__tile_ends
+
+    @property
+    def tile_locations(self) -> np.ndarray:
+        """Owner mesh position of each tile along the split axis
+        (reference tiling.py:90-123)."""
+        arr = self.__arr
+        if arr.split is None:
+            return np.zeros(tuple(len(e) for e in self.__tile_ends), dtype=np.int64)
+        shape = tuple(len(e) for e in self.__tile_ends)
+        owners = np.zeros(shape, dtype=np.int64)
+        idx = [slice(None)] * len(shape)
+        for r in range(shape[arr.split]):
+            idx[arr.split] = r
+            owners[tuple(idx)] = r
+        return owners
+
+    def tile_slices(self, pos: Tuple[int, ...]) -> Tuple[slice, ...]:
+        """Global-coordinate slices of the tile at grid position ``pos``."""
+        slices = []
+        for dim, p in enumerate(pos):
+            ends = self.__tile_ends[dim]
+            start = 0 if p == 0 else int(ends[p - 1])
+            slices.append(slice(start, int(ends[p])))
+        return tuple(slices)
+
+    def __getitem__(self, key):
+        """The tile's data (a jax array view) at grid position ``key``
+        (reference tiling.py:160-302)."""
+        if isinstance(key, int):
+            key = (key,)
+        pos = list(key) + [0] * (len(self.__tile_ends) - len(key))
+        return self.__arr.larray[self.tile_slices(tuple(pos))]
+
+
+class SquareDiagTiles:
+    """Diagonal-aligned square tile grid (reference tiling.py:303-1258).
+
+    Computes the reference's width-matched row/column tile decomposition
+    where tiles along the global diagonal are square (``tiles_per_proc``
+    knob, reference :344).  The QR driver that consumed the caching/
+    match_tiles machinery is replaced by TSQR; the geometry remains for
+    introspection and for algorithms that want diagonal-aligned blocking.
+    """
+
+    def __init__(self, arr, tiles_per_proc: int = 1):
+        if arr.ndim != 2:
+            raise ValueError("SquareDiagTiles requires a 2-D DNDarray")
+        if tiles_per_proc < 1:
+            raise ValueError("tiles_per_proc must be >= 1")
+        self.__arr = arr
+        comm = arr.comm
+        size = comm.size
+        m, n = arr.shape
+        k = min(m, n)
+        # divide the diagonal extent into size * tiles_per_proc near-equal tiles
+        ntiles = max(size * tiles_per_proc, 1)
+        base = k // ntiles
+        rem = k % ntiles
+        widths = [base + (1 if i < rem else 0) for i in range(ntiles)]
+        widths = [w for w in widths if w > 0]
+        row_ends = list(np.cumsum(widths))
+        if row_ends and row_ends[-1] < m:
+            row_ends[-1] = m  # last row tile absorbs the overhang
+        col_ends = list(np.cumsum(widths))
+        if col_ends and col_ends[-1] < n:
+            col_ends[-1] = n
+        self.__row_ends = row_ends
+        self.__col_ends = col_ends
+        self.__tiles_per_proc = tiles_per_proc
+
+    @property
+    def arr(self):
+        return self.__arr
+
+    @property
+    def tiles_per_proc(self) -> int:
+        return self.__tiles_per_proc
+
+    @property
+    def row_indices(self) -> List[int]:
+        """Global start row of each tile row (reference :700-740)."""
+        return [0] + self.__row_ends[:-1]
+
+    @property
+    def col_indices(self) -> List[int]:
+        """Global start column of each tile column."""
+        return [0] + self.__col_ends[:-1]
+
+    def get_start_stop(self, key: Tuple[int, int]) -> Tuple[int, int, int, int]:
+        """(row_start, row_stop, col_start, col_stop) of tile ``key``
+        (reference tiling.py:810-930)."""
+        r, c = key
+        rs = 0 if r == 0 else self.__row_ends[r - 1]
+        cs = 0 if c == 0 else self.__col_ends[c - 1]
+        return int(rs), int(self.__row_ends[r]), int(cs), int(self.__col_ends[c])
+
+    def __getitem__(self, key) -> "np.ndarray":
+        """Tile data at (row, col) (reference local_get, tiling.py:933)."""
+        rs, re, cs, ce = self.get_start_stop(key)
+        return self.__arr.larray[rs:re, cs:ce]
+
+    def local_get(self, key):
+        """Alias of ``__getitem__`` (reference tiling.py:933-955)."""
+        return self[key]

@@ -1,0 +1,117 @@
+"""Trigonometric and hyperbolic elementwise maps.
+
+Reference: heat/core/trigonometrics.py:30-421 — all ``__local_op`` maps.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from . import _operations
+
+__all__ = [
+    "arccos",
+    "acos",
+    "arcsin",
+    "asin",
+    "arctan",
+    "atan",
+    "arctan2",
+    "atan2",
+    "cos",
+    "cosh",
+    "deg2rad",
+    "degrees",
+    "rad2deg",
+    "radians",
+    "sin",
+    "sinh",
+    "tan",
+    "tanh",
+]
+
+
+def arccos(x, out=None):
+    """Inverse cosine (reference trigonometrics.py:30-62)."""
+    return _operations.__local_op(jnp.arccos, x, out)
+
+
+acos = arccos
+
+
+def arcsin(x, out=None):
+    """Inverse sine (reference trigonometrics.py:63-95)."""
+    return _operations.__local_op(jnp.arcsin, x, out)
+
+
+asin = arcsin
+
+
+def arctan(x, out=None):
+    """Inverse tangent (reference trigonometrics.py:96-128)."""
+    return _operations.__local_op(jnp.arctan, x, out)
+
+
+atan = arctan
+
+
+def arctan2(t1, t2):
+    """Quadrant-aware inverse tangent of t1/t2
+    (reference trigonometrics.py:129-171)."""
+    from . import _operations as ops
+
+    def _atan2(a, b):
+        a = a.astype(jnp.float32) if jnp.issubdtype(a.dtype, jnp.integer) else a
+        b = b.astype(jnp.float32) if jnp.issubdtype(b.dtype, jnp.integer) else b
+        return jnp.arctan2(a, b)
+
+    return ops.__binary_op(_atan2, t1, t2)
+
+
+atan2 = arctan2
+
+
+def cos(x, out=None):
+    """Cosine (reference trigonometrics.py:172-204)."""
+    return _operations.__local_op(jnp.cos, x, out)
+
+
+def cosh(x, out=None):
+    """Hyperbolic cosine (reference trigonometrics.py:205-237)."""
+    return _operations.__local_op(jnp.cosh, x, out)
+
+
+def deg2rad(x, out=None):
+    """Degrees → radians (reference trigonometrics.py:238-262)."""
+    return _operations.__local_op(jnp.deg2rad, x, out)
+
+
+radians = deg2rad
+
+
+def rad2deg(x, out=None):
+    """Radians → degrees (reference trigonometrics.py:263-287)."""
+    return _operations.__local_op(jnp.rad2deg, x, out)
+
+
+degrees = rad2deg
+
+
+def sin(x, out=None):
+    """Sine (reference trigonometrics.py:288-320)."""
+    return _operations.__local_op(jnp.sin, x, out)
+
+
+def sinh(x, out=None):
+    """Hyperbolic sine (reference trigonometrics.py:321-353)."""
+    return _operations.__local_op(jnp.sinh, x, out)
+
+
+def tan(x, out=None):
+    """Tangent (reference trigonometrics.py:354-387)."""
+    return _operations.__local_op(jnp.tan, x, out)
+
+
+def tanh(x, out=None):
+    """Hyperbolic tangent (reference trigonometrics.py:388-421)."""
+    return _operations.__local_op(jnp.tanh, x, out)

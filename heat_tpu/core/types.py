@@ -1,0 +1,417 @@
+"""NumPy-style dtype hierarchy for heat_tpu.
+
+Reference: heat/core/types.py:62-688 — a class hierarchy
+``generic → bool/number → integer/floating → concrete dtypes`` where each
+concrete class is *callable as a cast* (``ht.float32(x)`` converts ``x``),
+plus ``canonical_heat_type`` / ``heat_type_of`` normalization,
+``promote_types`` over an explicit lattice, ``can_cast`` with the default
+"intuitive" rule, and ``finfo``/``iinfo``.
+
+TPU-first deltas from the reference:
+
+* concrete dtypes map to **JAX dtypes** rather than torch dtypes;
+* ``bfloat16`` and ``float16`` are first-class (bfloat16 is the native MXU
+  input type — the single most important dtype on TPU; the reference has
+  neither);
+* promotion delegates to JAX's type-promotion lattice
+  (``jnp.promote_types``), which matches the torch-style semantics the
+  reference implements by hand (int32 + float32 → float32, not numpy's
+  float64);
+* ``float64``/``int64`` exist because heat_tpu enables ``jax_enable_x64``;
+  on real TPU hardware float64 is software-emulated and should be avoided in
+  hot paths (defaults everywhere are float32, as in the reference).
+"""
+
+from __future__ import annotations
+
+import builtins
+import numbers
+from typing import Any, Tuple, Union
+
+import numpy as np
+
+import jax.numpy as jnp
+
+__all__ = [
+    "generic",
+    "number",
+    "integer",
+    "signedinteger",
+    "unsignedinteger",
+    "floating",
+    "bool",
+    "bool_",
+    "uint8",
+    "int8",
+    "byte",
+    "int16",
+    "short",
+    "int32",
+    "int",
+    "int64",
+    "long",
+    "float16",
+    "half",
+    "bfloat16",
+    "float32",
+    "float",
+    "float_",
+    "float64",
+    "double",
+    "flexible",
+    "canonical_heat_type",
+    "heat_type_of",
+    "heat_type_is_exact",
+    "heat_type_is_inexact",
+    "issubdtype",
+    "can_cast",
+    "promote_types",
+    "result_type",
+    "finfo",
+    "iinfo",
+]
+
+
+class generic:
+    """Root of the dtype hierarchy (reference types.py:62-150).
+
+    Calling a concrete subclass casts its argument:
+    ``ht.float32([1, 2])`` → a float32 DNDarray (reference behavior of every
+    dtype class's ``__new__``).
+    """
+
+    _jax_type = None  # concrete classes override
+    _np_type = None
+
+    def __new__(cls, *value, device=None, comm=None):
+        if cls._jax_type is None:
+            raise TypeError(f"cannot create '{cls.__name__}' instances — abstract dtype")
+        from . import factories
+
+        if len(value) == 0:
+            value = (0,)
+        if len(value) == 1:
+            value = value[0]
+        return factories.array(value, dtype=cls, device=device, comm=comm)
+
+    @classmethod
+    def jax_type(cls):
+        """The backing jax/numpy dtype (the analog of the reference's
+        ``torch_type``, types.py:160)."""
+        if cls._jax_type is None:
+            raise TypeError(f"abstract dtype '{cls.__name__}' has no jax type")
+        return cls._jax_type
+
+    @classmethod
+    def char(cls) -> str:
+        return np.dtype(cls._np_type).char if cls._np_type is not None else "?"
+
+
+class bool(generic):  # noqa: A001 — mirrors the reference's shadowing (types.py:152)
+    _jax_type = jnp.bool_
+    _np_type = np.bool_
+
+
+bool_ = bool
+
+
+class number(generic):
+    pass
+
+
+class integer(number):
+    pass
+
+
+class signedinteger(integer):
+    pass
+
+
+class unsignedinteger(integer):
+    pass
+
+
+class floating(number):
+    pass
+
+
+class flexible(generic):
+    """Placeholder branch kept for hierarchy parity (reference types.py:208)."""
+
+
+class uint8(unsignedinteger):
+    _jax_type = jnp.uint8
+    _np_type = np.uint8
+
+
+class int8(signedinteger):
+    _jax_type = jnp.int8
+    _np_type = np.int8
+
+
+class int16(signedinteger):
+    _jax_type = jnp.int16
+    _np_type = np.int16
+
+
+class int32(signedinteger):
+    _jax_type = jnp.int32
+    _np_type = np.int32
+
+
+class int64(signedinteger):
+    _jax_type = jnp.int64
+    _np_type = np.int64
+
+
+class float16(floating):
+    _jax_type = jnp.float16
+    _np_type = np.float16
+
+
+class bfloat16(floating):
+    """TPU-native 16-bit float (8-bit exponent).  Not in the reference —
+    added because it is the canonical MXU input type."""
+
+    _jax_type = jnp.bfloat16
+    _np_type = jnp.bfloat16  # ml_dtypes-backed numpy scalar type
+
+
+class float32(floating):
+    _jax_type = jnp.float32
+    _np_type = np.float32
+
+
+class float64(floating):
+    _jax_type = jnp.float64
+    _np_type = np.float64
+
+
+# aliases (reference types.py:211-240)
+byte = int8
+short = int16
+int = int32  # noqa: A001
+long = int64
+half = float16
+float = float32  # noqa: A001
+float_ = float32
+double = float64
+
+
+_CONCRETE: Tuple[type, ...] = (
+    bool,
+    uint8,
+    int8,
+    int16,
+    int32,
+    int64,
+    float16,
+    bfloat16,
+    float32,
+    float64,
+)
+
+# jax/numpy dtype → heat type
+__dtype_map = {np.dtype(c._np_type): c for c in _CONCRETE}
+__name_map = {c.__name__: c for c in _CONCRETE}
+__name_map.update(
+    {
+        "byte": int8,
+        "short": int16,
+        "int": int32,
+        "long": int64,
+        "half": float16,
+        "float": float32,
+        "double": float64,
+        "bool_": bool,
+        "b": bool,
+        "u1": uint8,
+        "i1": int8,
+        "i2": int16,
+        "i4": int32,
+        "i8": int64,
+        "f2": float16,
+        "f4": float32,
+        "f8": float64,
+    }
+)
+
+
+def canonical_heat_type(a_type: Any) -> type:
+    """Normalize python/numpy/jax/string types to the heat class
+    (reference types.py:275-340)."""
+    if isinstance(a_type, type) and issubclass(a_type, generic):
+        if a_type._jax_type is None:
+            raise TypeError(f"data type {a_type!r} is abstract and cannot back an array")
+        return a_type
+    if a_type is builtins.bool:
+        return bool
+    if a_type is builtins.int:
+        return int32
+    if a_type is builtins.float:
+        return float32
+    if isinstance(a_type, str):
+        key = a_type.strip().lower()
+        if key in __name_map:
+            return __name_map[key]
+        try:
+            return __dtype_map[np.dtype(key)]
+        except (TypeError, KeyError):
+            raise TypeError(f"data type {a_type!r} not understood")
+    try:
+        return __dtype_map[np.dtype(a_type)]
+    except (TypeError, KeyError):
+        raise TypeError(f"data type {a_type!r} not understood")
+
+
+def heat_type_of(obj: Any) -> type:
+    """Infer the heat type of an array-like / scalar / iterable
+    (reference types.py:343-441)."""
+    from .dndarray import DNDarray
+
+    if isinstance(obj, DNDarray):
+        return obj.dtype
+    if isinstance(obj, (jnp.ndarray, np.ndarray)) or hasattr(obj, "dtype"):
+        return canonical_heat_type(obj.dtype)
+    if isinstance(obj, builtins.bool):
+        return bool
+    if isinstance(obj, numbers.Integral):
+        return int32
+    if isinstance(obj, numbers.Real):
+        return float32
+    if isinstance(obj, (list, tuple)):
+        return canonical_heat_type(np.asarray(obj).dtype)
+    raise TypeError(f"cannot determine heat type of {type(obj)}")
+
+
+def heat_type_is_exact(a_type: Any) -> builtins.bool:
+    """True for integer/bool types (reference types.py helper)."""
+    t = canonical_heat_type(a_type)
+    return issubclass(t, integer) or t is bool
+
+
+def heat_type_is_inexact(a_type: Any) -> builtins.bool:
+    """True for floating types."""
+    return issubclass(canonical_heat_type(a_type), floating)
+
+
+def issubdtype(arg1: Any, arg2: type) -> builtins.bool:
+    """Hierarchy test, e.g. ``ht.issubdtype(ht.int32, ht.integer)``."""
+    try:
+        t1 = canonical_heat_type(arg1)
+    except TypeError:
+        t1 = arg1
+    if not (isinstance(t1, type) and issubclass(t1, generic)):
+        raise TypeError(f"{arg1!r} is not a heat type")
+    return issubclass(t1, arg2)
+
+
+# ---------------------------------------------------------------------- #
+# casting / promotion (reference types.py:444-576)                        #
+# ---------------------------------------------------------------------- #
+def __width(t: type) -> builtins.int:
+    return np.dtype(t._np_type).itemsize * 8
+
+
+def can_cast(from_: Any, to: Any, casting: str = "intuitive") -> builtins.bool:
+    """Casting admissibility (reference types.py:444-539).
+
+    Rules: ``'no'``, ``'safe'``, ``'same_kind'``, ``'unsafe'`` follow numpy;
+    the default ``'intuitive'`` = safe **plus** integer→floating of at least
+    the same bit width (e.g. int32→float32), matching the reference's
+    default rule.
+    """
+    if not isinstance(from_, type):
+        from_ = heat_type_of(from_)
+    src = canonical_heat_type(from_)
+    dst = canonical_heat_type(to)
+    if casting == "no":
+        return src is dst
+    if casting == "unsafe":
+        return True
+    s_np, d_np = np.dtype(src._np_type), np.dtype(dst._np_type)
+    if casting == "same_kind":
+        if src is bfloat16 or dst is bfloat16:
+            return issubclass(dst, floating) or casting == "unsafe"
+        return np.can_cast(s_np, d_np, casting="same_kind")
+    # safe / intuitive
+    if src is bfloat16:
+        safe = dst in (bfloat16, float32, float64)
+    elif dst is bfloat16:
+        # bf16 has 8 mantissa bits → represents all integers only up to 256
+        safe = src in (bool, uint8, int8)
+    else:
+        safe = np.can_cast(s_np, d_np, casting="safe")
+    if safe or casting == "safe":
+        return safe
+    if casting == "intuitive":
+        if (issubclass(src, integer) or src is bool) and issubclass(dst, floating):
+            return __width(dst) >= min(__width(src), 32) or dst in (float32, float64)
+        return False
+    raise ValueError(f"invalid casting rule {casting!r}")
+
+
+def promote_types(type1: Any, type2: Any) -> type:
+    """Smallest type both inputs safely cast to (reference types.py:542-574).
+
+    Delegates to JAX's promotion lattice, which reproduces the
+    torch-flavored semantics the reference tabulates by hand
+    (int + float32 → float32) and extends it to bfloat16.
+    """
+    t1 = canonical_heat_type(type1)
+    t2 = canonical_heat_type(type2)
+    return canonical_heat_type(jnp.promote_types(t1._jax_type, t2._jax_type))
+
+
+def result_type(*operands) -> type:
+    """Promoted type over arbitrarily many operands/scalars (numpy-parity
+    helper used throughout the op engine)."""
+    t = None
+    for op in operands:
+        ot = op if isinstance(op, type) and issubclass(op, generic) else heat_type_of(op)
+        t = ot if t is None else promote_types(t, ot)
+    return t
+
+
+# ---------------------------------------------------------------------- #
+# finfo / iinfo (reference types.py:577-688)                              #
+# ---------------------------------------------------------------------- #
+class finfo:
+    """Machine limits for floating types (reference types.py:577-634)."""
+
+    def __new__(cls, dtype):
+        t = canonical_heat_type(dtype)
+        if not issubclass(t, floating):
+            raise TypeError(f"data type {t.__name__} not inexact")
+        info = jnp.finfo(t._jax_type)
+        obj = object.__new__(cls)
+        obj.bits = info.bits
+        obj.eps = builtins.float(info.eps)
+        obj.max = builtins.float(info.max)
+        obj.min = builtins.float(info.min)
+        obj.tiny = builtins.float(info.tiny)
+        obj.dtype = t
+        return obj
+
+    def __repr__(self):
+        return f"finfo(dtype={self.dtype.__name__}, eps={self.eps}, max={self.max})"
+
+
+class iinfo:
+    """Machine limits for integer types (reference types.py:637-688)."""
+
+    def __new__(cls, dtype):
+        t = canonical_heat_type(dtype)
+        if not (issubclass(t, integer) or t is bool):
+            raise TypeError(f"data type {t.__name__} not an integer type")
+        info = jnp.iinfo(t._jax_type) if t is not bool else None
+        obj = object.__new__(cls)
+        if t is bool:
+            obj.bits, obj.min, obj.max = 8, 0, 1
+        else:
+            obj.bits, obj.min, obj.max = info.bits, builtins.int(info.min), builtins.int(info.max)
+        obj.dtype = t
+        return obj
+
+    def __repr__(self):
+        return f"iinfo(dtype={self.dtype.__name__}, min={self.min}, max={self.max})"

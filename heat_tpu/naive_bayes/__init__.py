@@ -1,0 +1,1 @@
+"""heat_tpu.naive_bayes"""

@@ -1,0 +1,19 @@
+"""Version information for heat_tpu.
+
+Mirrors the role of the reference's heat/core/version.py:1-4 (HeAT 0.5.1);
+this framework versions independently.
+"""
+
+major: int = 0
+"""Major version number."""
+minor: int = 1
+"""Minor version number."""
+micro: int = 0
+"""Micro (patch) version number."""
+extension: str = None
+"""Version extension tag (e.g. dev/rc); None for releases."""
+
+if not extension:
+    __version__ = "{}.{}.{}".format(major, minor, micro)
+else:
+    __version__ = "{}.{}.{}-{}".format(major, minor, micro, extension)

@@ -1,0 +1,17 @@
+"""Test configuration: run the whole suite on a virtual 8-device CPU mesh.
+
+Mirrors the reference's test strategy (SURVEY.md §4): the reference executes
+one unittest suite under mpirun -np {1,2,4,7}; here the same effect comes
+from XLA host-platform device multiplication — every test sees an 8-device
+mesh, and split/replicated paths exercise real (CPU-emulated) collectives.
+Set HEAT_TEST_DEVICES to change the mesh size (e.g. 1 or 7 for the
+uneven-chunk edge cases the reference probes with -np 7).
+"""
+
+import os
+
+import jax
+
+# must run before any jax computation
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", int(os.environ.get("HEAT_TEST_DEVICES", "8")))

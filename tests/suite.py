@@ -1,0 +1,71 @@
+"""Shared test utilities: numpy-oracle comparison helpers.
+
+Mirrors the reference's test_suites/basic_test.py:12-170 —
+``assert_array_equal`` validates both the global value and the shard
+geometry; ``assert_func_equal`` sweeps a function over every dtype × split
+combination against a numpy oracle.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import heat_tpu as ht
+
+SPLITS = (None, 0)
+FLOAT_TYPES = (ht.float32, ht.float64)
+INT_TYPES = (ht.int32, ht.int64)
+ALL_TYPES = FLOAT_TYPES + INT_TYPES
+
+
+def assert_array_equal(heat_array: ht.DNDarray, expected, rtol=1e-5, atol=1e-8):
+    """Verify global value + metadata consistency
+    (reference basic_test.py:68-140)."""
+    expected = np.asarray(expected)
+    assert isinstance(heat_array, ht.DNDarray), f"not a DNDarray: {type(heat_array)}"
+    assert tuple(heat_array.shape) == tuple(expected.shape), (
+        f"global shape {heat_array.shape} != expected {expected.shape}"
+    )
+    got = heat_array.numpy()
+    if expected.dtype.kind in "fc":
+        np.testing.assert_allclose(got.astype(np.float64), expected.astype(np.float64), rtol=rtol, atol=atol)
+    else:
+        np.testing.assert_array_equal(got, expected)
+    # shard geometry: lshape_map must tile the global shape along split
+    if heat_array.split is not None:
+        lmap = heat_array.lshape_map
+        assert lmap[:, heat_array.split].sum() == heat_array.shape[heat_array.split]
+
+
+def assert_func_equal(
+    shape,
+    heat_func,
+    numpy_func,
+    heat_args=None,
+    numpy_args=None,
+    dtypes=FLOAT_TYPES,
+    splits=SPLITS,
+    low=-100,
+    high=100,
+    rtol=1e-5,
+    atol=1e-6,
+):
+    """Sweep dtype × split against a numpy oracle
+    (reference basic_test.py:141-170)."""
+    heat_args = heat_args or {}
+    numpy_args = numpy_args or {}
+    rng = np.random.default_rng(42)
+    for dtype in dtypes:
+        npdt = np.dtype(dtype._np_type)
+        if npdt.kind == "f":
+            data = rng.uniform(low, high, size=shape).astype(npdt)
+        else:
+            data = rng.integers(low, high, size=shape).astype(npdt)
+        expected = numpy_func(data, **numpy_args)
+        for split in splits:
+            x = ht.array(data, split=split)
+            result = heat_func(x, **heat_args)
+            if isinstance(result, ht.DNDarray):
+                assert_array_equal(result, expected, rtol=rtol, atol=atol)
+            else:
+                np.testing.assert_allclose(result, expected, rtol=rtol, atol=atol)

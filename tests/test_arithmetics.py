@@ -1,0 +1,133 @@
+"""Arithmetic op tests vs numpy oracle across dtypes × splits
+(reference: heat/core/tests/test_arithmetics.py)."""
+
+import numpy as np
+import pytest
+
+import heat_tpu as ht
+
+from suite import assert_array_equal, assert_func_equal, ALL_TYPES
+
+
+def _pairs(split):
+    a = np.arange(1, 25, dtype=np.float32).reshape(6, 4)
+    b = np.arange(24, 0, -1, dtype=np.float32).reshape(6, 4)
+    return ht.array(a, split=split), ht.array(b, split=split), a, b
+
+
+@pytest.mark.parametrize("split", [None, 0, 1])
+def test_binary_ops(split):
+    x, y, a, b = _pairs(split)
+    assert_array_equal(x + y, a + b)
+    assert_array_equal(x - y, a - b)
+    assert_array_equal(x * y, a * b)
+    assert_array_equal(x / y, a / b)
+    assert_array_equal(x // y, a // b)
+    assert_array_equal(x % y, a % b)
+    assert_array_equal(x**2, a**2)
+    assert (x + y).split == split
+
+
+def test_scalar_ops():
+    x = ht.arange(5, dtype=ht.float32, split=0)
+    a = np.arange(5, dtype=np.float32)
+    assert_array_equal(x + 2, a + 2)
+    assert_array_equal(2 + x, 2 + a)
+    assert_array_equal(2 - x, 2 - a)
+    assert_array_equal(x * 3, a * 3)
+    assert_array_equal(1 / (x + 1), 1 / (a + 1))
+    assert_array_equal(-x, -a)
+    assert_array_equal(abs(-x), a)
+
+
+def test_mixed_split_autoresplit():
+    # improvement over the reference (raises NotImplementedError there)
+    a = np.arange(16, dtype=np.float32).reshape(4, 4)
+    x0 = ht.array(a, split=0)
+    x1 = ht.array(a, split=1)
+    assert_array_equal(x0 + x1, a + a)
+
+
+def test_broadcasting():
+    a = np.arange(12, dtype=np.float32).reshape(4, 3)
+    v = np.arange(3, dtype=np.float32)
+    x = ht.array(a, split=0)
+    w = ht.array(v)
+    assert_array_equal(x + w, a + v)
+    assert_array_equal(x * w, a * v)
+
+
+def test_bitwise():
+    a = np.array([0b1100, 0b1010], dtype=np.int32)
+    b = np.array([0b1010, 0b0110], dtype=np.int32)
+    x, y = ht.array(a, split=0), ht.array(b, split=0)
+    assert_array_equal(x & y, a & b)
+    assert_array_equal(x | y, a | b)
+    assert_array_equal(x ^ y, a ^ b)
+    assert_array_equal(~x, ~a)
+    assert_array_equal(x << 1, a << 1)
+    assert_array_equal(x >> 1, a >> 1)
+    with pytest.raises(TypeError):
+        ht.bitwise_and(ht.ones(3), ht.ones(3))
+    with pytest.raises(TypeError):
+        ht.invert(ht.ones(3))
+
+
+def test_inplace():
+    x = ht.arange(4, dtype=ht.float32, split=0)
+    x += 1
+    np.testing.assert_array_equal(x.numpy(), [1, 2, 3, 4])
+
+
+def test_sum_prod():
+    assert_func_equal((5, 6), ht.sum, np.sum, dtypes=ALL_TYPES, rtol=1e-4)
+    assert_func_equal((5, 6), ht.prod, np.prod, low=1, high=2, rtol=1e-4)
+    a = np.arange(24, dtype=np.float32).reshape(4, 6)
+    for split in (None, 0, 1):
+        x = ht.array(a, split=split)
+        assert_array_equal(x.sum(axis=0), a.sum(axis=0))
+        assert_array_equal(x.sum(axis=1), a.sum(axis=1))
+        assert_array_equal(x.sum(axis=(0, 1)), a.sum(axis=(0, 1)))
+        assert_array_equal(ht.sum(x, axis=0, keepdims=True), a.sum(axis=0, keepdims=True))
+    # split bookkeeping
+    x = ht.array(a, split=1)
+    assert x.sum(axis=0).split == 0
+    assert x.sum(axis=1).split is None
+
+
+def test_cumsum_cumprod():
+    a = np.arange(1, 13, dtype=np.float32).reshape(3, 4)
+    for split in (None, 0, 1):
+        x = ht.array(a, split=split)
+        assert_array_equal(ht.cumsum(x, 0), np.cumsum(a, 0))
+        assert_array_equal(ht.cumsum(x, 1), np.cumsum(a, 1))
+        assert_array_equal(ht.cumprod(x, 0), np.cumprod(a, 0))
+
+
+def test_diff():
+    a = np.array([1.0, 4.0, 9.0, 16.0, 25.0], dtype=np.float32)
+    x = ht.array(a, split=0)
+    assert_array_equal(ht.diff(x), np.diff(a))
+    assert_array_equal(ht.diff(x, n=2), np.diff(a, n=2))
+    m = np.arange(12, dtype=np.float32).reshape(3, 4) ** 2
+    xm = ht.array(m, split=0)
+    assert_array_equal(ht.diff(xm, axis=0), np.diff(m, axis=0))
+    assert_array_equal(ht.diff(xm, axis=1), np.diff(m, axis=1))
+    with pytest.raises(ValueError):
+        ht.diff(x, n=-1)
+
+
+def test_out_param():
+    x = ht.arange(4, dtype=ht.float32)
+    out = ht.zeros(4)
+    res = ht.add(x, x, out=out)
+    assert res is out
+    np.testing.assert_array_equal(out.numpy(), [0, 2, 4, 6])
+
+
+def test_fmod_mod():
+    a = np.array([-3.5, 2.5, 7.0], dtype=np.float32)
+    b = np.array([2.0, 2.0, 3.0], dtype=np.float32)
+    x, y = ht.array(a), ht.array(b)
+    assert_array_equal(ht.fmod(x, y), np.fmod(a, b))
+    assert_array_equal(ht.mod(x, y), np.mod(a, b))

@@ -1,0 +1,145 @@
+"""DNDarray container tests (reference: heat/core/tests/test_dndarray.py)."""
+
+import numpy as np
+import pytest
+
+import heat_tpu as ht
+
+from suite import assert_array_equal
+
+
+def test_metadata():
+    x = ht.zeros((8, 6), split=0)
+    assert x.shape == (8, 6)
+    assert x.gshape == (8, 6)
+    assert x.ndim == 2
+    assert x.size == 48
+    assert x.split == 0
+    assert x.dtype is ht.float32
+    assert x.itemsize == 4
+    assert x.nbytes == 48 * 4
+    assert x.balanced
+    assert x.is_balanced()
+    size = x.comm.size
+    assert x.lshape[0] == -(-8 // size)
+    assert x.lshape_map[:, 0].sum() == 8
+
+
+def test_strides():
+    x = ht.zeros((4, 3, 2))
+    assert x.stride == (6, 2, 1)
+    assert x.strides == (24, 8, 4)
+
+
+def test_astype():
+    x = ht.arange(6, split=0)
+    y = x.astype(ht.float64)
+    assert y.dtype is ht.float64
+    assert x.dtype is ht.int32  # copy semantics
+    z = x.astype(ht.float32, copy=False)
+    assert z is x
+    assert x.dtype is ht.float32
+
+
+def test_item_and_scalars():
+    x = ht.array([42])
+    assert x.item() == 42
+    assert int(x) == 42
+    assert float(x) == 42.0
+    assert bool(ht.array([1]))
+    with pytest.raises(ValueError):
+        ht.ones((3,)).item()
+
+
+def test_len_iter():
+    x = ht.arange(5, split=0)
+    assert len(x) == 5
+    vals = [int(v.item()) for v in x]
+    assert vals == [0, 1, 2, 3, 4]
+
+
+def test_getitem_basic():
+    data = np.arange(24).reshape(6, 4)
+    x = ht.array(data, split=0)
+    assert x[0, 0].item() == 0
+    assert_array_equal(x[2], data[2])
+    assert_array_equal(x[1:4], data[1:4])
+    assert_array_equal(x[:, 1], data[:, 1])
+    assert_array_equal(x[1:4, 2:], data[1:4, 2:])
+    assert x[1:4].split == 0
+
+
+def test_getitem_advanced():
+    data = np.arange(24).reshape(6, 4)
+    x = ht.array(data, split=0)
+    idx = ht.array([0, 2, 4])
+    assert_array_equal(x[idx], data[[0, 2, 4]])
+    mask = data[:, 0] > 8
+    assert_array_equal(x[ht.array(mask)], data[mask])
+
+
+def test_setitem():
+    data = np.arange(12).reshape(4, 3).astype(np.float32)
+    x = ht.array(data, split=0)
+    x[0, 0] = 99
+    assert x[0, 0].item() == 99
+    x[1] = np.zeros(3)
+    np.testing.assert_array_equal(x.numpy()[1], 0)
+    x[2:4, 1] = 7
+    np.testing.assert_array_equal(x.numpy()[2:4, 1], 7)
+
+
+def test_lloc():
+    x = ht.arange(6, dtype=ht.float32, split=0)
+    assert x.lloc[2].item() == 2.0
+    x.lloc[2] = 10.0
+    assert x[2].item() == 10.0
+
+
+def test_fill_diagonal():
+    x = ht.zeros((4, 4), split=0)
+    x.fill_diagonal(5.0)
+    np.testing.assert_array_equal(x.numpy(), np.eye(4) * 5)
+
+
+def test_halo():
+    size = ht.core.communication.get_comm().size
+    x = ht.arange(size * 4, dtype=ht.float32, split=0)
+    x.get_halo(1)
+    if size > 1:
+        assert x.halo_prev is not None
+    x2 = ht.arange(8)
+    x2.get_halo(1)
+    assert x2.halo_prev is None  # replicated: no halos
+    with pytest.raises(TypeError):
+        x.get_halo("no")
+    with pytest.raises(ValueError):
+        x.get_halo(-1)
+
+
+def test_numpy_protocol():
+    x = ht.arange(5, split=0)
+    arr = np.asarray(x)
+    np.testing.assert_array_equal(arr, np.arange(5))
+    assert x.tolist() == [0, 1, 2, 3, 4]
+
+
+def test_resplit_roundtrip():
+    x = ht.random.randn(8, 8, split=0)
+    ref = x.numpy()
+    y = x.resplit(1)
+    assert y.split == 1
+    np.testing.assert_allclose(y.numpy(), ref)
+
+
+def test_redistribute_noop():
+    x = ht.arange(8, split=0)
+    x.redistribute_()  # silently accepted
+    x.balance_()
+    assert x.balanced
+
+
+def test_to_device():
+    x = ht.arange(4, split=0)
+    y = x.to_device("cpu")
+    np.testing.assert_array_equal(y.numpy(), x.numpy())

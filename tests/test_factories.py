@@ -1,0 +1,120 @@
+"""Factory tests (reference: heat/core/tests/test_factories.py)."""
+
+import numpy as np
+import pytest
+
+import heat_tpu as ht
+
+from suite import assert_array_equal
+
+
+def test_array_from_list():
+    x = ht.array([[1, 2], [3, 4]])
+    assert x.dtype is ht.int32
+    assert x.shape == (2, 2)
+    assert x.split is None
+
+
+def test_array_split():
+    x = ht.array(np.arange(16).reshape(8, 2), split=0)
+    assert x.split == 0
+    assert_array_equal(x, np.arange(16).reshape(8, 2))
+    y = ht.array(np.arange(16).reshape(2, 8), split=1)
+    assert y.split == 1
+
+
+def test_array_dtype_conversion():
+    x = ht.array([1.5, 2.5], dtype=ht.int32)
+    np.testing.assert_array_equal(x.numpy(), [1, 2])
+    y = ht.array([1, 2], dtype=ht.float64)
+    assert y.dtype is ht.float64
+
+
+def test_array_python_float_default():
+    # python floats default to float32 (reference factories.py:240-260)
+    x = ht.array([1.0, 2.0])
+    assert x.dtype is ht.float32
+    # numpy float64 data keeps float64
+    y = ht.array(np.array([1.0, 2.0]))
+    assert y.dtype is ht.float64
+
+
+def test_array_is_split():
+    size = ht.core.communication.get_comm().size
+    pieces = [np.full((2, 3), r, dtype=np.float32) for r in range(size)]
+    x = ht.array(pieces, is_split=0)
+    assert x.shape == (2 * size, 3)
+    assert x.split == 0
+    with pytest.raises(ValueError):
+        ht.array([1, 2], split=0, is_split=0)
+
+
+def test_array_ndmin():
+    x = ht.array([1, 2, 3], ndmin=3)
+    assert x.shape == (1, 1, 3)
+
+
+def test_array_from_dndarray():
+    x = ht.arange(4, split=0)
+    y = ht.array(x)
+    assert y.split == 0
+    np.testing.assert_array_equal(y.numpy(), x.numpy())
+
+
+def test_arange():
+    assert_array_equal(ht.arange(10), np.arange(10))
+    assert_array_equal(ht.arange(2, 10, 2, split=0), np.arange(2, 10, 2))
+    assert ht.arange(5).dtype is ht.int32
+    assert ht.arange(5.0).dtype is ht.float32
+    assert ht.arange(5, dtype=ht.float64).dtype is ht.float64
+    with pytest.raises(TypeError):
+        ht.arange(1, 2, 3, 4)
+
+
+def test_linspace():
+    assert_array_equal(ht.linspace(0, 10, 11), np.linspace(0, 10, 11))
+    x, step = ht.linspace(0, 1, 5, retstep=True)
+    assert abs(step - 0.25) < 1e-6
+    assert_array_equal(ht.linspace(0, 10, 11, endpoint=False),
+                       np.linspace(0, 10, 11, endpoint=False).astype(np.float32), rtol=1e-6)
+    with pytest.raises(ValueError):
+        ht.linspace(0, 1, 0)
+
+
+def test_logspace():
+    assert_array_equal(ht.logspace(0, 3, 4), np.logspace(0, 3, 4), rtol=1e-5)
+
+
+def test_zeros_ones_full_empty():
+    assert_array_equal(ht.zeros((3, 4), split=0), np.zeros((3, 4)))
+    assert_array_equal(ht.ones((3, 4), split=1), np.ones((3, 4)))
+    assert_array_equal(ht.full((2, 2), 7.0), np.full((2, 2), 7.0))
+    e = ht.empty((4, 2), split=0)
+    assert e.shape == (4, 2)
+    with pytest.raises(ValueError):
+        ht.zeros((-1, 3))
+    with pytest.raises(TypeError):
+        ht.zeros("bad")
+
+
+def test_like_factories():
+    x = ht.ones((4, 3), dtype=ht.int64, split=0)
+    z = ht.zeros_like(x)
+    assert z.shape == (4, 3) and z.dtype is ht.int64 and z.split == 0
+    o = ht.ones_like(x, dtype=ht.float32)
+    assert o.dtype is ht.float32
+    f = ht.full_like(x, 9, dtype=ht.int64)
+    assert f[0, 0].item() == 9
+    e = ht.empty_like(x)
+    assert e.shape == (4, 3)
+
+
+def test_eye():
+    assert_array_equal(ht.eye(4), np.eye(4))
+    assert_array_equal(ht.eye((3, 5), split=0), np.eye(3, 5))
+    assert ht.eye(4, dtype=ht.int32).dtype is ht.int32
+
+
+def test_asarray():
+    x = ht.ones(3)
+    assert ht.asarray(x) is x

@@ -1,0 +1,79 @@
+"""Type-system tests (reference: heat/core/tests/test_types.py)."""
+
+import numpy as np
+import pytest
+
+import heat_tpu as ht
+
+
+def test_canonical_heat_type():
+    assert ht.core.types.canonical_heat_type(ht.float32) is ht.float32
+    assert ht.core.types.canonical_heat_type("float32") is ht.float32
+    assert ht.core.types.canonical_heat_type(float) is ht.float32
+    assert ht.core.types.canonical_heat_type(int) is ht.int32
+    assert ht.core.types.canonical_heat_type(bool) is ht.bool
+    assert ht.core.types.canonical_heat_type(np.float64) is ht.float64
+    assert ht.core.types.canonical_heat_type("i8") is ht.int64
+    with pytest.raises(TypeError):
+        ht.core.types.canonical_heat_type("no_such_type")
+    with pytest.raises(TypeError):
+        ht.core.types.canonical_heat_type(ht.core.types.floating)
+
+
+def test_heat_type_of():
+    assert ht.core.types.heat_type_of(1) is ht.int32
+    assert ht.core.types.heat_type_of(1.0) is ht.float32
+    assert ht.core.types.heat_type_of(True) is ht.bool
+    assert ht.core.types.heat_type_of(np.zeros(3, dtype=np.int16)) is ht.int16
+    assert ht.core.types.heat_type_of(ht.ones(3)) is ht.float32
+
+
+def test_type_hierarchy():
+    assert ht.issubdtype(ht.int32, ht.core.types.integer)
+    assert ht.issubdtype(ht.float64, ht.core.types.floating)
+    assert ht.issubdtype(ht.uint8, ht.core.types.unsignedinteger)
+    assert not ht.issubdtype(ht.float32, ht.core.types.integer)
+    assert ht.issubdtype(ht.bfloat16, ht.core.types.floating)
+
+
+def test_promote_types():
+    assert ht.promote_types(ht.int32, ht.float32) is ht.float32
+    assert ht.promote_types(ht.uint8, ht.int8) is ht.int16
+    assert ht.promote_types(ht.float32, ht.float64) is ht.float64
+    assert ht.promote_types(ht.bool, ht.int32) is ht.int32
+    assert ht.promote_types(ht.bfloat16, ht.float32) is ht.float32
+
+
+def test_can_cast():
+    assert ht.can_cast(ht.int32, ht.int64)
+    assert ht.can_cast(ht.int32, ht.float32)  # intuitive rule
+    assert ht.can_cast(ht.int64, ht.float64)
+    assert not ht.can_cast(ht.float32, ht.int32)
+    assert ht.can_cast(ht.float32, ht.int32, casting="unsafe")
+    assert not ht.can_cast(ht.float64, ht.float32, casting="safe")
+    assert ht.can_cast(ht.float64, ht.float32, casting="same_kind")
+
+
+def test_cast_constructor():
+    x = ht.float32([1, 2, 3])
+    assert x.dtype is ht.float32
+    np.testing.assert_array_equal(x.numpy(), [1.0, 2.0, 3.0])
+    y = ht.int64(3.7)
+    assert y.dtype is ht.int64
+    assert y.item() == 3
+
+
+def test_finfo_iinfo():
+    fi = ht.finfo(ht.float32)
+    assert fi.bits == 32
+    assert fi.eps == np.finfo(np.float32).eps
+    ii = ht.iinfo(ht.int16)
+    assert ii.max == 32767
+    with pytest.raises(TypeError):
+        ht.finfo(ht.int32)
+    with pytest.raises(TypeError):
+        ht.iinfo(ht.float32)
+
+
+def test_result_type():
+    assert ht.core.types.result_type(ht.ones(3, dtype=ht.int32), 1.0) is ht.float32
