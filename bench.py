@@ -1,0 +1,105 @@
+"""Driver benchmark: KMeans throughput on the flagship fused Lloyd step.
+
+Prints ONE JSON line:
+  {"metric": "kmeans_iter_per_sec", "value": N, "unit": "iter/s",
+   "vs_baseline": R, ...aux...}
+
+``vs_baseline`` compares against a numpy implementation of the identical
+algorithm (same shapes, same Lloyd iteration) on the host CPU — the
+reference repo publishes no numbers (BASELINE.md), so the stand-in baseline
+is the strongest single-process library path a reference user has locally.
+Aux keys record cdist and moments bandwidth for the other headline configs.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+N, F, K, ITERS = 500_000, 32, 8, 30
+
+
+def make_blobs():
+    rng = np.random.default_rng(0)
+    centers = rng.normal(scale=10, size=(K, F)).astype(np.float32)
+    return np.concatenate(
+        [c + rng.normal(size=(N // K, F)).astype(np.float32) for c in centers]
+    ), centers
+
+
+def numpy_kmeans_rate(data: np.ndarray, init: np.ndarray) -> float:
+    """Identical Lloyd loop in numpy (the baseline)."""
+    centers = init.copy()
+    t0 = time.perf_counter()
+    for _ in range(ITERS):
+        d2 = (
+            (data * data).sum(1, keepdims=True)
+            + (centers * centers).sum(1)[None, :]
+            - 2.0 * data @ centers.T
+        )
+        labels = d2.argmin(1)
+        sums = np.zeros_like(centers)
+        np.add.at(sums, labels, data)
+        counts = np.bincount(labels, minlength=K).astype(np.float32)[:, None]
+        centers = np.where(counts > 0, sums / np.maximum(counts, 1), centers)
+    return ITERS / (time.perf_counter() - t0)
+
+
+def heat_kmeans_rate(data: np.ndarray, init: np.ndarray):
+    import heat_tpu as ht
+    from heat_tpu.cluster.kmeans import KMeans
+
+    X = ht.array(data, split=0)
+    init_nd = ht.array(init)
+    km = KMeans(n_clusters=K, init=init_nd, max_iter=ITERS, tol=0.0)
+    km.fit(X)  # warmup: compile the fused step
+    t0 = time.perf_counter()
+    km = KMeans(n_clusters=K, init=init_nd, max_iter=ITERS, tol=0.0)
+    km.fit(X)
+    rate = ITERS / (time.perf_counter() - t0)
+    return rate, X, ht
+
+
+def aux_metrics(ht, X):
+    """cdist GB/s and moments GB/s on the same chip."""
+    sub = ht.array(np.asarray(X.larray[:20_000]), split=0)
+    d = ht.spatial.cdist(sub, quadratic_expansion=True)
+    d.larray.block_until_ready()
+    t0 = time.perf_counter()
+    d = ht.spatial.cdist(sub, quadratic_expansion=True)
+    d.larray.block_until_ready()
+    cdist_gbs = d.shape[0] * d.shape[1] * 4 / (time.perf_counter() - t0) / 1e9
+
+    ht.std(X, axis=0).larray.block_until_ready()
+    t0 = time.perf_counter()
+    ht.mean(X, axis=0).larray.block_until_ready()
+    ht.std(X, axis=0).larray.block_until_ready()
+    moments_gbs = X.nbytes * 2 / (time.perf_counter() - t0) / 1e9
+    return cdist_gbs, moments_gbs
+
+
+def main():
+    data, centers = make_blobs()
+    heat_rate, X, ht = heat_kmeans_rate(data, centers)
+    numpy_rate = numpy_kmeans_rate(data, centers)
+    cdist_gbs, moments_gbs = aux_metrics(ht, X)
+    print(
+        json.dumps(
+            {
+                "metric": "kmeans_iter_per_sec",
+                "value": round(heat_rate, 2),
+                "unit": "iter/s",
+                "vs_baseline": round(heat_rate / numpy_rate, 2),
+                "baseline_numpy_iter_per_sec": round(numpy_rate, 2),
+                "cdist_gb_per_sec": round(cdist_gbs, 2),
+                "moments_gb_per_sec": round(moments_gbs, 2),
+                "config": f"n={N} f={F} k={K} iters={ITERS}",
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,40 @@
+"""Statistical-moments benchmark (reference: benchmarks/
+statistical_moments/heat-cpu.py — mean/std along axis 0, 10 trials)."""
+
+from __future__ import annotations
+
+import argparse
+import time
+
+import numpy as np
+
+
+def main():
+    parser = argparse.ArgumentParser(description="heat_tpu moments benchmark")
+    parser.add_argument("--n", type=int, default=10_000_000)
+    parser.add_argument("--f", type=int, default=8)
+    parser.add_argument("--trials", type=int, default=3)
+    args = parser.parse_args()
+
+    import heat_tpu as ht
+
+    rng = np.random.default_rng(0)
+    x = ht.array(rng.normal(size=(args.n, args.f)).astype(np.float32), split=0)
+
+    ht.mean(x, axis=0).larray.block_until_ready()  # warmup
+    ht.std(x, axis=0).larray.block_until_ready()
+
+    times = []
+    for _ in range(args.trials):
+        t0 = time.perf_counter()
+        m = ht.mean(x, axis=0)
+        s = ht.std(x, axis=0)
+        s.larray.block_until_ready()
+        times.append(time.perf_counter() - t0)
+    best = min(times)
+    gb = x.nbytes * 2 / 1e9  # two passes over the data
+    print(f"moments: n={args.n} f={args.f} best={best:.4f}s → {gb / best:.2f} GB/s")
+
+
+if __name__ == "__main__":
+    main()

@@ -32,6 +32,7 @@ from .core import *
 from .core import linalg, random
 from . import cluster
 from . import classification
+from . import parallel
 from . import graph
 from . import naive_bayes
 from . import regression

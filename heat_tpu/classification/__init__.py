@@ -1,1 +1,3 @@
-"""heat_tpu.classification"""
+"""Classification estimators (reference: heat/classification/__init__.py)."""
+
+from .knn import KNN

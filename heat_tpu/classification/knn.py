@@ -1,0 +1,93 @@
+"""K-nearest-neighbor classification.
+
+Reference: heat/classification/knn.py:4-111 — ``cdist(X, train)`` →
+distributed ``topk(largest=False)`` → one-hot label gather → sum → argmax
+(:83-101), with ``label_to_one_hot`` (:103-111).
+
+TPU formulation: the same pipeline as one fused computation —
+distance matmul (MXU) → ``lax.top_k`` → one-hot matmul vote.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core import types
+from ..core.base import BaseEstimator, ClassificationMixin
+from ..core.dndarray import DNDarray
+from ..core.sanitation import sanitize_in
+
+__all__ = ["KNN"]
+
+
+class KNN(ClassificationMixin, BaseEstimator):
+    """KNN classifier (reference knn.py:4-50).
+
+    Parameters
+    ----------
+    x : DNDarray — training samples (n, f)
+    y : DNDarray — training labels; (n,) class ids or (n, c) one-hot
+    num_neighbours : int — the k in kNN
+    """
+
+    def __init__(self, x: DNDarray, y: DNDarray, num_neighbours: int):
+        sanitize_in(x)
+        sanitize_in(y)
+        if x.shape[0] != y.shape[0]:
+            raise ValueError(f"Number of samples and labels needs to be the same, got {x.shape[0]}, {y.shape[0]}")
+        if not isinstance(num_neighbours, int) or not 0 < num_neighbours <= x.shape[0]:
+            raise ValueError(
+                f"num_neighbours must be an int in [1, {x.shape[0]}], got {num_neighbours}"
+            )
+        self.num_neighbours = num_neighbours
+        self.x = x
+        if y.ndim == 1:
+            self.y = KNN.label_to_one_hot(y)
+        else:
+            self.y = y
+
+    @staticmethod
+    def label_to_one_hot(a: DNDarray) -> DNDarray:
+        """Dense one-hot from class ids (reference knn.py:103-111)."""
+        arr = a.larray.astype(jnp.int32)
+        num_classes = int(jnp.max(arr)) + 1
+        one_hot = jax.nn.one_hot(arr, num_classes, dtype=jnp.float32)
+        return DNDarray(
+            a.comm.apply_sharding(one_hot, a.split),
+            tuple(one_hot.shape),
+            types.float32,
+            a.split,
+            a.device,
+            a.comm,
+            True,
+        )
+
+    def fit(self, x: DNDarray, y: DNDarray):
+        """Store the training set (lazy learner; reference knn.py:51-82)."""
+        self.x = x
+        self.y = KNN.label_to_one_hot(y) if y.ndim == 1 else y
+
+    def predict(self, x: DNDarray) -> DNDarray:
+        """Majority vote of the k nearest training samples
+        (reference knn.py:83-101)."""
+        sanitize_in(x)
+        # promote, don't truncate (the distance-module convention): float64
+        # inputs keep float64 ordering of near-tie neighbors
+        promoted = types.promote_types(
+            types.promote_types(x.dtype, self.x.dtype), types.float32
+        )
+        query = x.larray.astype(promoted.jax_type())
+        train = self.x.larray.astype(promoted.jax_type())
+        labels = self.y.larray.astype(jnp.float32)
+
+        from ..spatial.distance import quadratic_d2
+
+        d2 = quadratic_d2(query, train)
+        _, idx = lax.top_k(-d2, self.num_neighbours)  # k smallest distances
+        votes = jnp.sum(labels[idx], axis=1)  # (m, c)
+        pred = jnp.argmax(votes, axis=1).astype(jnp.int64)
+        split = x.split if x.split == 0 else None
+        pred = x.comm.apply_sharding(pred, split)
+        return DNDarray(pred, tuple(pred.shape), types.int64, split, x.device, x.comm, True)

@@ -1,1 +1,6 @@
-"""heat_tpu.cluster"""
+"""Clustering estimators (reference: heat/cluster/__init__.py)."""
+
+from .kmeans import KMeans
+from .kmedians import KMedians
+from .kmedoids import KMedoids
+from .spectral import Spectral

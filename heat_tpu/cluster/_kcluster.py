@@ -1,0 +1,164 @@
+"""Shared engine for k-clustering estimators.
+
+Reference: heat/cluster/_kcluster.py:4-249 — centroid initialization
+(uniform sampling or k-means++/probability-based), cluster assignment via
+the distance metric, and the fit/predict skeleton.  The reference's
+per-sample owner-rank ``Bcast`` during init (:104-113) is plain global
+indexing here.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional, Union
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..core import factories, random, types
+from ..core.base import BaseEstimator, ClusteringMixin
+from ..core.dndarray import DNDarray
+
+__all__ = ["_KCluster"]
+
+import jax
+
+
+@jax.jit
+def _kmeanspp_next(arr, dmin, center, u):
+    """One k-means++ draw: fold the newest center into the running
+    min-distance vector and sample the next index from the d² CDF —
+    entirely on device, one scalar index to host."""
+    d_new = jnp.sum((arr - center) ** 2, axis=1)
+    dmin = jnp.minimum(dmin, d_new)
+    cdf = jnp.cumsum(dmin)
+    total = cdf[-1]
+    draw = u * jnp.where(total > 0, total, 1.0)
+    idx = jnp.clip(jnp.searchsorted(cdf, draw), 0, arr.shape[0] - 1)
+    return dmin, idx
+
+
+class _KCluster(ClusteringMixin, BaseEstimator):
+    """Base class for KMeans/KMedians/KMedoids (reference _kcluster.py:4-62).
+
+    Parameters
+    ----------
+    metric : callable(DNDarray, DNDarray) -> DNDarray
+        Pairwise distance function (from :mod:`heat_tpu.spatial.distance`).
+    n_clusters, init, max_iter, tol, random_state : as in the reference.
+    """
+
+    def __init__(
+        self,
+        metric: Callable,
+        n_clusters: int,
+        init: Union[str, DNDarray],
+        max_iter: int,
+        tol: float,
+        random_state: Optional[int],
+    ):
+        self.n_clusters = n_clusters
+        self.init = init
+        self.max_iter = max_iter
+        self.tol = tol
+        self.random_state = random_state
+        self._metric = metric
+        self._cluster_centers = None
+        self._labels = None
+        self._inertia = None
+        self._n_iter = None
+
+    @property
+    def cluster_centers_(self) -> DNDarray:
+        return self._cluster_centers
+
+    @property
+    def labels_(self) -> DNDarray:
+        return self._labels
+
+    @property
+    def inertia_(self) -> float:
+        return self._inertia
+
+    @property
+    def n_iter_(self) -> int:
+        return self._n_iter
+
+    def _initialize_cluster_centers(self, x: DNDarray):
+        """Pick initial centroids (reference _kcluster.py:70-190)."""
+        if self.random_state is not None:
+            random.seed(self.random_state)
+
+        if isinstance(self.init, DNDarray):
+            if self.init.shape != (self.n_clusters, x.shape[1]):
+                raise ValueError("passed centroids do not match cluster count or data shape")
+            self._cluster_centers = self.init.resplit(None)
+            return
+        if self.init == "random":
+            # uniform sampling of k distinct rows (reference :82-117)
+            idx = random.randperm(x.shape[0])[: self.n_clusters]
+            centers = x.larray[idx.larray]
+            self._cluster_centers = DNDarray(
+                x.comm.apply_sharding(centers, None),
+                (self.n_clusters, x.shape[1]),
+                x.dtype,
+                None,
+                x.device,
+                x.comm,
+                True,
+            )
+            return
+        if self.init == "probability_based":
+            # k-means++ (reference :129-180): iterative distance-weighted
+            # draws.  The running min-distance vector is updated against
+            # only the NEWEST center (one (n, f) pass per draw, no
+            # (n, k, f) temporary), and sampling happens on device — one
+            # scalar index syncs to host per draw.
+            arr = x.larray.astype(jnp.float32)
+            n = arr.shape[0]
+
+            first = int(np.asarray(random.randint(0, n, (1,)).larray)[0])
+            idxs = [first]
+            dmin = jnp.full((n,), jnp.inf, dtype=jnp.float32)
+            center = arr[first]
+            us = np.asarray(random.rand(self.n_clusters).larray)
+            for i in range(1, self.n_clusters):
+                dmin, idx = _kmeanspp_next(arr, dmin, center, float(us[i]))
+                idxs.append(int(idx))
+                center = arr[int(idx)]
+            carr = arr[jnp.asarray(idxs)].astype(x.dtype.jax_type())
+            self._cluster_centers = DNDarray(
+                x.comm.apply_sharding(carr, None),
+                (self.n_clusters, x.shape[1]),
+                x.dtype,
+                None,
+                x.device,
+                x.comm,
+                True,
+            )
+            return
+        raise ValueError(
+            f"init needs to be one of 'random', DNDarray or 'probability_based', got {self.init}"
+        )
+
+    def _assign_to_cluster(self, x: DNDarray) -> DNDarray:
+        """Nearest-centroid labels (reference _kcluster.py:192-204)."""
+        if self._cluster_centers is None:
+            raise RuntimeError(
+                f"{type(self).__name__} has no cluster centers — call fit() first"
+            )
+        distances = self._metric(x, self._cluster_centers)
+        return distances.argmin(axis=1)
+
+    def _update_centroids(self, x: DNDarray, matching_centroids: DNDarray):
+        raise NotImplementedError()
+
+    def fit(self, x: DNDarray):
+        raise NotImplementedError()
+
+    def predict(self, x: DNDarray) -> DNDarray:
+        """Nearest learned centroid for each sample
+        (reference _kcluster.py:233-249)."""
+        from ..core.sanitation import sanitize_in
+
+        sanitize_in(x)
+        return self._assign_to_cluster(x)

@@ -1,0 +1,91 @@
+"""K-Medoids clustering (centroids snapped to actual data points).
+
+Reference: heat/cluster/kmedoids.py:5-130 — the shared skeleton with a
+medoid update: compute the cluster mean, then snap to the nearest real
+datapoint of that cluster (:43-103).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Union
+
+import jax.numpy as jnp
+
+from ..core.dndarray import DNDarray
+from ..core.sanitation import sanitize_in
+from ..spatial import distance
+from ._kcluster import _KCluster
+
+__all__ = ["KMedoids"]
+
+
+class KMedoids(_KCluster):
+    """K-Medoids estimator (reference kmedoids.py:5-42)."""
+
+    def __init__(
+        self,
+        n_clusters: int = 8,
+        init: Union[str, DNDarray] = "random",
+        max_iter: int = 300,
+        random_state: Optional[int] = None,
+    ):
+        super().__init__(
+            # quadratic expansion: one MXU matmul, no (n, k, f) temporary
+            metric=lambda x, y: distance.cdist(x, y, quadratic_expansion=True),
+            n_clusters=n_clusters,
+            init=init,
+            max_iter=max_iter,
+            tol=0.0,  # medoids converge exactly (reference kmedoids.py:37)
+            random_state=random_state,
+        )
+
+    def _update_centroids(self, x: DNDarray, matching_centroids: DNDarray):
+        """Mean per cluster, snapped to the nearest member datapoint
+        (reference kmedoids.py:43-103)."""
+        arr = x.larray.astype(jnp.float32)
+        labels = matching_centroids.larray
+        k = self.n_clusters
+        member = labels[None, :] == jnp.arange(k)[:, None]  # (k, n)
+        counts = jnp.sum(member, axis=1)[:, None]
+        sums = jnp.matmul(member.astype(arr.dtype), arr)
+        means = sums / jnp.maximum(counts, 1)
+        # snap each mean to the closest member point: (k, n) via the
+        # quadratic expansion (no (k, n, f) broadcast), ±inf on outsiders
+        from ..spatial.distance import quadratic_d2
+
+        d2 = jnp.where(member, quadratic_d2(means, arr), jnp.inf)
+        medoid_idx = jnp.argmin(d2, axis=1)
+        old = self._cluster_centers.larray.astype(jnp.float32)
+        new = jnp.where(counts > 0, arr[medoid_idx], old)
+        return DNDarray(
+            new.astype(x.dtype.jax_type()),
+            tuple(new.shape),
+            self._cluster_centers.dtype,
+            None,
+            x.device,
+            x.comm,
+            True,
+        )
+
+    def fit(self, x: DNDarray) -> "KMedoids":
+        """Iterate until the medoids stop moving (reference kmedoids.py:104-130)."""
+        sanitize_in(x)
+        if x.ndim != 2:
+            raise ValueError(f"input needs to be 2D, but was {x.ndim}D")
+        self._initialize_cluster_centers(x)
+
+        for epoch in range(self.max_iter):
+            labels = self._assign_to_cluster(x)
+            new_centers = self._update_centroids(x, labels)
+            # medoids are snapped to actual datapoints, so convergence is
+            # exact array equality — no float-shift threshold needed
+            converged = bool(
+                jnp.array_equal(new_centers.larray, self._cluster_centers.larray)
+            )
+            self._cluster_centers = new_centers
+            self._n_iter = epoch + 1
+            if converged:
+                break
+
+        self._labels = self._assign_to_cluster(x)
+        return self

@@ -1,0 +1,148 @@
+"""Spectral clustering via graph Laplacian + Lanczos embedding.
+
+Reference: heat/cluster/spectral.py:6-197 — similarity (rbf/euclidean) →
+``graph.Laplacian`` → ``lanczos(L, m)`` → local eig of the tridiagonal T →
+spectral embedding → KMeans on the first k eigenvectors, with a
+spectral-gap heuristic choosing k when unspecified (:98-165).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Union
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..core import types
+from ..core.base import BaseEstimator, ClusteringMixin
+from ..core.dndarray import DNDarray
+from ..core.linalg import solver
+from ..core.sanitation import sanitize_in
+from ..graph import Laplacian
+from ..spatial import distance
+from .kmeans import KMeans
+
+__all__ = ["Spectral"]
+
+
+class Spectral(ClusteringMixin, BaseEstimator):
+    """Spectral clustering estimator (reference spectral.py:6-97).
+
+    Parameters follow the reference: gamma is the rbf kernel coefficient
+    (sigma = sqrt(1/(2·gamma)) ties it to the rbf form), n_lanczos the
+    Krylov dimension, metric ∈ {'rbf', 'euclidean'}.
+    """
+
+    def __init__(
+        self,
+        n_clusters: Optional[int] = None,
+        gamma: float = 1.0,
+        metric: str = "rbf",
+        laplacian: str = "fully_connected",
+        threshold: float = 1.0,
+        boundary: str = "upper",
+        n_lanczos: int = 300,
+        assign_labels: str = "kmeans",
+        **params,
+    ):
+        self.n_clusters = n_clusters
+        self.gamma = gamma
+        self.metric = metric
+        self.laplacian = laplacian
+        self.threshold = threshold
+        self.boundary = boundary
+        self.n_lanczos = n_lanczos
+        self.assign_labels = assign_labels
+
+        if metric == "rbf":
+            sigma = float(np.sqrt(1.0 / (2.0 * gamma)))
+            sim = lambda x: distance.rbf(x, sigma=sigma, quadratic_expansion=True)
+        elif metric == "euclidean":
+            sim = lambda x: distance.cdist(x, quadratic_expansion=True)
+        else:
+            raise NotImplementedError(f"Metric {metric} not implemented")
+
+        self._laplacian = Laplacian(
+            sim,
+            definition="norm_sym",
+            mode=laplacian,
+            threshold_key=boundary,
+            threshold_value=threshold,
+        )
+        self._labels = None
+        self._cluster_centers = None
+
+    @property
+    def labels_(self):
+        return self._labels
+
+    def _spectral_embedding(self, x: DNDarray):
+        """Eigenvector embedding of the Laplacian
+        (reference spectral.py:98-137): lanczos tridiagonalization, then an
+        on-host eig of the small (m, m) tridiagonal T."""
+        L = self._laplacian.construct(x)
+        m = min(self.n_lanczos, x.shape[0])
+        # deterministic start vector: fit() and predict() on the same data
+        # must produce the identical Krylov basis (a random v0 would flip
+        # eigenvector signs between the two embeddings)
+        n = x.shape[0]
+        v0 = DNDarray(
+            jnp.full((n,), 1.0 / np.sqrt(n), dtype=jnp.float32),
+            (n,), types.float32, None, x.device, x.comm, True,
+        )
+        V, T = solver.lanczos(L, m, v0=v0)
+        evals, evecs = np.linalg.eigh(np.asarray(T.larray))  # T symmetric
+        # eigenvectors of L ≈ V @ evecs, ascending eigenvalues
+        emb = jnp.matmul(V.larray, jnp.asarray(evecs, dtype=V.larray.dtype))
+        return evals, emb
+
+    def fit(self, x: DNDarray) -> "Spectral":
+        """(reference spectral.py:138-180)"""
+        sanitize_in(x)
+        if x.split is not None and x.split != 0:
+            raise NotImplementedError("Not implemented for other splitting-axes")
+        evals, emb = self._spectral_embedding(x)
+
+        k = self.n_clusters
+        if k is None:
+            # spectral-gap heuristic (reference spectral.py:151-157)
+            diffs = np.diff(evals[: min(len(evals), 15)])
+            k = int(np.argmax(diffs) + 1) if len(diffs) else 1
+            k = max(k, 1)
+
+        components = emb[:, :k]
+        comp = DNDarray(
+            x.comm.apply_sharding(components, x.split),
+            tuple(components.shape),
+            types.float32,
+            x.split,
+            x.device,
+            x.comm,
+            True,
+        )
+        kmeans = KMeans(n_clusters=k, init="probability_based", random_state=0)
+        kmeans.fit(comp)
+        self._labels = kmeans.labels_
+        self._cluster_centers = kmeans.cluster_centers_
+        self._kmeans = kmeans
+        self._embedding_dim = k
+        return self
+
+    def predict(self, x: DNDarray) -> DNDarray:
+        """Embed ``x`` and classify with the fitted k-means
+        (reference spectral.py:167-197)."""
+        sanitize_in(x)
+        if self._labels is None:
+            raise RuntimeError("Spectral has not been fitted — call fit() first")
+        _, emb = self._spectral_embedding(x)
+        components = emb[:, : self._embedding_dim]
+        comp = DNDarray(
+            x.comm.apply_sharding(components, x.split),
+            tuple(components.shape),
+            types.float32,
+            x.split,
+            x.device,
+            x.comm,
+            True,
+        )
+        return self._kmeans.predict(comp)

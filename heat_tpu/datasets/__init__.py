@@ -1,1 +1,39 @@
-"""heat_tpu.datasets"""
+"""Bundled datasets for tests and demos.
+
+Reference: heat/datasets/data/ ships iris (csv/h5/nc) and diabetes.h5 used
+by the IO and ML test suites.  The same public-domain datasets are bundled
+here (generated from scikit-learn's copies, not copied from the reference),
+with loader helpers the reference leaves to ``ht.load``.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional, Tuple
+
+_DATA_DIR = os.path.join(os.path.dirname(__file__), "data")
+
+__all__ = ["data_path", "load_iris", "load_diabetes"]
+
+
+def data_path(name: str) -> str:
+    """Absolute path of a bundled data file (e.g. 'iris.csv', 'iris.h5',
+    'diabetes.h5')."""
+    return os.path.join(_DATA_DIR, name)
+
+
+def load_iris(split: Optional[int] = None, device=None):
+    """The iris measurements as a (150, 4) float32 DNDarray."""
+    from ..core import io
+
+    return io.load_hdf5(data_path("iris.h5"), "data", split=split, device=device)
+
+
+def load_diabetes(split: Optional[int] = None, device=None):
+    """The diabetes regression set: (x, y) DNDarrays of shape (442, 10) and
+    (442,)."""
+    from ..core import io, types
+
+    x = io.load_hdf5(data_path("diabetes.h5"), "x", dtype=types.float64, split=split, device=device)
+    y = io.load_hdf5(data_path("diabetes.h5"), "y", dtype=types.float64, split=split, device=device)
+    return x, y

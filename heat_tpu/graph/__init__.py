@@ -1,1 +1,3 @@
-"""heat_tpu.graph"""
+"""Graph analytics (reference: heat/graph/__init__.py)."""
+
+from .laplacian import Laplacian

@@ -1,1 +1,3 @@
-"""heat_tpu.naive_bayes"""
+"""Naive Bayes estimators (reference: heat/naive_bayes/__init__.py)."""
+
+from .gaussianNB import GaussianNB

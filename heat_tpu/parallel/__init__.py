@@ -1,0 +1,36 @@
+"""Named parallelism primitives (sequence/context parallelism toolkit).
+
+The reference has no attention or sequence models, but it contains every
+communication *mechanism* those need, in primitive form (SURVEY.md §5.7):
+
+=============================  ==========================================
+reference mechanism            exposed here as
+=============================  ==========================================
+ring pairwise exchange         :func:`ring_map` (spatial/distance.py:
+                               261-345 — stationary block + rotating
+                               block over (p+1)//2 rounds)
+halo exchange                  :func:`halo_exchange` (dndarray.py:390-463
+                               — neighbor boundary strips)
+axis re-split Alltoall         :func:`all_to_all_resplit`
+                               (communication.py:712-881 — the Ulysses
+                               sequence↔head swap)
+—                              :func:`ring_attention` — blockwise ring
+                               attention built on the same ppermute ring,
+                               the long-context flagship
+=============================  ==========================================
+
+All primitives are ``shard_map`` programs over the communicator's 1-D mesh
+with :func:`jax.lax.ppermute` / sharding-transformations doing the
+communication over ICI.
+"""
+
+from .primitives import all_to_all_resplit, halo_exchange, ring_map
+from .ring_attention import ring_attention, ring_self_attention
+
+__all__ = [
+    "all_to_all_resplit",
+    "halo_exchange",
+    "ring_map",
+    "ring_attention",
+    "ring_self_attention",
+]

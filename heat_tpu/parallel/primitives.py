@@ -1,0 +1,162 @@
+"""Sequence/context-parallel communication primitives.
+
+See package docstring for the reference-mechanism mapping.  Every function
+accepts either a DNDarray (uses its communicator) or a raw jax.Array (uses
+the default communicator).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional, Tuple, Union
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec
+
+from ..core.communication import XlaCommunication, get_comm
+from ..core.dndarray import DNDarray
+
+__all__ = ["all_to_all_resplit", "halo_exchange", "ring_map"]
+
+
+def _unpack(x, comm: Optional[XlaCommunication]):
+    if isinstance(x, DNDarray):
+        return x.larray, x.comm
+    return x, (comm or get_comm())
+
+
+def ring_map(
+    fn: Callable,
+    x,
+    comm: Optional[XlaCommunication] = None,
+    axis: int = 0,
+) -> jax.Array:
+    """Apply ``fn(stationary_block, rotating_block, round)`` over a full
+    ring rotation and stack the per-round results.
+
+    The communication shape of the reference's pairwise-distance ring
+    (spatial/distance.py:261-345) and of ring attention: each mesh position
+    keeps its stationary block while the rotating copy moves one hop per
+    round via ``ppermute``; after ``size`` rounds every position has seen
+    every block.
+
+    Returns an array with a leading ``size`` axis of per-round results,
+    sharded like ``x``.  Requires ``x.shape[axis] % size == 0``.
+    """
+    arr, comm = _unpack(x, comm)
+    size = comm.size
+    if axis != 0:
+        arr = jnp.moveaxis(arr, axis, 0)
+    if arr.shape[0] % max(size, 1) != 0:
+        raise ValueError(
+            f"ring_map needs axis {axis} ({arr.shape[0]}) divisible by mesh size ({size})"
+        )
+    if size == 1:
+        out = fn(arr, arr, 0)
+        return out[None]
+
+    mesh, name = comm.mesh, comm.axis_name
+    perm = [(i, (i + 1) % size) for i in range(size)]
+
+    def kernel(block):
+        stationary = block
+
+        def body(r, carry):
+            rotating, acc = carry
+            res = fn(stationary, rotating, r)
+            acc = acc.at[r].set(res)
+            rotating = jax.lax.ppermute(rotating, name, perm)
+            return rotating, acc
+
+        probe = fn(stationary, stationary, 0)
+        acc0 = jnp.zeros((size,) + probe.shape, probe.dtype)
+        # freshly-created carries are axis-invariant; the loop makes them
+        # varying over the mesh axis — align the types up front
+        acc0 = jax.lax.pcast(acc0, (name,), to="varying")
+        _, acc = jax.lax.fori_loop(0, size, body, (stationary, acc0))
+        if probe.ndim == 0:
+            # scalar per round: materialize the per-position axis so the
+            # global result is (rounds, positions)
+            acc = acc[:, None]
+        return acc
+
+    out = jax.jit(
+        jax.shard_map(
+            kernel,
+            mesh=mesh,
+            in_specs=PartitionSpec(name),
+            out_specs=PartitionSpec(None, name),
+        )
+    )(arr)
+    return out
+
+
+def halo_exchange(
+    x,
+    halo_size: int,
+    comm: Optional[XlaCommunication] = None,
+) -> Tuple[jax.Array, jax.Array]:
+    """Fetch each shard's neighbor boundary strips via one ppermute pair.
+
+    The reference's ``get_halo`` (dndarray.py:390-463) posts Isend/Irecv
+    with prev/next ranks; here both directions are a single
+    ``shard_map``-wrapped pair of collective-permutes over ICI.  Returns
+    ``(prev_halos, next_halos)`` where each is sharded like ``x`` and holds,
+    per shard, the strip received from the neighbor (first/last shard
+    receive zeros, mirroring the reference's absent-neighbor behavior).
+    Requires axis 0 divisible by the mesh size and local length ≥ halo.
+    """
+    arr, comm = _unpack(x, comm)
+    size = comm.size
+    if halo_size < 0:
+        raise ValueError(f"halo_size needs to be non-negative, got {halo_size}")
+    if size == 1 or halo_size == 0:
+        z = jnp.zeros((halo_size,) + arr.shape[1:], arr.dtype)
+        return z, z
+    if arr.shape[0] % size != 0:
+        raise ValueError(
+            f"halo_exchange needs axis 0 ({arr.shape[0]}) divisible by mesh size ({size})"
+        )
+    if arr.shape[0] // size < halo_size:
+        raise ValueError("halo_size exceeds the local shard length")
+
+    mesh, name = comm.mesh, comm.axis_name
+    fwd = [(i, i + 1) for i in range(size - 1)]  # my tail → next's halo_prev
+    bwd = [(i + 1, i) for i in range(size - 1)]  # my head → prev's halo_next
+
+    def kernel(block):
+        tail = block[-halo_size:]
+        head = block[:halo_size]
+        prev_halo = jax.lax.ppermute(tail, name, fwd)  # zeros at position 0
+        next_halo = jax.lax.ppermute(head, name, bwd)  # zeros at last position
+        return prev_halo, next_halo
+
+    prev, nxt = jax.jit(
+        jax.shard_map(
+            kernel,
+            mesh=mesh,
+            in_specs=PartitionSpec(name),
+            out_specs=(PartitionSpec(name), PartitionSpec(name)),
+        )
+    )(arr)
+    return prev, nxt
+
+
+def all_to_all_resplit(
+    x,
+    from_axis: int,
+    to_axis: int,
+    comm: Optional[XlaCommunication] = None,
+) -> jax.Array:
+    """Swap the sharded axis: split at ``from_axis`` → split at ``to_axis``.
+
+    The Ulysses sequence-parallel primitive (heads↔sequence swap) and the
+    reference's axis-permuted ``Alltoallv`` (communication.py:764-881).
+    Expressed as a sharding transformation; XLA lowers it to one
+    all-to-all over ICI when both axis sizes divide the mesh.
+    """
+    arr, comm = _unpack(x, comm)
+    del from_axis  # the array's current sharding already encodes it
+    return comm.apply_sharding(arr, to_axis)

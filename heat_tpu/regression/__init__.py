@@ -1,1 +1,3 @@
-"""heat_tpu.regression"""
+"""Regression estimators (reference: heat/regression/__init__.py)."""
+
+from .lasso import Lasso

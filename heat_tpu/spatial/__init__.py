@@ -1,1 +1,4 @@
-"""heat_tpu.spatial"""
+"""Spatial/distance computations (reference: heat/spatial/__init__.py)."""
+
+from . import distance
+from .distance import cdist, manhattan, rbf

@@ -1,0 +1,103 @@
+"""Pairwise distance computations.
+
+Reference: heat/spatial/distance.py:28-475 — ``cdist``/``rbf``/``manhattan``
+route into ``_dist``, which hand-rolls a **ring communication** schedule:
+with X split=0, each of (size+1)//2 rounds Sends the local block to rank+i,
+Recvs from rank−i, computes a tile, and ships the result back to exploit
+symmetry (:244-345).
+
+TPU-first formulation: the distance matrix is one global computation.  For
+the euclidean metric the quadratic expansion ``|x|² + |y|² − 2xy``
+(reference :28-72 uses the same trick locally) turns the hot loop into a
+single large matmul on the MXU; GSPMD schedules the inter-shard movement —
+on an ICI ring that schedule *is* the reference's ring, chosen by the
+compiler.  Row-sharding of X propagates to row-sharding of D.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+
+from ..core import types
+from ..core.dndarray import DNDarray
+from ..core.sanitation import sanitize_in
+
+__all__ = ["cdist", "manhattan", "rbf", "quadratic_d2"]
+
+
+def quadratic_d2(xa, ya):
+    """Squared euclidean distances via the MXU-native quadratic expansion
+    |x|² + |y|² − 2xy, clamped at 0 against rounding (the one shared
+    implementation — reference _quadratic_expand, distance.py:40-72)."""
+    x2 = jnp.sum(xa * xa, axis=-1, keepdims=True)
+    y2 = jnp.sum(ya * ya, axis=-1, keepdims=True).swapaxes(-1, -2)
+    return jnp.maximum(x2 + y2 - 2.0 * jnp.matmul(xa, ya.swapaxes(-1, -2)), 0.0)
+
+
+def _prep(x: DNDarray, y: Optional[DNDarray]):
+    sanitize_in(x)
+    if x.ndim != 2:
+        raise NotImplementedError(f"X should be a 2D DNDarray, but is {x.ndim}D")
+    if y is not None:
+        sanitize_in(y)
+        if y.ndim != 2:
+            raise NotImplementedError(f"Y should be a 2D DNDarray, but is {y.ndim}D")
+        if x.shape[1] != y.shape[1]:
+            raise ValueError(
+                f"inputs must have the same number of features, got {x.shape[1]} and {y.shape[1]}"
+            )
+    promoted = types.promote_types(x.dtype, types.float32)
+    xa = x.larray.astype(promoted.jax_type())
+    ya = xa if y is None else y.larray.astype(promoted.jax_type())
+    return xa, ya, promoted
+
+
+def _wrap(x: DNDarray, garr, dtype) -> DNDarray:
+    split = x.split if x.split == 0 else None
+    garr = x.comm.apply_sharding(garr, split)
+    return DNDarray(garr, tuple(garr.shape), dtype, split, x.device, x.comm, True)
+
+
+def _euclidean(xa, ya, quadratic_expansion: bool):
+    if quadratic_expansion:
+        return jnp.sqrt(quadratic_d2(xa, ya))
+    diff = xa[:, None, :] - ya[None, :, :]
+    return jnp.sqrt(jnp.sum(diff * diff, axis=-1))
+
+
+def cdist(X: DNDarray, Y: Optional[DNDarray] = None, quadratic_expansion: bool = False) -> DNDarray:
+    """Pairwise euclidean distances (reference distance.py:166-172).
+
+    ``quadratic_expansion=True`` uses the |x|²+|y|²−2xy form — on TPU this
+    is the fast path (a single MXU matmul); the exact broadcast form is the
+    default, like the reference's torch.cdist.
+    """
+    xa, ya, dtype = _prep(X, Y)
+    return _wrap(X, _euclidean(xa, ya, quadratic_expansion), dtype)
+
+
+def rbf(
+    X: DNDarray,
+    Y: Optional[DNDarray] = None,
+    sigma: float = 1.0,
+    quadratic_expansion: bool = False,
+) -> DNDarray:
+    """Gaussian (RBF) kernel matrix exp(−d²/2σ²)
+    (reference distance.py:173-179)."""
+    xa, ya, dtype = _prep(X, Y)
+    if quadratic_expansion:
+        d2 = quadratic_d2(xa, ya)
+    else:
+        diff = xa[:, None, :] - ya[None, :, :]
+        d2 = jnp.sum(diff * diff, axis=-1)
+    return _wrap(X, jnp.exp(-d2 / (2.0 * sigma * sigma)), dtype)
+
+
+def manhattan(X: DNDarray, Y: Optional[DNDarray] = None, expand: bool = False) -> DNDarray:
+    """Pairwise L1 distances (reference distance.py:180-186)."""
+    xa, ya, dtype = _prep(X, Y)
+    del expand  # accepted for API parity; one formulation here
+    d = jnp.sum(jnp.abs(xa[:, None, :] - ya[None, :, :]), axis=-1)
+    return _wrap(X, d, dtype)

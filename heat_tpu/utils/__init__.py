@@ -1,1 +1,3 @@
-"""heat_tpu.utils"""
+"""Utilities (reference: heat/utils/__init__.py)."""
+
+from . import matrixgallery

@@ -1,0 +1,30 @@
+"""Test-matrix gallery.
+
+Reference: heat/utils/matrixgallery.py:7-52 — the ``parter`` Toeplitz matrix
+``A[i,j] = 1/(i - j + 0.5)`` whose singular values cluster at π, built from
+split-aware arange/expand_dims.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ..core import arithmetics, factories, manipulations, types
+from ..core.dndarray import DNDarray
+
+__all__ = ["parter"]
+
+
+def parter(n: int, split: Optional[int] = None, device=None, comm=None) -> DNDarray:
+    """The Parter matrix A[i,j] = 1/(i − j + 0.5)
+    (reference matrixgallery.py:7-52)."""
+    if not isinstance(n, int):
+        raise TypeError(f"n must be an int, got {type(n)}")
+    ii = factories.arange(n, dtype=types.float32, device=device, comm=comm)
+    jj = factories.arange(n, dtype=types.float32, device=device, comm=comm)
+    I = manipulations.expand_dims(ii, 1)  # (n, 1)
+    J = manipulations.expand_dims(jj, 0)  # (1, n)
+    A = arithmetics.div(1.0, arithmetics.add(arithmetics.sub(I, J), 0.5))
+    if split is not None:
+        A = A.resplit(split)
+    return A

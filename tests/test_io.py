@@ -1,0 +1,82 @@
+"""IO tests (reference: heat/core/tests/test_io.py)."""
+
+import os
+
+import numpy as np
+import pytest
+
+import heat_tpu as ht
+
+
+@pytest.fixture
+def tmp_h5(tmp_path):
+    return str(tmp_path / "data.h5")
+
+
+def test_hdf5_roundtrip(tmp_h5):
+    x = ht.arange(64, dtype=ht.float32, split=0).reshape((16, 4))
+    ht.save_hdf5(x, tmp_h5, "data")
+    for split in (None, 0, 1):
+        y = ht.load_hdf5(tmp_h5, "data", split=split)
+        np.testing.assert_array_equal(y.numpy(), x.numpy())
+        assert y.split == split
+    # extension dispatch
+    z = ht.load(tmp_h5, "data", split=0)
+    np.testing.assert_array_equal(z.numpy(), x.numpy())
+
+
+def test_hdf5_validation(tmp_h5):
+    with pytest.raises(TypeError):
+        ht.load_hdf5(1, "data")
+    with pytest.raises(TypeError):
+        ht.load_hdf5(tmp_h5, 1)
+    with pytest.raises(TypeError):
+        ht.save_hdf5("not an array", tmp_h5, "data")
+
+
+def test_csv_roundtrip(tmp_path):
+    p = str(tmp_path / "data.csv")
+    data = np.arange(20, dtype=np.float32).reshape(5, 4)
+    x = ht.array(data, split=0)
+    ht.save_csv(x, p)
+    y = ht.load_csv(p, split=0)
+    np.testing.assert_allclose(y.numpy(), data)
+    # header lines + separator
+    with open(p, "w") as f:
+        f.write("a;b;c\n1;2;3\n4;5;6\n")
+    z = ht.load_csv(p, header_lines=1, sep=";")
+    np.testing.assert_allclose(z.numpy(), [[1, 2, 3], [4, 5, 6]])
+
+
+def test_load_save_dispatch(tmp_path):
+    x = ht.ones((4, 4))
+    with pytest.raises(ValueError):
+        ht.save(x, str(tmp_path / "file.xyz"))
+    with pytest.raises(ValueError):
+        ht.load(str(tmp_path / "file.xyz"))
+    with pytest.raises(TypeError):
+        ht.load(42)
+
+
+def test_netcdf_gated(tmp_path):
+    if ht.io.supports_netcdf():
+        p = str(tmp_path / "d.nc")
+        x = ht.arange(12, dtype=ht.float32).reshape((3, 4))
+        ht.save_netcdf(x, p, "var")
+        y = ht.load_netcdf(p, "var")
+        np.testing.assert_array_equal(y.numpy(), x.numpy())
+    else:
+        with pytest.raises(RuntimeError):
+            ht.load_netcdf("nope.nc", "var")
+
+
+def test_bundled_datasets():
+    iris = ht.datasets.load_iris(split=0)
+    assert iris.shape == (150, 4)
+    assert iris.dtype is ht.float32
+    x, y = ht.datasets.load_diabetes(split=0)
+    assert x.shape == (442, 10)
+    assert y.shape == (442,)
+    # csv copy matches h5 copy
+    iris_csv = ht.load_csv(ht.datasets.data_path("iris.csv"), sep=";")
+    np.testing.assert_allclose(iris_csv.numpy(), iris.numpy(), atol=0.051)

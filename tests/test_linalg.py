@@ -1,0 +1,158 @@
+"""Linear algebra tests (reference: heat/core/linalg/tests/)."""
+
+import numpy as np
+import pytest
+
+import heat_tpu as ht
+
+from suite import assert_array_equal
+
+
+@pytest.mark.parametrize("sa", [None, 0, 1])
+@pytest.mark.parametrize("sb", [None, 0, 1])
+def test_matmul_all_splits(sa, sb):
+    rng = np.random.default_rng(11)
+    a = rng.normal(size=(16, 8)).astype(np.float32)
+    b = rng.normal(size=(8, 12)).astype(np.float32)
+    x = ht.array(a, split=sa)
+    y = ht.array(b, split=sb)
+    assert_array_equal(x @ y, a @ b, rtol=1e-4, atol=1e-4)
+
+
+def test_matmul_split_rules():
+    a = ht.ones((8, 4), split=0)
+    b = ht.ones((4, 8), split=None)
+    assert (a @ b).split == 0
+    c = ht.ones((8, 4), split=None)
+    d = ht.ones((4, 8), split=1)
+    assert (c @ d).split == 1
+    e = ht.ones((8, 4), split=1)
+    f = ht.ones((4, 8), split=0)
+    assert (e @ f).split is None
+
+
+def test_matmul_vectors():
+    a = np.arange(6, dtype=np.float32)
+    m = np.arange(24, dtype=np.float32).reshape(6, 4)
+    assert_array_equal(ht.matmul(ht.array(a, split=0), ht.array(m, split=0)), a @ m)
+    assert_array_equal(ht.matmul(ht.array(m.T), ht.array(a, split=0)), m.T @ a)
+
+
+def test_matmul_dtype_promotion():
+    a = ht.ones((4, 4), dtype=ht.int32)
+    b = ht.ones((4, 4), dtype=ht.float32)
+    assert (a @ b).dtype is ht.float32
+
+
+def test_dot():
+    a = np.arange(5, dtype=np.float32)
+    b = np.arange(5, 10, dtype=np.float32)
+    res = ht.dot(ht.array(a, split=0), ht.array(b, split=0))
+    assert float(res) == float(a @ b)
+    s = ht.dot(ht.array(2.0), ht.array(3.0))
+    assert float(s) == 6.0
+
+
+def test_norm_projection():
+    a = np.array([3.0, 4.0], dtype=np.float32)
+    assert abs(ht.linalg.norm(ht.array(a, split=0)) - 5.0) < 1e-6
+    x = ht.array([1.0, 2.0], split=0)
+    e1 = ht.array([1.0, 0.0], split=0)
+    assert_array_equal(ht.linalg.projection(x, e1), np.array([1.0, 0.0]))
+    with pytest.raises(RuntimeError):
+        ht.linalg.projection(ht.ones((2, 2)), e1)
+
+
+def test_outer():
+    a = np.arange(4, dtype=np.float32)
+    b = np.arange(3, dtype=np.float32)
+    res = ht.linalg.outer(ht.array(a, split=0), ht.array(b))
+    assert_array_equal(res, np.outer(a, b))
+    assert res.split == 0
+
+
+def test_transpose():
+    data = np.arange(24, dtype=np.float32).reshape(2, 3, 4)
+    x = ht.array(data, split=1)
+    t = ht.linalg.transpose(x, (2, 0, 1))
+    assert_array_equal(t, data.transpose(2, 0, 1))
+    assert t.split == 2
+    assert x.T.shape == (4, 3, 2)
+
+
+@pytest.mark.parametrize("split", [None, 0, 1])
+def test_tril_triu(split):
+    data = np.arange(20, dtype=np.float32).reshape(4, 5)
+    x = ht.array(data, split=split)
+    assert_array_equal(ht.tril(x), np.tril(data))
+    assert_array_equal(ht.triu(x, k=1), np.triu(data, 1))
+    assert_array_equal(ht.tril(x, k=-1), np.tril(data, -1))
+
+
+@pytest.mark.parametrize("split", [None, 0, 1])
+def test_qr(split):
+    rng = np.random.default_rng(2)
+    a = rng.normal(size=(32, 8)).astype(np.float32)
+    x = ht.array(a, split=split)
+    q, r = ht.linalg.qr(x)
+    np.testing.assert_allclose(q.numpy() @ r.numpy(), a, atol=1e-4)
+    np.testing.assert_allclose(q.numpy().T @ q.numpy(), np.eye(8), atol=1e-4)
+    np.testing.assert_allclose(r.numpy(), np.triu(r.numpy()), atol=1e-5)
+    r_only = ht.linalg.qr(x, calc_q=False)
+    assert r_only.Q is None
+    np.testing.assert_allclose(np.abs(r_only.R.numpy()), np.abs(r.numpy()), atol=1e-4)
+
+
+def test_qr_validation():
+    with pytest.raises(ValueError):
+        ht.linalg.qr(ht.ones(4))
+    with pytest.raises(TypeError):
+        ht.linalg.qr(ht.ones((4, 4)), tiles_per_proc="x")
+
+
+@pytest.mark.parametrize("split", [None, 0])
+def test_svd(split):
+    rng = np.random.default_rng(4)
+    a = rng.normal(size=(40, 6)).astype(np.float32)
+    x = ht.array(a, split=split)
+    u, s, v = ht.linalg.svd(x)
+    np.testing.assert_allclose(
+        u.numpy() @ np.diag(s.numpy()) @ v.numpy().T, a, atol=1e-4
+    )
+    np.testing.assert_allclose(s.numpy(), np.linalg.svd(a, compute_uv=False), rtol=1e-4)
+    s_only = ht.linalg.svd(x, compute_uv=False)
+    np.testing.assert_allclose(s_only.numpy(), s.numpy(), rtol=1e-5)
+
+
+def test_svd_wide():
+    rng = np.random.default_rng(4)
+    a = rng.normal(size=(6, 30)).astype(np.float32)
+    u, s, v = ht.linalg.svd(ht.array(a, split=1))
+    np.testing.assert_allclose(u.numpy() @ np.diag(s.numpy()) @ v.numpy().T, a, atol=1e-4)
+
+
+def test_cg():
+    rng = np.random.default_rng(8)
+    m = rng.normal(size=(10, 10)).astype(np.float32)
+    spd = m @ m.T + 10 * np.eye(10, dtype=np.float32)
+    b = rng.normal(size=10).astype(np.float32)
+    A = ht.array(spd, split=0)
+    x0 = ht.zeros(10, split=0)
+    x = ht.linalg.cg(A, ht.array(b, split=0), x0)
+    np.testing.assert_allclose(spd @ x.numpy(), b, atol=1e-3)
+    with pytest.raises(RuntimeError):
+        ht.linalg.cg(ht.ones(3), ht.ones(3), ht.ones(3))
+
+
+def test_lanczos():
+    rng = np.random.default_rng(9)
+    m = rng.normal(size=(20, 20)).astype(np.float32)
+    sym = (m + m.T) / 2
+    A = ht.array(sym, split=0)
+    V, T = ht.linalg.lanczos(A, 20)
+    # eigenvalues of T approximate eigenvalues of A
+    ev_t = np.sort(np.linalg.eigvalsh(T.numpy()))
+    ev_a = np.sort(np.linalg.eigvalsh(sym))
+    np.testing.assert_allclose(ev_t[-3:], ev_a[-3:], rtol=1e-2, atol=1e-2)
+    with pytest.raises(RuntimeError):
+        ht.linalg.lanczos(ht.ones((3, 4)), 2)

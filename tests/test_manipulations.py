@@ -1,0 +1,159 @@
+"""Manipulation tests vs numpy oracle
+(reference: heat/core/tests/test_manipulations.py)."""
+
+import numpy as np
+import pytest
+
+import heat_tpu as ht
+
+from suite import assert_array_equal
+
+
+@pytest.fixture
+def data():
+    return np.arange(24, dtype=np.float32).reshape(6, 4)
+
+
+@pytest.mark.parametrize("split", [None, 0, 1])
+def test_concatenate(data, split):
+    x = ht.array(data, split=split)
+    y = ht.array(data + 100, split=split)
+    assert_array_equal(ht.concatenate([x, y], axis=0), np.concatenate([data, data + 100], 0))
+    assert_array_equal(ht.concatenate([x, y], axis=1), np.concatenate([data, data + 100], 1))
+
+
+def test_concatenate_type_promotion():
+    x = ht.array([1, 2, 3])
+    y = ht.array([1.5, 2.5, 3.5])
+    res = ht.concatenate([x, y])
+    assert res.dtype is ht.float32
+    np.testing.assert_allclose(res.numpy(), [1, 2, 3, 1.5, 2.5, 3.5])
+
+
+def test_diag_diagonal(data):
+    x = ht.array(data, split=0)
+    assert_array_equal(ht.diagonal(x), np.diagonal(data))
+    assert_array_equal(ht.diag(ht.array([1.0, 2.0, 3.0])), np.diag([1.0, 2.0, 3.0]))
+    assert_array_equal(ht.diagonal(x, offset=1), np.diagonal(data, offset=1))
+
+
+def test_expand_squeeze(data):
+    x = ht.array(data, split=1)
+    e = ht.expand_dims(x, 0)
+    assert e.shape == (1, 6, 4)
+    assert e.split == 2  # split shifted
+    s = e.squeeze(0)
+    assert s.shape == (6, 4)
+    assert s.split == 1
+    with pytest.raises(ValueError):
+        x.squeeze(0)
+
+
+def test_flatten_reshape(data):
+    x = ht.array(data, split=0)
+    f = x.flatten()
+    assert f.split == 0
+    assert_array_equal(f, data.flatten())
+    r = x.reshape(4, 6)
+    assert_array_equal(r, data.reshape(4, 6))
+    r2 = ht.reshape(x, (2, -1))
+    assert r2.shape == (2, 12)
+    with pytest.raises(ValueError):
+        x.reshape(5, 5)
+
+
+@pytest.mark.parametrize("split", [None, 0, 1])
+def test_flip(data, split):
+    x = ht.array(data, split=split)
+    assert_array_equal(ht.flip(x), np.flip(data))
+    assert_array_equal(ht.flipud(x), np.flipud(data))
+    assert_array_equal(ht.fliplr(x), np.fliplr(data))
+
+
+def test_pad(data):
+    x = ht.array(data, split=0)
+    assert_array_equal(ht.pad(x, ((1, 2), (0, 1))), np.pad(data, ((1, 2), (0, 1))))
+    assert_array_equal(
+        ht.pad(x, 2, constant_values=9), np.pad(data, 2, constant_values=9)
+    )
+
+
+def test_repeat(data):
+    x = ht.array(data, split=0)
+    assert_array_equal(ht.repeat(x, 3), np.repeat(data, 3))
+    assert_array_equal(ht.repeat(x, 2, axis=1), np.repeat(data, 2, axis=1))
+
+
+def test_rot90(data):
+    x = ht.array(data, split=0)
+    assert_array_equal(ht.rot90(x), np.rot90(data))
+    assert_array_equal(ht.rot90(x, k=2), np.rot90(data, k=2))
+
+
+@pytest.mark.parametrize("split", [None, 0])
+def test_sort(split):
+    rng = np.random.default_rng(5)
+    data = rng.permutation(40).reshape(8, 5).astype(np.float32)
+    x = ht.array(data, split=split)
+    v, i = ht.sort(x, axis=0)
+    assert_array_equal(v, np.sort(data, axis=0))
+    assert_array_equal(i, np.argsort(data, axis=0, kind="stable"))
+    vd, _ = ht.sort(x, axis=1, descending=True)
+    assert_array_equal(vd, -np.sort(-data, axis=1))
+
+
+def test_split_functions(data):
+    x = ht.array(data, split=0)
+    parts = ht.split(x, 2, axis=0)
+    assert len(parts) == 2
+    assert_array_equal(parts[0], data[:3])
+    v = ht.vsplit(x, 3)
+    assert_array_equal(v[1], data[2:4])
+    h = ht.hsplit(x, 2)
+    assert_array_equal(h[0], data[:, :2])
+    with pytest.raises(ValueError):
+        ht.split(x, 5, axis=0)
+
+
+def test_stack_hstack_vstack(data):
+    x = ht.array(data, split=0)
+    y = ht.array(data * 2, split=0)
+    assert_array_equal(ht.stack([x, y]), np.stack([data, data * 2]))
+    assert_array_equal(ht.stack([x, y], axis=1), np.stack([data, data * 2], axis=1))
+    assert_array_equal(ht.vstack([x, y]), np.vstack([data, data * 2]))
+    assert_array_equal(ht.hstack([x, y]), np.hstack([data, data * 2]))
+    a1 = ht.array([1.0, 2.0])
+    b1 = ht.array([3.0, 4.0])
+    assert_array_equal(ht.column_stack([a1, b1]), np.column_stack([[1.0, 2.0], [3.0, 4.0]]))
+    assert_array_equal(ht.row_stack([a1, b1]), np.vstack([[1.0, 2.0], [3.0, 4.0]]))
+
+
+def test_unique():
+    v = np.array([3, 1, 2, 1, 3, 3, 7], dtype=np.int32)
+    x = ht.array(v, split=0)
+    u = ht.unique(x, sorted=True)
+    assert_array_equal(u, np.unique(v))
+    u2, inv = ht.unique(x, return_inverse=True)
+    np.testing.assert_array_equal(u2.numpy()[inv.numpy()], v)
+
+
+@pytest.mark.parametrize("split", [None, 0])
+def test_topk(split):
+    data = np.array([[9.0, 1.0, 5.0, 7.0], [2.0, 8.0, 4.0, 6.0]], dtype=np.float32)
+    x = ht.array(data, split=split)
+    v, i = ht.topk(x, 2)
+    np.testing.assert_array_equal(v.numpy(), [[9.0, 7.0], [8.0, 6.0]])
+    v2, i2 = ht.topk(x, 2, largest=False)
+    np.testing.assert_array_equal(v2.numpy(), [[1.0, 5.0], [2.0, 4.0]])
+    vdim, _ = ht.topk(x, 1, dim=0)
+    np.testing.assert_array_equal(vdim.numpy(), [[9.0, 8.0, 5.0, 7.0]])
+
+
+def test_resplit_balance(data):
+    x = ht.array(data, split=0)
+    y = ht.resplit(x, 1)
+    assert y.split == 1 and x.split == 0
+    b = ht.core.manipulations.balance(x)
+    assert b.balanced
+    r = ht.core.manipulations.redistribute(x)
+    assert r is x

@@ -1,0 +1,218 @@
+"""ML-layer tests: spatial, cluster, regression, classification,
+naive_bayes, graph, utils (reference: heat/{cluster,regression,...}/tests/)."""
+
+import numpy as np
+import pytest
+
+import heat_tpu as ht
+
+
+def _blobs(seed=0, n=100, centers=((0, 0), (5, 5), (0, 5), (5, 0)), noise=0.3):
+    rng = np.random.default_rng(seed)
+    data = np.concatenate(
+        [np.asarray(c) + noise * rng.normal(size=(n, len(c))) for c in centers]
+    ).astype(np.float32)
+    labels = np.repeat(np.arange(len(centers)), n)
+    return data, labels
+
+
+# ---------------------------------------------------------------- spatial
+@pytest.mark.parametrize("quad", [False, True])
+def test_cdist(quad):
+    rng = np.random.default_rng(1)
+    a = rng.normal(size=(10, 3)).astype(np.float32)
+    b = rng.normal(size=(7, 3)).astype(np.float32)
+    from scipy.spatial.distance import cdist as scipy_cdist
+
+    d = ht.spatial.cdist(ht.array(a, split=0), ht.array(b), quadratic_expansion=quad)
+    np.testing.assert_allclose(d.numpy(), scipy_cdist(a, b), atol=1e-3)
+    assert d.split == 0
+    d_self = ht.spatial.cdist(ht.array(a, split=0))
+    np.testing.assert_allclose(d_self.numpy(), scipy_cdist(a, a), atol=1e-3)
+
+
+def test_manhattan_rbf():
+    rng = np.random.default_rng(2)
+    a = rng.normal(size=(6, 4)).astype(np.float32)
+    from scipy.spatial.distance import cdist as scipy_cdist
+
+    m = ht.spatial.manhattan(ht.array(a, split=0))
+    np.testing.assert_allclose(m.numpy(), scipy_cdist(a, a, metric="cityblock"), rtol=1e-5)
+    sigma = 2.0
+    r = ht.spatial.rbf(ht.array(a, split=0), sigma=sigma)
+    expected = np.exp(-scipy_cdist(a, a) ** 2 / (2 * sigma**2))
+    np.testing.assert_allclose(r.numpy(), expected, atol=1e-5)
+
+
+def test_cdist_validation():
+    with pytest.raises(NotImplementedError):
+        ht.spatial.cdist(ht.ones(3))
+    with pytest.raises(ValueError):
+        ht.spatial.cdist(ht.ones((3, 2)), ht.ones((3, 4)))
+
+
+# ---------------------------------------------------------------- cluster
+@pytest.mark.parametrize("init", ["random", "probability_based"])
+def test_kmeans(init):
+    data, true_labels = _blobs()
+    X = ht.array(data, split=0)
+    km = ht.cluster.KMeans(n_clusters=4, init=init, random_state=5).fit(X)
+    assert km.cluster_centers_.shape == (4, 2)
+    pred = km.labels_.numpy()
+    if init == "probability_based":
+        # k-means++ init must resolve the well-separated blobs exactly
+        for blob in range(4):
+            assert len(np.unique(pred[true_labels == blob])) == 1
+    else:
+        # plain random init may hit a local optimum; still a valid clustering
+        assert len(np.unique(pred)) >= 3
+    # predict == labels on training data
+    np.testing.assert_array_equal(km.predict(X).numpy(), pred)
+    assert km.inertia_ > 0
+
+
+def test_kmeans_fixed_init():
+    data, _ = _blobs()
+    X = ht.array(data, split=0)
+    init_centers = ht.array(np.array([[0, 0], [5, 5], [0, 5], [5, 0]], dtype=np.float32))
+    km = ht.cluster.KMeans(n_clusters=4, init=init_centers).fit(X)
+    centers = np.sort(np.round(km.cluster_centers_.numpy()), axis=0)
+    np.testing.assert_array_equal(centers, np.sort([[0, 0], [5, 5], [0, 5], [5, 0]], axis=0))
+    with pytest.raises(ValueError):
+        ht.cluster.KMeans(n_clusters=3, init=init_centers).fit(X)
+    with pytest.raises(ValueError):
+        ht.cluster.KMeans(n_clusters=3, init="bogus").fit(X)
+
+
+def test_kmedians_kmedoids():
+    data, true_labels = _blobs(seed=3)
+    X = ht.array(data, split=0)
+    for Est in (ht.cluster.KMedians, ht.cluster.KMedoids):
+        est = Est(n_clusters=4, init="probability_based", random_state=2).fit(X)
+        pred = est.labels_.numpy()
+        for blob in range(4):
+            assert len(np.unique(pred[true_labels == blob])) == 1
+    # medoids are actual datapoints
+    km = ht.cluster.KMedoids(n_clusters=4, init="probability_based", random_state=2).fit(X)
+    centers = km.cluster_centers_.numpy()
+    for c in centers:
+        assert np.min(np.linalg.norm(data - c, axis=1)) < 1e-6
+
+
+def test_spectral():
+    data, true_labels = _blobs(seed=4, n=50, centers=((0, 0), (7, 7)), noise=0.4)
+    X = ht.array(data, split=0)
+    sp = ht.cluster.Spectral(n_clusters=2, gamma=0.5, n_lanczos=30).fit(X)
+    pred = sp.labels_.numpy()
+    for blob in range(2):
+        assert len(np.unique(pred[true_labels == blob])) == 1
+    assert sp.fit_predict(X) is not None
+
+
+def test_estimator_api():
+    km = ht.cluster.KMeans(n_clusters=3)
+    params = km.get_params()
+    assert params["n_clusters"] == 3
+    km.set_params(n_clusters=5)
+    assert km.n_clusters == 5
+    assert ht.core.base.is_clusterer(km)
+    assert not ht.core.base.is_classifier(km)
+    with pytest.raises(ValueError):
+        km.set_params(bogus=1)
+
+
+# ---------------------------------------------------------------- graph
+def test_laplacian():
+    data = np.array([[0.0, 0.0], [1.0, 0.0], [10.0, 10.0]], dtype=np.float32)
+    X = ht.array(data, split=0)
+    lap = ht.graph.Laplacian(lambda x: ht.spatial.rbf(x, sigma=1.0), definition="simple")
+    L = lap.construct(X).numpy()
+    np.testing.assert_allclose(L.sum(axis=1), 0.0, atol=1e-6)  # row sums vanish
+    lap_sym = ht.graph.Laplacian(lambda x: ht.spatial.rbf(x, sigma=1.0), definition="norm_sym")
+    Ls = lap_sym.construct(X).numpy()
+    np.testing.assert_allclose(np.diag(Ls), 1.0, atol=1e-6)
+    with pytest.raises(NotImplementedError):
+        ht.graph.Laplacian(lambda x: x, definition="bogus")
+
+
+# ---------------------------------------------------------------- lasso
+def test_lasso():
+    x, y = ht.datasets.load_diabetes(split=0)
+    xn = ht.array(
+        (x.numpy() - x.numpy().mean(0)) / x.numpy().std(0), split=0, dtype=ht.float32
+    )
+    est = ht.regression.Lasso(lam=0.1, max_iter=200, tol=1e-8)
+    est.fit(xn, y)
+    pred = est.predict(xn)
+    rmse = est.rmse(y, pred)
+    assert rmse < 60  # diabetes baseline ~54
+    assert est.coef_.shape == (10, 1)
+    assert float(est.intercept_.item()) == pytest.approx(float(y.numpy().mean()), rel=1e-2)
+    # stronger penalty shrinks coefficients
+    est_strong = ht.regression.Lasso(lam=20.0, max_iter=200)
+    est_strong.fit(xn, y)
+    assert np.abs(est_strong.coef_.numpy()).sum() < np.abs(est.coef_.numpy()).sum()
+    assert ht.core.base.is_regressor(est)
+    with pytest.raises(ValueError):
+        est.fit(ht.ones(3), y)
+
+
+# ---------------------------------------------------------------- knn
+def test_knn():
+    iris = ht.datasets.load_iris(split=0)
+    labels = ht.array(np.repeat([0, 1, 2], 50))
+    knn = ht.classification.KNN(iris, labels, 5)
+    acc = (knn.predict(iris).numpy() == labels.numpy()).mean()
+    assert acc > 0.9
+    one_hot = ht.classification.KNN.label_to_one_hot(labels)
+    assert one_hot.shape == (150, 3)
+    np.testing.assert_array_equal(one_hot.numpy().argmax(1), labels.numpy())
+    with pytest.raises(ValueError):
+        ht.classification.KNN(iris, ht.array([0, 1]), 3)
+    assert ht.core.base.is_classifier(knn)
+
+
+# ---------------------------------------------------------------- gaussianNB
+def test_gaussian_nb():
+    iris = ht.datasets.load_iris(split=0)
+    labels = ht.array(np.repeat([0, 1, 2], 50))
+    nb = ht.naive_bayes.GaussianNB().fit(iris, labels)
+    acc = (nb.predict(iris).numpy() == labels.numpy()).mean()
+    assert acc > 0.94
+    proba = nb.predict_proba(iris).numpy()
+    np.testing.assert_allclose(proba.sum(axis=1), 1.0, atol=1e-5)
+    # parity with sklearn
+    from sklearn.naive_bayes import GaussianNB as SkNB
+
+    sk = SkNB().fit(iris.numpy(), labels.numpy())
+    np.testing.assert_allclose(nb.theta_, sk.theta_, rtol=1e-6)
+    np.testing.assert_allclose(nb.sigma_, sk.var_, rtol=1e-5)
+    np.testing.assert_array_equal(nb.predict(iris).numpy(), sk.predict(iris.numpy()))
+
+
+def test_gaussian_nb_partial_fit():
+    iris = ht.datasets.load_iris(split=0)
+    labels_np = np.repeat([0, 1, 2], 50)
+    perm = np.random.default_rng(0).permutation(150)
+    nb = ht.naive_bayes.GaussianNB()
+    half = perm[:75], perm[75:]
+    nb.partial_fit(
+        ht.array(iris.numpy()[half[0]]), ht.array(labels_np[half[0]]), classes=[0, 1, 2]
+    )
+    nb.partial_fit(ht.array(iris.numpy()[half[1]]), ht.array(labels_np[half[1]]))
+    full = ht.naive_bayes.GaussianNB().fit(iris, ht.array(labels_np))
+    np.testing.assert_allclose(nb.theta_, full.theta_, rtol=1e-4)
+    np.testing.assert_allclose(nb.sigma_, full.sigma_, rtol=1e-3)
+    with pytest.raises(ValueError):
+        ht.naive_bayes.GaussianNB().partial_fit(iris, ht.array(labels_np))
+
+
+# ---------------------------------------------------------------- utils
+def test_parter():
+    P = ht.utils.matrixgallery.parter(30, split=0)
+    assert P.shape == (30, 30)
+    s = ht.linalg.svd(P, compute_uv=False)
+    assert abs(float(s[0].item()) - np.pi) < 1e-2
+    n = 30
+    expected = 1.0 / (np.arange(n)[:, None] - np.arange(n)[None, :] + 0.5)
+    np.testing.assert_allclose(P.numpy(), expected, rtol=1e-5)

@@ -1,0 +1,123 @@
+"""Elementwise map, logical, relational, rounding tests
+(reference: heat/core/tests/test_{exponential,trigonometrics,logical,
+relational,rounding}.py)."""
+
+import numpy as np
+import pytest
+
+import heat_tpu as ht
+
+from suite import assert_array_equal, assert_func_equal
+
+
+def test_exponential_suite():
+    assert_func_equal((4, 5), ht.exp, np.exp, low=-2, high=2)
+    assert_func_equal((4, 5), ht.expm1, np.expm1, low=-2, high=2)
+    assert_func_equal((4, 5), ht.exp2, np.exp2, low=-2, high=2)
+    assert_func_equal((4, 5), ht.log, np.log, low=0.1, high=100)
+    assert_func_equal((4, 5), ht.log2, np.log2, low=0.1, high=100)
+    assert_func_equal((4, 5), ht.log10, np.log10, low=0.1, high=100)
+    assert_func_equal((4, 5), ht.log1p, np.log1p, low=0.1, high=100)
+    assert_func_equal((4, 5), ht.sqrt, np.sqrt, low=0.0, high=100)
+
+
+def test_exp_int_promotes():
+    x = ht.arange(5, split=0)
+    assert ht.exp(x).dtype is ht.float32
+
+
+def test_trig_suite():
+    assert_func_equal((3, 7), ht.sin, np.sin)
+    assert_func_equal((3, 7), ht.cos, np.cos)
+    assert_func_equal((3, 7), ht.tan, np.tan, low=-1.3, high=1.3)
+    assert_func_equal((3, 7), ht.sinh, np.sinh, low=-3, high=3)
+    assert_func_equal((3, 7), ht.cosh, np.cosh, low=-3, high=3)
+    assert_func_equal((3, 7), ht.tanh, np.tanh)
+    assert_func_equal((3, 7), ht.arcsin, np.arcsin, low=-1, high=1)
+    assert_func_equal((3, 7), ht.arccos, np.arccos, low=-1, high=1)
+    assert_func_equal((3, 7), ht.arctan, np.arctan)
+    assert_func_equal((3, 7), ht.deg2rad, np.deg2rad, low=-360, high=360)
+    assert_func_equal((3, 7), ht.rad2deg, np.rad2deg)
+
+
+def test_arctan2():
+    a = np.array([1.0, -1.0, 0.5], dtype=np.float32)
+    b = np.array([-1.0, 2.0, 0.5], dtype=np.float32)
+    assert_array_equal(ht.arctan2(ht.array(a, split=0), ht.array(b, split=0)), np.arctan2(a, b))
+
+
+def test_rounding_suite():
+    assert_func_equal((4, 6), ht.abs, np.abs)
+    assert_func_equal((4, 6), ht.fabs, np.fabs)
+    assert_func_equal((4, 6), ht.ceil, np.ceil)
+    assert_func_equal((4, 6), ht.floor, np.floor)
+    assert_func_equal((4, 6), ht.trunc, np.trunc)
+    assert_func_equal((4, 6), ht.sign, np.sign)
+
+
+def test_clip_round_modf():
+    v = np.array([-3.7, -0.2, 0.4, 2.9], dtype=np.float32)
+    x = ht.array(v, split=0)
+    assert_array_equal(ht.clip(x, -1, 1), np.clip(v, -1, 1))
+    assert_array_equal(ht.round(x), np.round(v))
+    assert_array_equal(ht.round(x, decimals=1), np.round(v, 1))
+    fr, it = ht.modf(x)
+    nfr, nit = np.modf(v)
+    assert_array_equal(fr, nfr)
+    assert_array_equal(it, nit)
+    with pytest.raises(ValueError):
+        ht.clip(x, None, None)
+
+
+def test_abs_dtype():
+    x = ht.array([-1, 2, -3])
+    r = ht.abs(x, dtype=ht.float32)
+    assert r.dtype is ht.float32
+
+
+def test_relational_suite():
+    a = np.array([[1.0, 2.0], [3.0, 4.0]], dtype=np.float32)
+    b = np.array([[2.0, 2.0], [2.0, 2.0]], dtype=np.float32)
+    x, y = ht.array(a, split=0), ht.array(b, split=0)
+    assert_array_equal(x == y, a == b)
+    assert_array_equal(x != y, a != b)
+    assert_array_equal(x < y, a < b)
+    assert_array_equal(x <= y, a <= b)
+    assert_array_equal(x > y, a > b)
+    assert_array_equal(x >= y, a >= b)
+    assert (x == y).dtype is ht.bool
+    assert ht.equal(x, ht.array(a)) is True
+    assert ht.equal(x, y) is False
+    assert ht.equal(ht.ones(3), ht.ones((2, 3))) is False
+
+
+def test_logical_suite():
+    a = np.array([[True, False], [True, True]])
+    b = np.array([[False, False], [True, False]])
+    x, y = ht.array(a, split=0), ht.array(b, split=0)
+    assert_array_equal(ht.logical_and(x, y), a & b)
+    assert_array_equal(ht.logical_or(x, y), a | b)
+    assert_array_equal(ht.logical_xor(x, y), a ^ b)
+    assert_array_equal(ht.logical_not(x), ~a)
+    assert bool(ht.any(x)) and not bool(ht.all(x))
+    assert_array_equal(ht.all(x, axis=0), a.all(axis=0))
+    assert_array_equal(ht.any(x, axis=1), a.any(axis=1))
+
+
+def test_allclose_isclose():
+    x = ht.ones((4, 4), split=0)
+    y = ht.ones((4, 4), split=0) + 1e-9
+    assert ht.allclose(x, y)
+    assert not ht.allclose(x, y + 1.0)
+    assert_array_equal(ht.isclose(x, y), np.ones((4, 4), dtype=bool))
+
+
+def test_where_nonzero():
+    a = np.array([[0.0, 1.0], [2.0, 0.0]], dtype=np.float32)
+    x = ht.array(a, split=0)
+    nz = ht.nonzero(x)
+    np.testing.assert_array_equal(nz.numpy(), np.stack(np.nonzero(a), axis=1))
+    w = ht.where(x > 0, x, ht.zeros_like(x) - 1)
+    assert_array_equal(w, np.where(a > 0, a, -1))
+    with pytest.raises(TypeError):
+        ht.where(x > 0, x)

@@ -1,0 +1,115 @@
+"""Statistics tests vs numpy oracle (reference: heat/core/tests/test_statistics.py)."""
+
+import numpy as np
+import pytest
+
+import heat_tpu as ht
+
+from suite import assert_array_equal, assert_func_equal
+
+
+@pytest.fixture
+def data():
+    rng = np.random.default_rng(7)
+    return rng.normal(3.0, 2.0, size=(6, 8)).astype(np.float32)
+
+
+@pytest.mark.parametrize("split", [None, 0, 1])
+def test_mean_var_std(data, split):
+    x = ht.array(data, split=split)
+    assert abs(float(x.mean()) - data.mean()) < 1e-5
+    assert abs(float(x.var()) - data.var()) < 1e-4
+    assert abs(float(x.std()) - data.std()) < 1e-4
+    assert_array_equal(x.mean(axis=0), data.mean(axis=0), rtol=1e-5)
+    assert_array_equal(x.mean(axis=1), data.mean(axis=1), rtol=1e-5)
+    assert_array_equal(x.var(axis=0, ddof=1), data.var(axis=0, ddof=1), rtol=1e-4)
+    assert_array_equal(x.std(axis=1), data.std(axis=1), rtol=1e-4)
+
+
+def test_mean_int_input():
+    x = ht.arange(10, split=0)
+    assert abs(float(x.mean()) - 4.5) < 1e-6
+
+
+@pytest.mark.parametrize("split", [None, 0])
+def test_minmax_argminmax(data, split):
+    x = ht.array(data, split=split)
+    assert float(x.max()) == data.max()
+    assert float(x.min()) == data.min()
+    assert int(x.argmax()) == data.argmax()
+    assert int(x.argmin()) == data.argmin()
+    assert_array_equal(x.max(axis=0), data.max(axis=0))
+    assert_array_equal(x.argmax(axis=1), data.argmax(axis=1))
+    assert_array_equal(ht.min(x, axis=1, keepdims=True), data.min(axis=1, keepdims=True))
+
+
+def test_maximum_minimum(data):
+    other = np.flipud(data).copy()
+    x, y = ht.array(data, split=0), ht.array(other, split=0)
+    assert_array_equal(ht.maximum(x, y), np.maximum(data, other))
+    assert_array_equal(ht.minimum(x, y), np.minimum(data, other))
+
+
+def test_average(data):
+    x = ht.array(data, split=0)
+    w = np.arange(1.0, 9.0, dtype=np.float32)
+    assert_array_equal(
+        ht.average(x, axis=1, weights=ht.array(w)),
+        np.average(data, axis=1, weights=w),
+        rtol=1e-5,
+    )
+    res, wsum = ht.average(x, axis=0, returned=True)
+    assert_array_equal(res, np.average(data, axis=0), rtol=1e-5)
+
+
+def test_bincount():
+    v = np.array([0, 1, 1, 3, 2, 1, 7], dtype=np.int32)
+    x = ht.array(v, split=0)
+    assert_array_equal(ht.bincount(x), np.bincount(v))
+    assert_array_equal(ht.bincount(x, minlength=10), np.bincount(v, minlength=10))
+    w = np.arange(7, dtype=np.float32)
+    assert_array_equal(ht.bincount(x, weights=ht.array(w)), np.bincount(v, weights=w))
+
+
+def test_cov(data):
+    x = ht.array(data, split=0)
+    assert_array_equal(ht.cov(x), np.cov(data), rtol=1e-4)
+    assert_array_equal(ht.cov(x, bias=True), np.cov(data, bias=True), rtol=1e-4)
+
+
+def test_histogram(data):
+    x = ht.array(data, split=0)
+    h, edges = ht.histogram(x, bins=10)
+    nh, nedges = np.histogram(data, bins=10)
+    assert_array_equal(h, nh)
+    np.testing.assert_allclose(edges.numpy(), nedges, rtol=1e-5)
+    hc = ht.histc(x, bins=20, min=-5, max=10)
+    assert int(hc.sum()) == ((data >= -5) & (data <= 10)).sum()
+
+
+@pytest.mark.parametrize("split", [None, 0])
+def test_percentile_median(data, split):
+    x = ht.array(data, split=split)
+    for q in (10, 50, 99):
+        np.testing.assert_allclose(
+            float(ht.percentile(x, q)), np.percentile(data.astype(np.float64), q), rtol=1e-6
+        )
+    assert_array_equal(ht.median(x, axis=0), np.median(data, axis=0), rtol=1e-6)
+    assert_array_equal(
+        ht.percentile(x, 30, axis=1), np.percentile(data, 30, axis=1), rtol=1e-6
+    )
+    assert_array_equal(
+        ht.percentile(x, [25, 75]), np.percentile(data, [25, 75]), rtol=1e-6
+    )
+
+
+def test_skew_kurtosis():
+    rng = np.random.default_rng(3)
+    v = rng.exponential(2.0, size=1000).astype(np.float32)
+    x = ht.array(v, split=0)
+    from scipy import stats as sps
+
+    np.testing.assert_allclose(float(ht.skew(x, unbiased=False)), sps.skew(v), rtol=1e-3)
+    np.testing.assert_allclose(
+        float(ht.kurtosis(x, unbiased=False)), sps.kurtosis(v), rtol=1e-3
+    )
