@@ -8,9 +8,14 @@ Reports effective GB/s: bytes of the result matrix produced per second
 from __future__ import annotations
 
 import argparse
+import os
+import sys
 import time
 
 import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+from _common import bootstrap
 
 
 def main():
@@ -19,7 +24,7 @@ def main():
     parser.add_argument("--f", type=int, default=18, help="features (SUSY width)")
     parser.add_argument("--trials", type=int, default=3)
     parser.add_argument("--h5", nargs=2, metavar=("PATH", "DATASET"), default=None)
-    args = parser.parse_args()
+    args = bootstrap(parser)
 
     import heat_tpu as ht
 

@@ -8,9 +8,14 @@ pass --h5 PATH DATASET to reproduce the reference's file-driven runs.
 from __future__ import annotations
 
 import argparse
+import os
+import sys
 import time
 
 import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+from _common import bootstrap
 
 
 def main():
@@ -21,7 +26,7 @@ def main():
     parser.add_argument("--iterations", type=int, default=30)
     parser.add_argument("--trials", type=int, default=3)
     parser.add_argument("--h5", nargs=2, metavar=("PATH", "DATASET"), default=None)
-    args = parser.parse_args()
+    args = bootstrap(parser)
 
     import heat_tpu as ht
 

@@ -4,9 +4,14 @@ descent on the eurad H5 set, 1 iteration, 10 trials)."""
 from __future__ import annotations
 
 import argparse
+import os
+import sys
 import time
 
 import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+from _common import bootstrap
 
 
 def main():
@@ -16,7 +21,7 @@ def main():
     parser.add_argument("--iterations", type=int, default=1)
     parser.add_argument("--trials", type=int, default=3)
     parser.add_argument("--h5", nargs=3, metavar=("PATH", "XDSET", "YDSET"), default=None)
-    args = parser.parse_args()
+    args = bootstrap(parser)
 
     import heat_tpu as ht
 

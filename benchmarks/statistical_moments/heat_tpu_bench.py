@@ -4,9 +4,14 @@ statistical_moments/heat-cpu.py — mean/std along axis 0, 10 trials)."""
 from __future__ import annotations
 
 import argparse
+import os
+import sys
 import time
 
 import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+from _common import bootstrap
 
 
 def main():
@@ -14,7 +19,7 @@ def main():
     parser.add_argument("--n", type=int, default=10_000_000)
     parser.add_argument("--f", type=int, default=8)
     parser.add_argument("--trials", type=int, default=3)
-    args = parser.parse_args()
+    args = bootstrap(parser)
 
     import heat_tpu as ht
 

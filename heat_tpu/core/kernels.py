@@ -1,0 +1,91 @@
+"""Pallas TPU kernels for hot loops.
+
+SURVEY.md §7 reserves Pallas for the fused KMeans inner loop; this module
+implements the fused **distance + argmin** assignment: for each row block,
+the |x|²+|c|²−2xc distance tile and its argmin are computed entirely in
+VMEM — one HBM read of x per row, no (n, k) distance matrix ever
+materialized in HBM.  The centroid update remains a plain matmul (XLA is
+already optimal there).
+
+The kernel is opt-in (``assign_labels_pallas``) with a jnp fallback; on
+CPU it runs in interpret mode so the same code path is testable without a
+TPU.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+try:  # pallas is part of jax, but guard exotic builds
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    _HAS_PALLAS = True
+except Exception:  # pragma: no cover
+    _HAS_PALLAS = False
+
+__all__ = ["assign_labels_pallas", "assign_labels"]
+
+
+def _assign_kernel(x_ref, c_ref, out_ref):
+    """One row-block: d² tile in VMEM, argmin over centroids."""
+    x = x_ref[:]  # (bm, f)
+    c = c_ref[:]  # (k, f)
+    x2 = jnp.sum(x * x, axis=1, keepdims=True)  # (bm, 1)
+    c2 = jnp.sum(c * c, axis=1)[None, :]  # (1, k)
+    d2 = x2 + c2 - 2.0 * jax.lax.dot_general(
+        x, c, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    )
+    out_ref[:] = jnp.argmin(d2, axis=1).astype(jnp.int32)
+
+
+@functools.partial(jax.jit, static_argnames=("block_rows", "interpret"))
+def _assign_pallas(x, centers, block_rows: int = 1024, interpret: bool = False):
+    n, f = x.shape
+    k = centers.shape[0]
+    grid = (n // block_rows,)
+    return pl.pallas_call(
+        _assign_kernel,
+        out_shape=jax.ShapeDtypeStruct((n,), jnp.int32),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((block_rows, f), lambda i: (i, 0)),
+            pl.BlockSpec((k, f), lambda i: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((block_rows,), lambda i: (i,)),
+        interpret=interpret,
+    )(x, centers)
+
+
+def assign_labels_pallas(x, centers, block_rows: int = 1024):
+    """Fused nearest-centroid assignment via the Pallas kernel.
+
+    Pads the row count up to the block size, launches the grid, and slices
+    the padding back off.  Uses interpret mode automatically off-TPU.
+    """
+    if not _HAS_PALLAS:
+        return assign_labels(x, centers)
+    x = jnp.asarray(x, jnp.float32)
+    centers = jnp.asarray(centers, jnp.float32)
+    n = x.shape[0]
+    block_rows = min(block_rows, max(n, 8))
+    pad = (-n) % block_rows
+    if pad:
+        x = jnp.concatenate([x, jnp.zeros((pad, x.shape[1]), x.dtype)])
+    interpret = jax.devices()[0].platform not in ("tpu",)
+    labels = _assign_pallas(x, centers, block_rows=block_rows, interpret=interpret)
+    return labels[:n]
+
+
+def assign_labels(x, centers):
+    """jnp fallback: identical semantics, XLA-fused."""
+    from ..spatial.distance import quadratic_d2
+
+    return jnp.argmin(quadratic_d2(jnp.asarray(x), jnp.asarray(centers)), axis=1).astype(
+        jnp.int32
+    )
