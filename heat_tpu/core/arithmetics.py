@@ -36,6 +36,7 @@ __all__ = [
     "invert",
     "left_shift",
     "mod",
+    "remainder",
     "mul",
     "multiply",
     "pow",
@@ -93,6 +94,12 @@ floor_divide = floordiv
 def fmod(t1, t2, out=None):
     """Elementwise C-semantics remainder (reference arithmetics.py:478-523)."""
     return _operations.__binary_op(jnp.fmod, t1, t2, out)
+
+
+def remainder(t1, t2, out=None):
+    """Element-wise ``t1 % t2`` with Python sign semantics
+    (reference arithmetics.py:719-760; ``mod`` is its alias there)."""
+    return _operations.__binary_op(jnp.mod, t1, t2, out)
 
 
 def mod(t1, t2, out=None):

@@ -48,6 +48,7 @@ __all__ = [
     "resplit",
     "rot90",
     "row_stack",
+    "shape",
     "sort",
     "split",
     "squeeze",
@@ -282,6 +283,15 @@ def sort(a: DNDarray, axis: int = -1, descending: bool = False, out=None):
         out.larray = vals.larray
         return out, idx
     return vals, idx
+
+
+def shape(a: DNDarray) -> tuple:
+    """Global shape of ``a`` (reference manipulations.py:1874-1891)."""
+    from .dndarray import DNDarray
+
+    if not isinstance(a, DNDarray):
+        raise TypeError(f"Expected a to be a DNDarray but was {type(a)}")
+    return a.gshape
 
 
 def split(x: DNDarray, indices_or_sections, axis: int = 0) -> List[DNDarray]:

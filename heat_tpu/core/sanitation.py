@@ -19,6 +19,7 @@ __all__ = [
     "sanitize_in",
     "sanitize_infinity",
     "sanitize_in_tensor",
+    "sanitize_lshape",
     "sanitize_out",
     "sanitize_sequence",
     "scalar_to_1d",
@@ -51,6 +52,31 @@ def sanitize_infinity(x) -> Union[int, float]:
     if types.heat_type_is_exact(dt):
         return types.iinfo(dt).max
     return float("inf")
+
+
+def sanitize_lshape(array, tensor) -> None:
+    """Verify ``tensor`` is a legal replacement for ``array``'s local shard
+    (reference sanitation.py:69-108): non-split axes must match the global
+    shape; the split axis may differ (shards vary in size)."""
+    tshape = tuple(tensor.shape)
+    if tshape == tuple(array.lshape):
+        return
+    gshape = tuple(array.gshape)
+    split = array.split
+    if split is None:
+        non_zero = [i for i in range(len(tshape)) if tshape[i] != 0]
+        if all(tshape[i] == gshape[i] for i in non_zero):
+            return
+        raise ValueError(
+            f"Shape of local tensor is inconsistent with global DNDarray: "
+            f"tensor.shape is {tshape}, should be {gshape}"
+        )
+    if tshape[:split] + tshape[split + 1 :] == gshape[:split] + gshape[split + 1 :]:
+        return
+    raise ValueError(
+        f"Shape of local tensor along non-split axes is inconsistent with global "
+        f"DNDarray: tensor.shape is {tshape}, DNDarray is {gshape}"
+    )
 
 
 def sanitize_out(out: Any, output_shape, output_split, output_device, output_comm=None) -> None:

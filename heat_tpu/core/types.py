@@ -42,12 +42,14 @@ __all__ = [
     "bool",
     "bool_",
     "uint8",
+    "ubyte",
     "int8",
     "byte",
     "int16",
     "short",
     "int32",
     "int",
+    "int_",
     "int64",
     "long",
     "float16",
@@ -188,9 +190,11 @@ class float64(floating):
 
 
 # aliases (reference types.py:211-240)
+ubyte = uint8
 byte = int8
 short = int16
 int = int32  # noqa: A001
+int_ = int32
 long = int64
 half = float16
 float = float32  # noqa: A001
@@ -216,8 +220,10 @@ __dtype_map = {np.dtype(c._np_type): c for c in _CONCRETE}
 __name_map = {c.__name__: c for c in _CONCRETE}
 __name_map.update(
     {
+        "ubyte": uint8,
         "byte": int8,
         "short": int16,
+        "int_": int32,
         "int": int32,
         "long": int64,
         "half": float16,
