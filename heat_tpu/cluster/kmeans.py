@@ -74,9 +74,36 @@ class KMeans(_KCluster):
         shift = jnp.sum((new_centers - centers) ** 2)
         return labels, new_centers, shift
 
+    @staticmethod
+    @jax.jit
+    def _fit_loop(arr, centers, tol, max_iter):
+        """The ENTIRE Lloyd fit as one compiled program: a
+        ``lax.while_loop`` over fused assign+update steps, the final
+        labels, and the inertia.  One dispatch, one host sync per fit —
+        the host never sees intermediate state (the reference's per-epoch
+        convergence check, kmeans.py:106-118, costs a device round trip
+        per iteration; on a remote/tunneled TPU that round trip dwarfs the
+        step kernel itself)."""
+        from ..spatial.distance import quadratic_d2
+
+        def cond(state):
+            it, _, shift = state
+            return jnp.logical_and(it < max_iter, shift > tol)
+
+        def body(state):
+            it, c, _ = state
+            _, nc, shift = KMeans._step(arr, c)
+            return it + 1, nc, shift
+
+        init = (jnp.int32(0), centers, jnp.float32(jnp.inf))
+        n_iter, centers, _ = jax.lax.while_loop(cond, body, init)
+        labels = jnp.argmin(quadratic_d2(arr, centers), axis=1)
+        inertia = jnp.sum((arr - centers[labels]) ** 2)
+        return centers, labels, n_iter, inertia
+
     def fit(self, x: DNDarray) -> "KMeans":
         """Lloyd iterations until centroid shift ≤ tol (reference
-        kmeans.py:87-120)."""
+        kmeans.py:87-120), as a single on-device loop."""
         sanitize_in(x)
         if x.ndim != 2:
             raise ValueError(f"input needs to be 2D, but was {x.ndim}D")
@@ -84,15 +111,10 @@ class KMeans(_KCluster):
         arr = x.larray.astype(jnp.float32)
         centers = self._cluster_centers.larray.astype(jnp.float32)
 
-        for epoch in range(self.max_iter):
-            _, centers, shift = KMeans._step(arr, centers)
-            self._n_iter = epoch + 1
-            if float(shift) <= self.tol:
-                break
-
-        # final assignment against the FINAL centers, so labels_ always
-        # agrees with predict() (the loop's labels are one update stale)
-        labels, _, _ = KMeans._step(arr, centers)
+        centers, labels, n_iter, inertia = KMeans._fit_loop(
+            arr, centers, jnp.float32(self.tol), jnp.int32(self.max_iter)
+        )
+        self._n_iter = int(n_iter)
 
         self._cluster_centers = DNDarray(
             centers.astype(x.dtype.jax_type()),
@@ -110,6 +132,5 @@ class KMeans(_KCluster):
             lab, tuple(lab.shape), types.int64, x.split if x.split == 0 else None,
             x.device, x.comm, True,
         )
-        d2 = jnp.sum((arr - centers[labels]) ** 2)
-        self._inertia = float(d2)
+        self._inertia = float(inertia)
         return self

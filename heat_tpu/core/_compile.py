@@ -1,0 +1,48 @@
+"""Cached-jit dispatch for the op engine.
+
+Every user-level op (``ht.add``, ``ht.mean``, ``ht.sqrt`` …) runs a short
+chain of jnp primitives.  Dispatching those eagerly costs one host↔device
+round trip *per primitive* — on a tunneled/remote TPU that is ~50 ms each,
+three orders of magnitude above the kernel time.  The reference never faces
+this (torch eager ops run in-process, reference heat/core/_operations.py
+drives local torch kernels directly); the TPU-native answer is to compile
+each op chain once and replay the cached executable.
+
+``jitted(key, make_fn)`` memoizes ``jax.jit(make_fn())`` under a hashable
+key describing the op and its static parameters (axis, kwargs, cast dtype,
+scalar operands).  Subsequent calls with the same key skip tracing and
+lowering entirely — XLA replays the compiled program, fusing the whole op
+chain into one device round trip.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Tuple
+
+import jax
+
+__all__ = ["jitted", "clear_cache", "cache_size"]
+
+_CACHE: Dict[Tuple, Any] = {}
+
+
+def jitted(key: Tuple, make_fn: Callable[[], Callable]) -> Callable:
+    """Return a cached ``jax.jit`` of ``make_fn()`` memoized under ``key``.
+
+    ``make_fn`` is only invoked on a cache miss; it should return a function
+    closing over all static parameters named in ``key``.
+    """
+    fn = _CACHE.get(key)
+    if fn is None:
+        fn = jax.jit(make_fn())
+        _CACHE[key] = fn
+    return fn
+
+
+def clear_cache() -> None:
+    """Drop all cached executables (mainly for tests)."""
+    _CACHE.clear()
+
+
+def cache_size() -> int:
+    return len(_CACHE)

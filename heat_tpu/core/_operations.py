@@ -27,11 +27,23 @@ import numpy as np
 import jax.numpy as jnp
 
 from . import factories, sanitation, types
+from ._compile import jitted
 from .communication import sanitize_comm
 from .dndarray import DNDarray
 from .stride_tricks import broadcast_shape, sanitize_axis
 
 __all__ = ["__binary_op", "__local_op", "__reduce_op", "__cum_op"]
+
+
+def _freeze(kwargs: dict):
+    """Hashable view of an op's static kwargs, or None if not hashable
+    (→ caller falls back to eager dispatch)."""
+    try:
+        items = tuple(sorted(kwargs.items()))
+        hash(items)
+        return items
+    except TypeError:
+        return None
 
 
 def __binary_op(
@@ -75,8 +87,29 @@ def __binary_op(
     a2 = t2 if np.isscalar(t2) else (t2.larray if isinstance(t2, DNDarray) else jnp.asarray(t2))
 
     # heat dtype promotion (reference :138; delegated to the jax lattice,
-    # which implements the same torch-flavored rules)
-    result = operation(a1, a2, **fn_kwargs)
+    # which implements the same torch-flavored rules).  Python scalars are
+    # pre-cast with weak-type promotion (jnp.result_type treats them as
+    # weak) so they can be jit *arguments* — the compiled executable is
+    # reused across scalar values instead of recompiling per constant.
+    try:
+        if np.isscalar(a1):
+            a1 = jnp.asarray(a1, dtype=jnp.result_type(a2.dtype, a1))
+        elif np.isscalar(a2):
+            a2 = jnp.asarray(a2, dtype=jnp.result_type(a1.dtype, a2))
+    except OverflowError:
+        # e.g. uint8 array + 300: the weak-type result dtype cannot hold the
+        # scalar.  Keep the python scalar and fall through to the eager path,
+        # which reproduces jnp's wrapping semantics for out-of-range scalars.
+        pass
+    statics = _freeze(fn_kwargs) if not (np.isscalar(a1) or np.isscalar(a2)) else None
+    if statics is not None:
+        fn = jitted(
+            ("binary", operation, statics),
+            lambda: lambda x, y: operation(x, y, **fn_kwargs),
+        )
+        result = fn(a1, a2)
+    else:
+        result = operation(a1, a2, **fn_kwargs)
     out_dtype = types.canonical_heat_type(result.dtype)
 
     # split of the result: anchor's split, adjusted for broadcasting
@@ -115,9 +148,18 @@ def __local_op(
         raise TypeError(f"expected out to be None or a DNDarray, but was {type(out)}")
 
     arr = x.larray
+    cast = None
     if not no_cast and types.heat_type_is_exact(x.dtype):
-        arr = arr.astype(jnp.float32 if x.dtype is not types.int64 else jnp.float64)
-    result = operation(arr, **kwargs)
+        cast = jnp.float32 if x.dtype is not types.int64 else jnp.float64
+    statics = _freeze(kwargs)
+    if statics is not None:
+        fn = jitted(
+            ("local", operation, cast, statics),
+            lambda: lambda a: operation(a.astype(cast) if cast else a, **kwargs),
+        )
+        result = fn(arr)
+    else:
+        result = operation(arr.astype(cast) if cast else arr, **kwargs)
     dtype = types.canonical_heat_type(result.dtype)
     result = x.comm.apply_sharding(result, x.split if result.ndim else None)
     wrapped = DNDarray(result, tuple(result.shape), dtype, x.split, x.device, x.comm, x.balanced)
@@ -151,10 +193,22 @@ def __reduce_op(
     axis = sanitize_axis(x.shape, axis)
     keepdims = bool(keepdims) if keepdims is not None else False
 
-    result = reduction(x.larray, axis=axis, keepdims=keepdims, **kwargs)
     if dtype is not None:
         dtype = types.canonical_heat_type(dtype)
-        result = result.astype(dtype.jax_type())
+    cast = dtype.jax_type() if dtype is not None else None
+    statics = _freeze(kwargs)
+    if statics is not None:
+        fn = jitted(
+            ("reduce", reduction, axis, keepdims, cast, statics),
+            lambda: lambda a: (
+                lambda r: r.astype(cast) if cast is not None else r
+            )(reduction(a, axis=axis, keepdims=keepdims, **kwargs)),
+        )
+        result = fn(x.larray)
+    else:
+        result = reduction(x.larray, axis=axis, keepdims=keepdims, **kwargs)
+        if cast is not None:
+            result = result.astype(cast)
     out_dtype = types.canonical_heat_type(result.dtype)
 
     # split bookkeeping (reference :446-456)
@@ -194,10 +248,16 @@ def __cum_op(
     axis = sanitize_axis(x.shape, axis)
     if axis is None:
         raise NotImplementedError("cumulative operations require an explicit axis")
-    result = operation(x.larray, axis=axis)
     if dtype is not None:
         dtype = types.canonical_heat_type(dtype)
-        result = result.astype(dtype.jax_type())
+    cast = dtype.jax_type() if dtype is not None else None
+    fn = jitted(
+        ("cum", operation, axis, cast),
+        lambda: lambda a: (
+            lambda r: r.astype(cast) if cast is not None else r
+        )(operation(a, axis=axis)),
+    )
+    result = fn(x.larray)
     out_dtype = types.canonical_heat_type(result.dtype)
     result = x.comm.apply_sharding(result, x.split)
     wrapped = DNDarray(result, tuple(result.shape), out_dtype, x.split, x.device, x.comm, x.balanced)

@@ -234,7 +234,11 @@ class XlaCommunication(Communication):
         the reference, where layout errors corrupt results).
         """
         if self.size == 1:
-            split = None  # single device: everything is trivially replicated
+            # single device: every layout is trivially correct — skip the
+            # device_put dispatch when the data already lives on our device
+            if getattr(array, "devices", None) and array.devices() == {self._devices[0]}:
+                return array
+            split = None
         sh = self.sharding(array.ndim, split)
         if split is None or array.shape[split] % self.size == 0:
             return jax.device_put(array, sh)

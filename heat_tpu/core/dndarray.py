@@ -253,9 +253,15 @@ class DNDarray:
 
     @property
     def sharding(self):
-        """The actual NamedSharding of the backing array (TPU-native
-        introspection; no reference analog)."""
-        return self.__array.sharding
+        """The semantic NamedSharding of this array over its comm's mesh
+        (TPU-native introspection; no reference analog).
+
+        Derived from (comm, split) rather than read off the backing array:
+        on a single-device comm the backing array may carry a plain
+        SingleDeviceSharding (the apply_sharding fast path skips the
+        device_put), but the NamedSharding contract — ``.spec`` access,
+        mesh introspection — holds either way."""
+        return self.__comm.sharding(self.ndim, self.__split)
 
     # ------------------------------------------------------------------ #
     # conversion / export                                                #

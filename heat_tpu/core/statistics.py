@@ -18,6 +18,7 @@ import numpy as np
 import jax.numpy as jnp
 
 from . import _operations, factories, types
+from ._compile import jitted
 from .dndarray import DNDarray
 from .sanitation import sanitize_in
 from .stride_tricks import sanitize_axis
@@ -44,28 +45,24 @@ __all__ = [
 ]
 
 
+def _argmax_op(a, axis=None, keepdims=False):
+    return jnp.argmax(a, axis=axis, keepdims=keepdims)
+
+
+def _argmin_op(a, axis=None, keepdims=False):
+    return jnp.argmin(a, axis=axis, keepdims=keepdims)
+
+
 def argmax(x, axis=None, out=None, **kwargs):
     """Index of the global maximum (reference statistics.py:41-112; the
     MPI_ARGMAX packed-buffer reduction :1124-1168 is XLA's variadic
     reduce)."""
-    return _operations.__reduce_op(
-        lambda a, axis=None, keepdims=False: jnp.argmax(a, axis=axis, keepdims=keepdims),
-        x,
-        axis,
-        out,
-        dtype=types.int64,
-    )
+    return _operations.__reduce_op(_argmax_op, x, axis, out, dtype=types.int64)
 
 
 def argmin(x, axis=None, out=None, **kwargs):
     """Index of the global minimum (reference statistics.py:113-185)."""
-    return _operations.__reduce_op(
-        lambda a, axis=None, keepdims=False: jnp.argmin(a, axis=axis, keepdims=keepdims),
-        x,
-        axis,
-        out,
-        dtype=types.int64,
-    )
+    return _operations.__reduce_op(_argmin_op, x, axis, out, dtype=types.int64)
 
 
 def average(x: DNDarray, axis=None, weights: Optional[DNDarray] = None, returned: bool = False):
@@ -262,11 +259,12 @@ def mean(x, axis=None):
     combination is XLA's)."""
     sanitize_in(x)
     axis = sanitize_axis(x.shape, axis)
-    arr = x.larray
-    if types.heat_type_is_exact(x.dtype):
-        arr = arr.astype(jnp.float32)
-    res = jnp.mean(arr, axis=axis)
-    return _wrap_reduced(x, res, axis)
+    cast = jnp.float32 if types.heat_type_is_exact(x.dtype) else None
+    fn = jitted(
+        ("stat.mean", axis, cast),
+        lambda: lambda a: jnp.mean(a.astype(cast) if cast else a, axis=axis),
+    )
+    return _wrap_reduced(x, fn(x.larray), axis)
 
 
 def median(x: DNDarray, axis=None, out=None, keepdims: bool = False):
@@ -310,12 +308,29 @@ def percentile(x: DNDarray, q, axis=None, out=None, interpolation: str = "linear
     return result
 
 
-def std(x, axis=None, ddof: int = 0, **kwargs):
-    """Standard deviation (reference statistics.py:1466-1558)."""
-    v = var(x, axis, ddof=ddof, **kwargs)
-    from . import exponential
+def _moment2(x, axis, ddof, kwargs, name, finalize):
+    """Shared var/std engine: ddof/bessel semantics + one fused executable
+    (``finalize`` is identity for var, sqrt for std)."""
+    sanitize_in(x)
+    if "bessel" in kwargs:
+        ddof = 1 if kwargs.pop("bessel") else 0
+    if ddof not in (0, 1):
+        raise ValueError(f"ddof must be 0 or 1, got {ddof}")
+    axis = sanitize_axis(x.shape, axis)
+    cast = jnp.float32 if types.heat_type_is_exact(x.dtype) else None
+    fn = jitted(
+        (name, axis, ddof, cast),
+        lambda: lambda a: finalize(
+            jnp.var(a.astype(cast) if cast else a, axis=axis, ddof=ddof)
+        ),
+    )
+    return _wrap_reduced(x, fn(x.larray), axis)
 
-    return exponential.sqrt(v)
+
+def std(x, axis=None, ddof: int = 0, **kwargs):
+    """Standard deviation (reference statistics.py:1466-1558) — one fused
+    sqrt(var) executable rather than two dispatches."""
+    return _moment2(x, axis, ddof, kwargs, "stat.std", jnp.sqrt)
 
 
 def var(x, axis=None, ddof: int = 0, **kwargs):
@@ -324,14 +339,4 @@ def var(x, axis=None, ddof: int = 0, **kwargs):
 
     Note: like the reference, ``ddof`` ∈ {0, 1} (bessel correction via
     ``bessel=True`` kwarg is also accepted)."""
-    sanitize_in(x)
-    if "bessel" in kwargs:
-        ddof = 1 if kwargs.pop("bessel") else 0
-    if ddof not in (0, 1):
-        raise ValueError(f"ddof must be 0 or 1, got {ddof}")
-    axis = sanitize_axis(x.shape, axis)
-    arr = x.larray
-    if types.heat_type_is_exact(x.dtype):
-        arr = arr.astype(jnp.float32)
-    res = jnp.var(arr, axis=axis, ddof=ddof)
-    return _wrap_reduced(x, res, axis)
+    return _moment2(x, axis, ddof, kwargs, "stat.var", lambda r: r)

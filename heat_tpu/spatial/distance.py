@@ -21,6 +21,7 @@ from typing import Optional
 import jax.numpy as jnp
 
 from ..core import types
+from ..core._compile import jitted
 from ..core.dndarray import DNDarray
 from ..core.sanitation import sanitize_in
 
@@ -75,7 +76,11 @@ def cdist(X: DNDarray, Y: Optional[DNDarray] = None, quadratic_expansion: bool =
     default, like the reference's torch.cdist.
     """
     xa, ya, dtype = _prep(X, Y)
-    return _wrap(X, _euclidean(xa, ya, quadratic_expansion), dtype)
+    fn = jitted(
+        ("dist.euclidean", quadratic_expansion),
+        lambda: lambda a, b: _euclidean(a, b, quadratic_expansion),
+    )
+    return _wrap(X, fn(xa, ya), dtype)
 
 
 def rbf(
@@ -87,17 +92,28 @@ def rbf(
     """Gaussian (RBF) kernel matrix exp(−d²/2σ²)
     (reference distance.py:173-179)."""
     xa, ya, dtype = _prep(X, Y)
-    if quadratic_expansion:
-        d2 = quadratic_d2(xa, ya)
-    else:
-        diff = xa[:, None, :] - ya[None, :, :]
-        d2 = jnp.sum(diff * diff, axis=-1)
-    return _wrap(X, jnp.exp(-d2 / (2.0 * sigma * sigma)), dtype)
+
+    def _make():
+        def _rbf(a, b, sig):
+            if quadratic_expansion:
+                d2 = quadratic_d2(a, b)
+            else:
+                diff = a[:, None, :] - b[None, :, :]
+                d2 = jnp.sum(diff * diff, axis=-1)
+            return jnp.exp(-d2 / (2.0 * sig * sig))
+
+        return _rbf
+
+    fn = jitted(("dist.rbf", quadratic_expansion), _make)
+    return _wrap(X, fn(xa, ya, jnp.asarray(sigma, xa.dtype)), dtype)
 
 
 def manhattan(X: DNDarray, Y: Optional[DNDarray] = None, expand: bool = False) -> DNDarray:
     """Pairwise L1 distances (reference distance.py:180-186)."""
     xa, ya, dtype = _prep(X, Y)
     del expand  # accepted for API parity; one formulation here
-    d = jnp.sum(jnp.abs(xa[:, None, :] - ya[None, :, :]), axis=-1)
-    return _wrap(X, d, dtype)
+    fn = jitted(
+        ("dist.manhattan",),
+        lambda: lambda a, b: jnp.sum(jnp.abs(a[:, None, :] - b[None, :, :]), axis=-1),
+    )
+    return _wrap(X, fn(xa, ya), dtype)
