@@ -77,10 +77,16 @@ class _KCluster(ClusteringMixin, BaseEstimator):
 
     @property
     def inertia_(self) -> float:
+        # fit() leaves device scalars in place so it never blocks on the
+        # host; the sync happens (once) here on first access
+        if self._inertia is not None and not isinstance(self._inertia, float):
+            self._inertia = float(self._inertia)
         return self._inertia
 
     @property
     def n_iter_(self) -> int:
+        if self._n_iter is not None and not isinstance(self._n_iter, int):
+            self._n_iter = int(self._n_iter)
         return self._n_iter
 
     def _initialize_cluster_centers(self, x: DNDarray):

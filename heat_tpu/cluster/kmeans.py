@@ -60,31 +60,28 @@ class KMeans(_KCluster):
 
     @staticmethod
     @jax.jit
-    def _step(arr, centers):
-        """One Lloyd iteration: fused assign + masked-matmul update.
-        Runs entirely on-device; under a sharded mesh GSPMD reduces the
-        (k, f) partials with a single all-reduce."""
-        from ..spatial.distance import quadratic_d2
-
-        labels = jnp.argmin(quadratic_d2(arr, centers), axis=1)
-        sel = jax.nn.one_hot(labels, centers.shape[0], dtype=arr.dtype)  # (n, k)
-        sums = jnp.matmul(sel.T, arr)  # (k, f) — the MXU-native masked sum
-        counts = jnp.sum(sel, axis=0)[:, None]  # (k, 1)
-        new_centers = jnp.where(counts > 0, sums / jnp.maximum(counts, 1), centers)
-        shift = jnp.sum((new_centers - centers) ** 2)
-        return labels, new_centers, shift
-
-    @staticmethod
-    @jax.jit
     def _fit_loop(arr, centers, tol, max_iter):
         """The ENTIRE Lloyd fit as one compiled program: a
         ``lax.while_loop`` over fused assign+update steps, the final
-        labels, and the inertia.  One dispatch, one host sync per fit —
+        labels, and the inertia.  One dispatch, zero host syncs per fit —
         the host never sees intermediate state (the reference's per-epoch
         convergence check, kmeans.py:106-118, costs a device round trip
         per iteration; on a remote/tunneled TPU that round trip dwarfs the
-        step kernel itself)."""
-        from ..spatial.distance import quadratic_d2
+        step kernel itself).  The |x|² term of the quadratic expansion is
+        loop-invariant and hoisted — each iteration reads ``arr`` for the
+        two matmuls only."""
+
+        x2 = jnp.sum(arr * arr, axis=1, keepdims=True)  # (n, 1), hoisted
+
+        def step(c):
+            c2 = jnp.sum(c * c, axis=1)[None, :]  # (1, k)
+            d2 = x2 + c2 - 2.0 * jnp.matmul(arr, c.T)
+            labels = jnp.argmin(d2, axis=1)
+            sel = jax.nn.one_hot(labels, c.shape[0], dtype=arr.dtype)
+            sums = jnp.matmul(sel.T, arr)  # (k, f) masked sum on the MXU
+            counts = jnp.sum(sel, axis=0)[:, None]
+            nc = jnp.where(counts > 0, sums / jnp.maximum(counts, 1), c)
+            return labels, nc
 
         def cond(state):
             it, _, shift = state
@@ -92,12 +89,13 @@ class KMeans(_KCluster):
 
         def body(state):
             it, c, _ = state
-            _, nc, shift = KMeans._step(arr, c)
+            _, nc = step(c)
+            shift = jnp.sum((nc - c) ** 2)
             return it + 1, nc, shift
 
         init = (jnp.int32(0), centers, jnp.float32(jnp.inf))
         n_iter, centers, _ = jax.lax.while_loop(cond, body, init)
-        labels = jnp.argmin(quadratic_d2(arr, centers), axis=1)
+        labels, _ = step(centers)
         inertia = jnp.sum((arr - centers[labels]) ** 2)
         return centers, labels, n_iter, inertia
 
@@ -114,7 +112,9 @@ class KMeans(_KCluster):
         centers, labels, n_iter, inertia = KMeans._fit_loop(
             arr, centers, jnp.float32(self.tol), jnp.int32(self.max_iter)
         )
-        self._n_iter = int(n_iter)
+        # device scalars; n_iter_/inertia_ properties sync lazily on access,
+        # so fit() itself never blocks on (or round-trips through) the host
+        self._n_iter = n_iter
 
         self._cluster_centers = DNDarray(
             centers.astype(x.dtype.jax_type()),
@@ -132,5 +132,5 @@ class KMeans(_KCluster):
             lab, tuple(lab.shape), types.int64, x.split if x.split == 0 else None,
             x.device, x.comm, True,
         )
-        self._inertia = float(inertia)
+        self._inertia = inertia
         return self
