@@ -77,7 +77,7 @@ def heat_kmeans_rate(data: np.ndarray, init: np.ndarray):
     t_lo = min(_timed_fit(KMeans, init_nd, X, lo) for _ in range(3))
     t_hi = min(_timed_fit(KMeans, init_nd, X, hi) for _ in range(3))
     per_iter = max((t_hi - t_lo) / (hi - lo), 1e-9)
-    return 1.0 / per_iter, X, ht
+    return 1.0 / per_iter, X
 
 
 def aux_metrics(data: np.ndarray, X):
@@ -102,7 +102,9 @@ def aux_metrics(data: np.ndarray, X):
         # DCE, and the full-tile sum prevents narrowing the matmul to the
         # few elements a slice fence would need
         def body(i, carry):
-            d = quadratic_d2(x + carry, x)
+            # sqrt included: the public cdist applies it after the quadratic
+            # expansion (heat_tpu/spatial/distance.py _euclidean)
+            d = jnp.sqrt(quadratic_d2(x + carry, x))
             return jnp.sum(d) * 1e-12
 
         return jax.lax.fori_loop(0, reps, body, jnp.float32(0.0))
@@ -138,7 +140,7 @@ def aux_metrics(data: np.ndarray, X):
 
 def main():
     data, centers = make_blobs()
-    heat_rate, X, ht = heat_kmeans_rate(data, centers)
+    heat_rate, X = heat_kmeans_rate(data, centers)
     cdist_gbs, moments_gbs = aux_metrics(data, X)
     numpy_rate = numpy_kmeans_rate(data, centers)
     print(

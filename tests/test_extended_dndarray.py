@@ -1,0 +1,234 @@
+"""Extended DNDarray container tests: distributed indexing, data movement,
+and metadata — mirroring reference heat/core/tests/test_dndarray.py and the
+__getitem__/__setitem__/resplit_/redistribute_/balance_ scenarios of
+dndarray.py:1476-3339."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import heat_tpu as ht
+from suite import assert_array_equal
+
+RNG = np.random.default_rng(11)
+T = RNG.normal(size=(13, 7)).astype(np.float32)
+T3 = RNG.normal(size=(5, 6, 4)).astype(np.float32)
+
+
+# ------------------------------------------------------------------ indexing
+@pytest.mark.parametrize("split", [None, 0, 1])
+def test_getitem_matrix(split):
+    X = ht.array(T, split=split)
+    cases = [
+        np.s_[0], np.s_[-1], np.s_[3:9], np.s_[::2], np.s_[::-1],
+        np.s_[:, 2], np.s_[:, -3], np.s_[2:5, 1:4], np.s_[:, ::2],
+        np.s_[5, 3], np.s_[..., 1], np.s_[None, :, :],
+    ]
+    for key in cases:
+        got = X[key]
+        exp = T[key]
+        if np.isscalar(exp) or exp.ndim == 0:
+            assert float(got) == pytest.approx(float(exp), rel=1e-6)
+        else:
+            assert_array_equal(got, exp)
+
+
+@pytest.mark.parametrize("split", [None, 0])
+def test_getitem_fancy(split):
+    X = ht.array(T, split=split)
+    idx = np.array([0, 5, 12, 3, 5])
+    assert_array_equal(X[ht.array(idx)], T[idx])
+    mask = T[:, 0] > 0
+    assert_array_equal(X[ht.array(mask, split=split)], T[mask])
+
+
+def test_getitem_3d():
+    X = ht.array(T3, split=1)
+    assert_array_equal(X[:, 2, :], T3[:, 2, :])
+    assert_array_equal(X[1], T3[1])
+    assert_array_equal(X[:, 1:5:2, ::-1], T3[:, 1:5:2, ::-1])
+
+
+@pytest.mark.parametrize("split", [None, 0, 1])
+def test_setitem_matrix(split):
+    cases = [
+        (np.s_[0], 9.0),
+        (np.s_[3:9], 1.5),
+        (np.s_[:, 2], -2.0),
+        (np.s_[2:5, 1:4], 0.0),
+        (np.s_[-1], 7.0),
+    ]
+    for key, val in cases:
+        X = ht.array(T.copy(), split=split)
+        X[key] = val
+        exp = T.copy()
+        exp[key] = val
+        assert_array_equal(X, exp)
+
+
+def test_setitem_array_value():
+    X = ht.array(T.copy(), split=0)
+    row = np.arange(7, dtype=np.float32)
+    X[4] = ht.array(row)
+    exp = T.copy(); exp[4] = row
+    assert_array_equal(X, exp)
+    X[1:3] = ht.array(np.stack([row, row + 1]), split=0)
+    exp[1:3] = np.stack([row, row + 1])
+    assert_array_equal(X, exp)
+
+
+def test_getitem_result_split_metadata():
+    X = ht.array(T, split=0)
+    assert X[3:9].split == 0          # slicing along split keeps split
+    assert X[:, 2].split == 0          # split axis survives (still axis 0)
+    Y = ht.array(T, split=1)
+    assert Y[3:9].split == 1
+    sub = Y[:, 2]                      # split axis consumed by integer index
+    assert sub.split in (None, 0)
+    assert_array_equal(sub, T[:, 2])
+
+
+# ------------------------------------------------------------- data movement
+@pytest.mark.parametrize("src", [None, 0, 1])
+@pytest.mark.parametrize("dst", [None, 0, 1])
+def test_resplit_all_pairs(src, dst):
+    X = ht.array(T, split=src)
+    Y = ht.resplit(X, dst)
+    assert Y.split == dst
+    assert_array_equal(Y, T)
+    # in-place flavor
+    Z = ht.array(T, split=src)
+    Z.resplit_(dst)
+    assert Z.split == dst
+    assert_array_equal(Z, T)
+
+
+def test_resplit_negative_axis():
+    X = ht.array(T, split=0)
+    Y = ht.resplit(X, -1)
+    assert Y.split == 1
+    assert_array_equal(Y, T)
+
+
+def test_balance_after_ragged_getitem():
+    X = ht.array(np.arange(40, dtype=np.float32), split=0)
+    Y = X[X > 25.0]            # data-dependent, likely unbalanced
+    Y.balance_()
+    assert Y.is_balanced()
+    assert_array_equal(Y, np.arange(26, 40, dtype=np.float32))
+
+
+def test_redistribute_contract():
+    # design decision (vs reference dndarray.py:2560): heat_tpu keeps the
+    # canonical equal-block GSPMD layout, so redistribute_ warns and keeps
+    # the value/metadata intact instead of moving shards around
+    X = ht.array(np.arange(16, dtype=np.float32), split=0)
+    nshards = int(X.lshape_map.shape[0])
+    target = np.zeros(nshards, dtype=int)
+    target[0] = 16              # everything to shard 0
+    with pytest.warns(UserWarning):
+        X.redistribute_(target_map=target)
+    assert X.split == 0
+    assert_array_equal(X, np.arange(16, dtype=np.float32))
+    X.balance_()
+    assert X.is_balanced()
+    assert_array_equal(X, np.arange(16, dtype=np.float32))
+
+
+def test_lshape_map_tiles_global():
+    for split in (0, 1):
+        X = ht.array(T, split=split)
+        lmap = X.lshape_map
+        assert lmap[:, split].sum() == T.shape[split]
+        off = 0
+        for r in range(lmap.shape[0]):
+            off += int(lmap[r, split])
+        assert off == T.shape[split]
+
+
+def test_halo_values():
+    X = ht.array(np.arange(32, dtype=np.float32).reshape(16, 2), split=0)
+    X.get_halo(2)
+    wh = X.array_with_halos
+    # the halo-extended local block must be a contiguous slice of the global
+    arr = np.asarray(wh)
+    flat = np.arange(32, dtype=np.float32).reshape(16, 2)
+    # find arr as a window of flat
+    n = arr.shape[0]
+    found = any(np.array_equal(arr, flat[i : i + n]) for i in range(16 - n + 1))
+    assert found
+
+
+# ------------------------------------------------------------------ metadata
+def test_properties_roundtrip():
+    X = ht.array(T, split=1)
+    assert X.gshape == (13, 7)
+    assert X.ndim == 2
+    assert X.size == 91
+    assert X.gnumel == 91
+    assert X.nbytes == 91 * 4
+    assert X.dtype == ht.float32
+    assert X.split == 1
+    assert isinstance(X.lnumel, int)
+    assert X.lshape[0] == 13
+
+
+def test_astype_all_targets():
+    X = ht.array(T, split=0)
+    for t in (ht.float64, ht.int32, ht.int64, ht.bool, ht.uint8, ht.float16):
+        Y = X.astype(t)
+        assert Y.dtype == t
+        assert Y.split == 0
+    # astype keeps values
+    assert_array_equal(X.astype(ht.int32), T.astype(np.int32))
+
+
+def test_flatten_ravel_T():
+    X = ht.array(T, split=0)
+    assert_array_equal(X.flatten(), T.flatten())
+    assert_array_equal(X.ravel(), T.ravel())
+    assert_array_equal(X.T, T.T)
+    assert X.T.split == 1  # transpose remaps the split axis
+
+
+def test_comparison_dunders_produce_bool():
+    X = ht.array(T, split=0)
+    assert (X > 0).dtype == ht.bool
+    assert_array_equal(X > 0, T > 0)
+    assert_array_equal(X == X, np.ones_like(T, bool))
+    assert_array_equal(X != X, np.zeros_like(T, bool))
+
+
+def test_unary_dunders():
+    X = ht.array(T, split=0)
+    assert_array_equal(-X, -T)
+    assert_array_equal(+X, T)
+    assert_array_equal(abs(X), np.abs(T))
+    I = ht.array(np.array([1, 2, 4], np.int32), split=0)
+    assert_array_equal(~I, ~np.array([1, 2, 4], np.int32))
+
+
+def test_matmul_dunder_and_pow():
+    A = ht.array(T, split=0)
+    B = ht.array(T.T, split=1)
+    assert_array_equal(A @ B, T @ T.T, rtol=1e-4, atol=1e-4)
+    assert_array_equal(A**2, T**2, rtol=1e-5)
+
+
+def test_float_int_bool_conversion_guards():
+    s = ht.array(np.array([2.5], np.float32), split=0)
+    assert float(s) == 2.5
+    assert int(s) == 2
+    assert bool(ht.array(np.array([1])))
+    with pytest.raises(Exception):
+        float(ht.array(T, split=0))  # non-scalar must refuse
+
+
+def test_repr_and_str_split():
+    X = ht.array(T, split=0)
+    s = str(X)
+    assert "DNDarray" in repr(X) or "[" in s
+    big = ht.arange(100_000, split=0)
+    s2 = str(big)
+    assert "..." in s2 or len(s2) < 5000  # summarized, not 100k numbers

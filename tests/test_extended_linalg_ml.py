@@ -1,0 +1,309 @@
+"""Extended linalg + random + factories + ML tests mirroring reference
+heat/core/linalg/tests/, heat/core/tests/test_random.py, and the estimator
+suites (cluster/regression/classification/naive_bayes tests)."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import heat_tpu as ht
+from suite import assert_array_equal
+
+RNG = np.random.default_rng(31)
+
+
+# ---------------------------------------------------------------------- linalg
+@pytest.mark.parametrize("shape", [(16, 12, 20), (40, 8, 8), (7, 13, 5)])
+@pytest.mark.parametrize("sa,sb", [(0, 0), (0, 1), (1, 0), (1, 1)])
+def test_matmul_shapes_splits(shape, sa, sb):
+    m, k, n = shape
+    A = RNG.normal(size=(m, k)).astype(np.float32)
+    B = RNG.normal(size=(k, n)).astype(np.float32)
+    got = ht.matmul(ht.array(A, split=sa), ht.array(B, split=sb))
+    assert_array_equal(got, A @ B, rtol=1e-3, atol=1e-3)
+
+
+def test_matmul_result_dtype_promotion():
+    A = ht.array(np.arange(6).reshape(2, 3), dtype=ht.int32, split=0)
+    B = ht.array(np.arange(12).reshape(3, 4), dtype=ht.float32, split=0)
+    assert ht.matmul(A, B).dtype == ht.float32
+    C = ht.array(np.arange(12).reshape(3, 4), dtype=ht.int64, split=0)
+    assert ht.matmul(A, C).dtype == ht.int64
+
+
+@pytest.mark.parametrize("split", [None, 0, 1])
+def test_qr_reconstruction_and_orthogonality(split):
+    for shape in [(30, 10), (16, 16), (13, 7)]:
+        A = RNG.normal(size=shape).astype(np.float32)
+        q, r = ht.linalg.qr(ht.array(A, split=split))
+        qn, rn = q.numpy(), r.numpy()
+        np.testing.assert_allclose(qn @ rn, A, rtol=1e-3, atol=1e-4)
+        np.testing.assert_allclose(qn.T @ qn, np.eye(qn.shape[1]), atol=1e-4)
+        # R upper-triangular
+        np.testing.assert_allclose(np.tril(rn, -1), 0, atol=1e-5)
+
+
+@pytest.mark.parametrize("split", [None, 0])
+def test_svd_properties(split):
+    A = RNG.normal(size=(40, 10)).astype(np.float32)
+    u, s, v = ht.svd(ht.array(A, split=split))
+    un, sn, vn = u.numpy(), s.numpy(), v.numpy()
+    np.testing.assert_allclose(un @ np.diag(sn) @ vn.T, A, rtol=1e-3, atol=1e-3)
+    np.testing.assert_allclose(sn, np.linalg.svd(A, compute_uv=False), rtol=1e-3)
+    assert (np.diff(sn) <= 1e-5).all()  # descending
+
+
+def test_norm_dot_outer_projection():
+    a = RNG.normal(size=37).astype(np.float32)
+    b = RNG.normal(size=37).astype(np.float32)
+    A, B = ht.array(a, split=0), ht.array(b, split=0)
+    np.testing.assert_allclose(float(ht.dot(A, B)), a @ b, rtol=1e-4)
+    np.testing.assert_allclose(float(ht.norm(A)), np.linalg.norm(a), rtol=1e-4)
+    assert_array_equal(ht.outer(A, B), np.outer(a, b), rtol=1e-4)
+    proj = ht.linalg.projection(A, B)
+    exp = (a @ b) / (b @ b) * b
+    assert_array_equal(proj, exp, rtol=1e-3, atol=1e-4)
+
+
+def test_matrix_vector_norms():
+    M = RNG.normal(size=(6, 9)).astype(np.float32)
+    X = ht.array(M, split=0)
+    np.testing.assert_allclose(float(ht.norm(X)), np.linalg.norm(M), rtol=1e-4)
+
+
+@pytest.mark.parametrize("split", [None, 0, 1])
+@pytest.mark.parametrize("k", [-2, 0, 3])
+def test_tril_triu_offsets(split, k):
+    X = ht.array(T := RNG.normal(size=(9, 11)).astype(np.float32), split=split)
+    assert_array_equal(ht.tril(X, k), np.tril(T, k))
+    assert_array_equal(ht.triu(X, k), np.triu(T, k))
+
+
+def test_cg_solves_spd():
+    n = 24
+    Q = RNG.normal(size=(n, n)).astype(np.float32)
+    A = Q @ Q.T + n * np.eye(n, dtype=np.float32)
+    x_true = RNG.normal(size=n).astype(np.float32)
+    b = A @ x_true
+    X0 = ht.zeros(n, split=0, dtype=ht.float32)
+    x = ht.linalg.cg(ht.array(A, split=0), ht.array(b, split=0), X0)
+    np.testing.assert_allclose(x.numpy(), x_true, rtol=1e-2, atol=1e-2)
+
+
+def test_lanczos_tridiagonalizes():
+    n, m = 30, 12
+    Q = RNG.normal(size=(n, n)).astype(np.float64)
+    A = (Q + Q.T) / 2
+    V, Tm = ht.lanczos(ht.array(A, split=0), m)
+    Vn, Tn = V.numpy(), Tm.numpy()
+    # V orthonormal columns; T = V^T A V tridiagonal (A V = V T only up to
+    # the beta_m residual in the last Krylov column)
+    np.testing.assert_allclose(Vn.T @ Vn, np.eye(m), atol=1e-6)
+    np.testing.assert_allclose(Vn.T @ A @ Vn, Tn, atol=1e-5)
+    np.testing.assert_allclose((A @ Vn)[:, : m - 1], (Vn @ Tn)[:, : m - 1], atol=1e-5)
+    assert np.abs(np.triu(Tn, 2)).max() < 1e-6  # tridiagonal
+
+
+def test_transpose_nd_axes():
+    a = RNG.normal(size=(3, 4, 5)).astype(np.float32)
+    X = ht.array(a, split=0)
+    assert_array_equal(ht.transpose(X), a.T)
+    assert_array_equal(ht.transpose(X, (1, 0, 2)), a.transpose(1, 0, 2))
+    Y = ht.array(a, split=2)
+    got = ht.transpose(Y, (2, 0, 1))
+    assert_array_equal(got, a.transpose(2, 0, 1))
+    assert got.split == 0  # split follows its axis
+
+
+# ---------------------------------------------------------------------- random
+def test_rand_unit_interval_and_shape():
+    x = ht.random.rand(131, 7, split=0)
+    a = x.numpy()
+    assert a.shape == (131, 7)
+    assert (a >= 0).all() and (a < 1).all()
+
+
+def test_randn_split_matches_unsplit():
+    # counter-based RNG: same seed -> same global stream regardless of split
+    ht.random.seed(99)
+    a = ht.random.randn(50, 3, split=0).numpy()
+    ht.random.seed(99)
+    b = ht.random.randn(50, 3).numpy()
+    np.testing.assert_array_equal(a, b)
+
+
+def test_randint_bounds_dtype():
+    ht.random.seed(0)
+    x = ht.random.randint(5, 17, (300,), split=0)
+    a = x.numpy()
+    assert a.min() >= 5 and a.max() < 17
+    assert x.dtype in (ht.int32, ht.int64)
+    # single-arg form: [0, high)
+    y = ht.random.randint(4, size=(100,))
+    assert y.numpy().min() >= 0 and y.numpy().max() < 4
+
+
+def test_permutation_forms():
+    ht.random.seed(1)
+    p = ht.random.permutation(11)
+    np.testing.assert_array_equal(np.sort(p.numpy()), np.arange(11))
+    arr = ht.arange(12, split=0)
+    q = ht.random.permutation(arr)
+    np.testing.assert_array_equal(np.sort(q.numpy()), np.arange(12))
+    M = ht.array(RNG.normal(size=(6, 4)).astype(np.float32), split=0)
+    pm = ht.random.permutation(M)  # permutes rows only
+    got = pm.numpy()
+    assert sorted(map(tuple, got)) == sorted(map(tuple, M.numpy()))
+
+
+def test_state_roundtrip():
+    ht.random.seed(1234)
+    _ = ht.random.rand(10).numpy()
+    st = ht.random.get_state()
+    a = ht.random.rand(20, split=0).numpy()
+    ht.random.set_state(st)
+    b = ht.random.rand(20, split=0).numpy()
+    np.testing.assert_array_equal(a, b)
+    assert st[0] in ("Threefry", "threefry", "Philox")  # reference-style tuple
+
+
+# -------------------------------------------------------------------- factories
+def test_arange_forms_dtypes():
+    assert_array_equal(ht.arange(10, split=0), np.arange(10))
+    assert_array_equal(ht.arange(2, 17, 3, split=0), np.arange(2, 17, 3))
+    assert_array_equal(ht.arange(0, 1, 0.125), np.arange(0, 1, 0.125))
+    assert ht.arange(5).dtype in (ht.int32, ht.int64)
+    assert ht.arange(5, dtype=ht.float32).dtype == ht.float32
+
+
+def test_linspace_endpoint_num():
+    assert_array_equal(ht.linspace(0, 1, 7), np.linspace(0, 1, 7), rtol=1e-6)
+    assert_array_equal(ht.linspace(-4, 4, 30, split=0), np.linspace(-4, 4, 30), rtol=1e-6)
+
+
+def test_eye_rectangular_split():
+    for shape in [5, (4, 7), (7, 4)]:
+        for split in (None, 0, 1):
+            got = ht.eye(shape, split=split)
+            exp = np.eye(shape) if np.isscalar(shape) else np.eye(*shape)
+            assert_array_equal(got, exp)
+
+
+def test_full_like_and_dtype_inference():
+    X = ht.array(RNG.normal(size=(13, 7)).astype(np.float32), split=0)
+    F = ht.full_like(X, 3.5)
+    assert F.split == 0 and F.dtype == ht.float32
+    assert_array_equal(F, np.full((13, 7), 3.5, np.float32))
+    assert ht.array([1, 2, 3]).dtype in (ht.int32, ht.int64)
+    assert ht.array([1.0, 2.0]).dtype == ht.float32
+    assert ht.array([True]).dtype == ht.bool
+
+
+def test_is_split_assembly():
+    # is_split: every "rank" holds a piece; single-controller equivalent is
+    # assembling from the local shard list
+    a = np.arange(24, dtype=np.float32).reshape(8, 3)
+    X = ht.array(a, is_split=0)
+    assert X.split == 0
+    # global shape must multiply out along the mesh axis
+    assert X.shape[1] == 3
+
+
+# ------------------------------------------------------------------------- ML
+def test_kmeans_empty_cluster_survives():
+    # centers far away -> some clusters get zero members; fit must not nan
+    data = RNG.normal(size=(64, 2)).astype(np.float32)
+    init = np.stack([data[0], data[1], np.array([1e3, 1e3], np.float32)])
+    km = ht.cluster.KMeans(n_clusters=3, init=ht.array(init), max_iter=5, tol=0.0)
+    km.fit(ht.array(data, split=0))
+    assert np.isfinite(km.cluster_centers_.numpy()).all()
+
+
+def test_kmeans_predict_new_data():
+    c = np.array([[-5, -5], [5, 5]], np.float32)
+    data = np.concatenate([c[i] + RNG.normal(size=(50, 2)).astype(np.float32) * 0.5 for i in range(2)])
+    km = ht.cluster.KMeans(n_clusters=2, init=ht.array(c), max_iter=10)
+    km.fit(ht.array(data, split=0))
+    test_pts = np.array([[-5.1, -4.9], [4.8, 5.2]], np.float32)
+    lab = km.predict(ht.array(test_pts, split=0)).numpy().ravel()
+    assert lab[0] != lab[1]
+
+
+def test_kmedians_kmedoids_centers_shape():
+    data = RNG.normal(size=(60, 3)).astype(np.float32)
+    X = ht.array(data, split=0)
+    for cls in (ht.cluster.KMedians, ht.cluster.KMedoids):
+        est = cls(n_clusters=4, random_state=3)
+        est.fit(X)
+        assert est.cluster_centers_.shape == (4, 3)
+        lab = est.predict(X).numpy()
+        assert set(np.unique(lab)) <= set(range(4))
+    # medoids must be actual datapoints
+    med = ht.cluster.KMedoids(n_clusters=3, random_state=0)
+    med.fit(X)
+    C = med.cluster_centers_.numpy()
+    for row in C:
+        assert (np.abs(data - row).sum(1) < 1e-5).any()
+
+
+def test_lasso_shrinks_coefficients():
+    n, f = 200, 8
+    X = RNG.normal(size=(n, f)).astype(np.float32)
+    beta = np.zeros(f, np.float32); beta[:3] = [2.0, -1.5, 1.0]
+    y = X @ beta + 0.01 * RNG.normal(size=n).astype(np.float32)
+    weak = ht.regression.Lasso(lam=0.01, max_iter=100)
+    weak.fit(ht.array(X, split=0), ht.array(y[:, None], split=0))
+    strong = ht.regression.Lasso(lam=5.0, max_iter=100)
+    strong.fit(ht.array(X, split=0), ht.array(y[:, None], split=0))
+    w_weak = np.asarray(weak.coef_.numpy()).ravel()
+    w_strong = np.asarray(strong.coef_.numpy()).ravel()
+    assert np.abs(w_strong).sum() < np.abs(w_weak).sum()
+    np.testing.assert_allclose(w_weak[:3], beta[:3], atol=0.2)
+
+
+def test_knn_separable():
+    c = np.array([[-3, 0], [3, 0]], np.float32)
+    Xtr = np.concatenate([c[i] + 0.3 * RNG.normal(size=(30, 2)).astype(np.float32) for i in range(2)])
+    ytr = np.repeat([0, 1], 30).astype(np.int32)
+    knn = ht.classification.KNN(ht.array(Xtr, split=0), ht.array(ytr, split=0), 5)
+    pred = knn.predict(ht.array(np.array([[-3.0, 0.1], [2.9, -0.2]], np.float32), split=0))
+    got = pred.numpy().ravel()
+    assert got[0] == 0 and got[1] == 1
+
+
+def test_gaussian_nb_matches_sklearn_formula():
+    X = np.array([[-2.0], [-1.8], [-2.2], [2.0], [1.9], [2.1]], np.float32)
+    y = np.array([0, 0, 0, 1, 1, 1], np.int64)
+    nb = ht.naive_bayes.GaussianNB()
+    nb.fit(ht.array(X, split=0), ht.array(y, split=0))
+    pred = nb.predict(ht.array(np.array([[-1.0], [1.0]], np.float32), split=0)).numpy().ravel()
+    assert pred[0] == 0 and pred[1] == 1
+    proba = nb.predict_proba(ht.array(np.array([[-2.0]], np.float32), split=0)).numpy()
+    np.testing.assert_allclose(proba.sum(), 1.0, rtol=1e-5)
+    assert proba[0, 0] > 0.99
+
+
+def test_spectral_two_moons_shape():
+    theta = np.linspace(0, np.pi, 40)
+    m1 = np.stack([np.cos(theta), np.sin(theta)], 1)
+    m2 = np.stack([1 - np.cos(theta), 0.5 - np.sin(theta)], 1)
+    data = np.concatenate([m1, m2]).astype(np.float32) + 0.02 * RNG.normal(size=(80, 2)).astype(np.float32)
+    sp = ht.cluster.Spectral(n_clusters=2, gamma=5.0, metric="rbf", n_lanczos=30)
+    labels = sp.fit_predict(ht.array(data, split=0)).numpy().ravel()
+    assert set(np.unique(labels)) <= {0, 1}
+    assert labels.shape == (80,)
+
+
+def test_laplacian_modes():
+    data = RNG.normal(size=(20, 2)).astype(np.float32)
+    X = ht.array(data, split=0)
+    from heat_tpu.graph import Laplacian
+    from heat_tpu.spatial import rbf
+
+    for mode, defin in [("fully_connected", "norm_sym"), ("fully_connected", "simple")]:
+        L = Laplacian(lambda a: rbf(a, sigma=1.0), definition=defin, mode=mode).construct(X)
+        M = L.numpy()
+        np.testing.assert_allclose(M, M.T, atol=1e-5)
+        if defin == "simple":
+            np.testing.assert_allclose(M.sum(1), 0, atol=1e-4)  # rows sum to 0

@@ -1,0 +1,190 @@
+"""Extended elementwise/reduction sweeps mirroring the reference's
+dtype × split test strategy (reference heat/core/tests/test_arithmetics.py,
+test_relational.py, test_logical.py, test_exponential.py,
+test_trigonometrics.py, test_rounding.py — value parity vs a numpy oracle
+for every op, over every dtype and split)."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import heat_tpu as ht
+from suite import assert_array_equal, assert_func_equal, ALL_TYPES, FLOAT_TYPES
+
+PRIME_SHAPE = (13, 7)  # not divisible by the 8-device mesh: exercises padding
+
+
+# ---------------------------------------------------------------- elementwise
+UNARY_FLOAT = [
+    ("exp", np.exp), ("expm1", np.expm1), ("exp2", np.exp2),
+    ("log", np.log), ("log2", np.log2), ("log10", np.log10),
+    ("log1p", np.log1p), ("sqrt", np.sqrt),
+    ("sin", np.sin), ("cos", np.cos), ("tan", np.tan),
+    ("sinh", np.sinh), ("cosh", np.cosh), ("tanh", np.tanh),
+    ("arcsin", np.arcsin), ("arccos", np.arccos), ("arctan", np.arctan),
+    ("deg2rad", np.deg2rad), ("rad2deg", np.rad2deg),
+    ("floor", np.floor), ("ceil", np.ceil), ("trunc", np.trunc),
+    ("fabs", np.fabs), ("abs", np.abs),
+]
+
+
+@pytest.mark.parametrize("name,np_fn", UNARY_FLOAT, ids=[n for n, _ in UNARY_FLOAT])
+def test_unary_sweep(name, np_fn):
+    # positive-domain draw keeps log/sqrt/arcsin finite; arcsin/arccos need |x|<=1
+    lo, hi = (0.05, 0.95) if name in ("arcsin", "arccos") else (0.05, 3.0)
+    assert_func_equal(
+        PRIME_SHAPE, getattr(ht, name), np_fn, dtypes=FLOAT_TYPES, low=lo, high=hi
+    )
+
+
+BINARY = [
+    ("add", np.add), ("sub", np.subtract), ("mul", np.multiply),
+    ("div", np.divide), ("fmod", np.fmod),
+    ("maximum", np.maximum), ("minimum", np.minimum),
+    ("arctan2", np.arctan2), ("pow", np.power),
+]
+
+
+@pytest.mark.parametrize("name,np_fn", BINARY, ids=[n for n, _ in BINARY])
+@pytest.mark.parametrize("split", [None, 0, 1])
+def test_binary_sweep(name, np_fn, split):
+    rng = np.random.default_rng(3)
+    a = rng.uniform(0.5, 4.0, PRIME_SHAPE).astype(np.float32)
+    b = rng.uniform(0.5, 4.0, PRIME_SHAPE).astype(np.float32)
+    got = getattr(ht, name)(ht.array(a, split=split), ht.array(b, split=split))
+    assert_array_equal(got, np_fn(a, b), rtol=1e-5, atol=1e-5)
+
+
+@pytest.mark.parametrize("split", [None, 0, 1])
+def test_relational_sweep(split):
+    rng = np.random.default_rng(4)
+    a = rng.integers(0, 4, PRIME_SHAPE).astype(np.int32)
+    b = rng.integers(0, 4, PRIME_SHAPE).astype(np.int32)
+    for name, np_fn in [
+        ("eq", np.equal), ("ne", np.not_equal), ("lt", np.less),
+        ("le", np.less_equal), ("gt", np.greater), ("ge", np.greater_equal),
+    ]:
+        got = getattr(ht, name)(ht.array(a, split=split), ht.array(b, split=split))
+        assert_array_equal(got, np_fn(a, b))
+
+
+def test_scalar_on_both_sides():
+    a = np.arange(1, 27, dtype=np.float32).reshape(13, 2)
+    X = ht.array(a, split=0)
+    assert_array_equal(2.0 + X, 2.0 + a)
+    assert_array_equal(X + 2.0, a + 2.0)
+    assert_array_equal(2.0 - X, 2.0 - a)
+    assert_array_equal(X - 2.0, a - 2.0)
+    assert_array_equal(2.0 / X, 2.0 / a)
+    assert_array_equal(X / 2.0, a / 2.0)
+    assert_array_equal(2.0**X, (2.0**a), rtol=1e-4)
+    assert_array_equal(2.0 // X, 2.0 // a)
+    assert_array_equal(7.0 % X, 7.0 % a)
+
+
+@pytest.mark.parametrize("dtype", ALL_TYPES, ids=[t.__name__ for t in ALL_TYPES])
+def test_binary_promotion_identity(dtype):
+    # x + 0 keeps dtype for every type (the "intuitive" promotion rule keeps
+    # same-type ops closed; reference types.py:444-541)
+    x = ht.array(np.arange(5), dtype=dtype, split=0)
+    assert (x + x).dtype == dtype
+
+
+def test_mixed_dtype_promotion_pairs():
+    table = [
+        (ht.int32, ht.float32, ht.float32),
+        (ht.int32, ht.int64, ht.int64),
+        (ht.uint8, ht.int32, ht.int32),
+        (ht.float32, ht.float64, ht.float64),
+        (ht.bool, ht.int32, ht.int32),
+    ]
+    for ta, tb, tr in table:
+        a = ht.array([1, 2, 3], dtype=ta, split=0)
+        b = ht.array([1, 2, 3], dtype=tb, split=0)
+        assert (a + b).dtype == tr, (ta, tb)
+        assert (b + a).dtype == tr, (tb, ta)
+
+
+def test_size1_broadcast_along_split():
+    # the reference Bcasts a size-1-along-split operand (_operations.py:103-125)
+    rng = np.random.default_rng(5)
+    a = rng.normal(size=(13, 7)).astype(np.float32)
+    row = rng.normal(size=(1, 7)).astype(np.float32)
+    got = ht.array(a, split=0) + ht.array(row, split=0)
+    assert_array_equal(got, a + row)
+    col = rng.normal(size=(13, 1)).astype(np.float32)
+    got = ht.array(a, split=1) * ht.array(col, split=1)
+    assert_array_equal(got, a * col)
+
+
+# ---------------------------------------------------------------- reductions
+@pytest.mark.parametrize("split", [None, 0, 1])
+@pytest.mark.parametrize("axis", [None, 0, 1, (0, 1)])
+def test_sum_prod_axes(split, axis):
+    rng = np.random.default_rng(6)
+    a = rng.uniform(0.5, 1.5, PRIME_SHAPE).astype(np.float32)
+    assert_array_equal(ht.sum(ht.array(a, split=split), axis=axis), a.sum(axis=axis), rtol=1e-4)
+    assert_array_equal(ht.prod(ht.array(a, split=split), axis=axis), a.prod(axis=axis), rtol=1e-3)
+
+
+@pytest.mark.parametrize("split", [None, 0, 1])
+def test_reduction_keepdims(split):
+    a = np.arange(91, dtype=np.float32).reshape(13, 7)
+    X = ht.array(a, split=split)
+    for axis in (0, 1, None):
+        got = ht.sum(X, axis=axis, keepdims=True)
+        assert_array_equal(got, a.sum(axis=axis, keepdims=True))
+
+
+@pytest.mark.parametrize("split", [None, 0, 1])
+def test_all_any_axes(split):
+    a = (np.arange(91).reshape(13, 7) % 5) > 0
+    X = ht.array(a, split=split)
+    for axis in (None, 0, 1):
+        assert_array_equal(ht.all(X, axis=axis), a.all(axis=axis))
+        assert_array_equal(ht.any(X, axis=axis), a.any(axis=axis))
+
+
+def test_int_sum_stays_exact():
+    a = np.arange(1000, dtype=np.int64)
+    assert int(ht.sum(ht.array(a, split=0))) == 499500
+    assert ht.sum(ht.array(a, split=0)).dtype in (ht.int64,)
+
+
+@pytest.mark.parametrize("split", [None, 0])
+def test_cum_ops_3d(split):
+    rng = np.random.default_rng(7)
+    a = rng.uniform(0.5, 1.5, (6, 5, 4)).astype(np.float32)
+    X = ht.array(a, split=split)
+    for axis in (0, 1, 2):
+        assert_array_equal(ht.cumsum(X, axis), a.cumsum(axis), rtol=1e-4)
+        assert_array_equal(ht.cumprod(X, axis), a.cumprod(axis), rtol=1e-3)
+
+
+# ---------------------------------------------------------------- edge shapes
+def test_empty_and_single_element():
+    e = ht.array(np.zeros((0,), np.float32), split=0)
+    assert e.shape == (0,)
+    assert float(ht.sum(e)) == 0.0
+    s = ht.array(np.array([41.0], np.float32), split=0)
+    assert float(s.sum() + 1) == 42.0
+
+
+def test_tiny_array_on_big_mesh():
+    # fewer elements than devices: shards mostly empty/padded
+    a = np.array([3.0, 1.0, 2.0], np.float32)
+    X = ht.array(a, split=0)
+    assert_array_equal(X + X, a + a)
+    assert float(ht.max(X)) == 3.0
+    assert int(ht.argmin(X)) == 1
+    v, _ = ht.sort(X)
+    assert_array_equal(v, np.sort(a))
+
+
+def test_bool_arithmetic():
+    a = np.array([True, False, True, True])
+    X = ht.array(a, split=0)
+    assert int(ht.sum(X)) == 3
+    assert_array_equal(ht.logical_not(X), ~a)
+    assert_array_equal(X & ht.array([True, True, False, True], split=0), a & np.array([True, True, False, True]))

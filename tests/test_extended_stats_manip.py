@@ -1,0 +1,280 @@
+"""Extended statistics + manipulations tests mirroring reference
+heat/core/tests/test_statistics.py and test_manipulations.py scenarios —
+axis sweeps, uneven (prime) shapes on the 8-device mesh, and the
+distributed algorithms (sample-sort, unique, topk, percentile)."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import heat_tpu as ht
+from suite import assert_array_equal
+
+RNG = np.random.default_rng(23)
+T = RNG.normal(size=(13, 7)).astype(np.float32)
+
+
+# ------------------------------------------------------------------ statistics
+@pytest.mark.parametrize("split", [None, 0, 1])
+@pytest.mark.parametrize("axis", [None, 0, 1])
+def test_mean_var_std_axes(split, axis):
+    X = ht.array(T, split=split)
+    assert_array_equal(ht.mean(X, axis=axis), T.mean(axis=axis), rtol=1e-4, atol=1e-5)
+    assert_array_equal(ht.var(X, axis=axis), T.var(axis=axis), rtol=1e-3, atol=1e-5)
+    assert_array_equal(ht.std(X, axis=axis), T.std(axis=axis), rtol=1e-3, atol=1e-5)
+
+
+@pytest.mark.parametrize("ddof", [0, 1])
+def test_var_ddof(ddof):
+    X = ht.array(T, split=0)
+    assert_array_equal(ht.var(X, axis=0, ddof=ddof), T.var(axis=0, ddof=ddof), rtol=1e-3)
+
+
+@pytest.mark.parametrize("split", [None, 0, 1])
+@pytest.mark.parametrize("axis", [None, 0, 1])
+def test_min_max_arg_axes(split, axis):
+    X = ht.array(T, split=split)
+    assert_array_equal(ht.max(X, axis=axis), T.max(axis=axis))
+    assert_array_equal(ht.min(X, axis=axis), T.min(axis=axis))
+    am = ht.argmax(X, axis=axis)
+    an = ht.argmin(X, axis=axis)
+    if axis is None:
+        assert int(am) == int(T.argmax())
+        assert int(an) == int(T.argmin())
+    else:
+        assert_array_equal(am, T.argmax(axis=axis))
+        assert_array_equal(an, T.argmin(axis=axis))
+
+
+def test_average_returned_and_errors():
+    w = RNG.uniform(0.5, 1.0, 13).astype(np.float32)
+    X = ht.array(T, split=0)
+    avg, wsum = ht.average(X, axis=0, weights=ht.array(w, split=0), returned=True)
+    exp_avg, exp_w = np.average(T, axis=0, weights=w, returned=True)
+    assert_array_equal(avg, exp_avg, rtol=1e-4)
+    np.testing.assert_allclose(np.asarray(wsum.larray), exp_w, rtol=1e-5)
+    with pytest.raises(Exception):
+        ht.average(X, axis=0, weights=ht.array(w[:5]))  # length mismatch
+
+
+def test_cov_variants():
+    M = RNG.normal(size=(5, 40)).astype(np.float32)
+    H = ht.array(M, split=1)
+    assert_array_equal(ht.cov(H), np.cov(M), rtol=1e-3, atol=1e-4)
+    assert_array_equal(ht.cov(H, bias=True), np.cov(M, bias=True), rtol=1e-3, atol=1e-4)
+    assert_array_equal(ht.cov(H, ddof=1), np.cov(M, ddof=1), rtol=1e-3, atol=1e-4)
+    Ht = ht.array(M.T, split=0)
+    assert_array_equal(ht.cov(Ht, rowvar=False), np.cov(M.T, rowvar=False), rtol=1e-3, atol=1e-4)
+
+
+def test_bincount_weights_minlength():
+    v = RNG.integers(0, 9, 50).astype(np.int32)
+    w = RNG.uniform(0, 1, 50).astype(np.float32)
+    X = ht.array(v, split=0)
+    assert_array_equal(ht.bincount(X, minlength=12), np.bincount(v, minlength=12))
+    got = ht.bincount(X, weights=ht.array(w, split=0))
+    assert_array_equal(got, np.bincount(v, weights=w).astype(np.float32), rtol=1e-4)
+
+
+def test_histc_range_and_histogram_edges():
+    v = RNG.uniform(-3, 3, 200).astype(np.float32)
+    X = ht.array(v, split=0)
+    got = ht.histc(X, bins=20, min=-2.0, max=2.0)
+    exp = np.histogram(v[(v >= -2) & (v <= 2)], bins=20, range=(-2, 2))[0]
+    np.testing.assert_array_equal(np.asarray(got.larray), exp)
+    h, edges = ht.histogram(X, bins=15)
+    eh, eedges = np.histogram(v, bins=15)
+    np.testing.assert_array_equal(np.asarray(h.larray), eh)
+    np.testing.assert_allclose(np.asarray(edges.larray), eedges, rtol=1e-5)
+
+
+@pytest.mark.parametrize("q", [0, 10, 33.3, 50, 75, 100])
+@pytest.mark.parametrize("interp", ["linear", "lower", "higher", "nearest", "midpoint"])
+def test_percentile_interpolations(q, interp):
+    v = RNG.normal(size=97).astype(np.float32)  # odd, prime length
+    X = ht.array(v, split=0)
+    got = ht.percentile(X, q, interpolation=interp)
+    exp = np.percentile(v, q, method=interp if interp != "midpoint" else "midpoint")
+    np.testing.assert_allclose(float(got), exp, rtol=1e-4, atol=1e-5)
+
+
+def test_median_even_odd_axis():
+    even = RNG.normal(size=(10, 4)).astype(np.float32)
+    odd = RNG.normal(size=(9, 4)).astype(np.float32)
+    for data in (even, odd):
+        X = ht.array(data, split=0)
+        assert_array_equal(ht.median(X, axis=0), np.median(data, axis=0), rtol=1e-4)
+        np.testing.assert_allclose(float(ht.median(X)), np.median(data), rtol=1e-4)
+
+
+def _moments_oracle(a, axis, k):
+    m = a.mean(axis=axis, keepdims=True)
+    c = a - m
+    mk = (c**k).mean(axis=axis)
+    m2 = (c**2).mean(axis=axis)
+    return mk / m2 ** (k / 2)
+
+
+def test_skew_kurtosis_values():
+    data = RNG.normal(size=(500,)).astype(np.float64)
+    X = ht.array(data, split=0)
+    n = data.size
+    g1 = _moments_oracle(data, None, 3)
+    G1 = np.sqrt(n * (n - 1)) / (n - 2) * g1  # Fisher-Pearson adjusted
+    np.testing.assert_allclose(float(ht.skew(X)), G1, rtol=1e-3, atol=1e-4)
+    np.testing.assert_allclose(float(ht.skew(X, unbiased=False)), g1, rtol=1e-3, atol=1e-4)
+    g2 = _moments_oracle(data, None, 4) - 3.0
+    np.testing.assert_allclose(float(ht.kurtosis(X, unbiased=False)), g2, rtol=1e-3, atol=1e-4)
+    # Fischer=False reports Pearson (excess + 3)
+    np.testing.assert_allclose(
+        float(ht.kurtosis(X, unbiased=False, Fischer=False)), g2 + 3.0, rtol=1e-3
+    )
+
+
+# --------------------------------------------------------------- manipulations
+@pytest.mark.parametrize("split", [None, 0, 1])
+@pytest.mark.parametrize("axis", [0, 1, None])
+def test_sort_axes(split, axis):
+    a = RNG.integers(0, 50, (13, 7)).astype(np.int32)
+    X = ht.array(a, split=split)
+    if axis is None:
+        return  # reference sorts along an axis only
+    v, idx = ht.sort(X, axis=axis)
+    assert_array_equal(v, np.sort(a, axis=axis))
+    np.testing.assert_array_equal(
+        np.take_along_axis(a, np.asarray(idx.resplit(None).larray), axis=axis),
+        np.sort(a, axis=axis),
+    )
+
+
+def test_sort_descending():
+    a = RNG.integers(0, 50, 23).astype(np.int32)
+    v, _ = ht.sort(ht.array(a, split=0), descending=True)
+    assert_array_equal(v, np.sort(a)[::-1])
+
+
+@pytest.mark.parametrize("split", [None, 0])
+def test_unique_axis_and_inverse(split):
+    a = np.array([[1, 2], [3, 4], [1, 2], [5, 6], [3, 4]], np.int32)
+    X = ht.array(a, split=split)
+    u = ht.unique(X, sorted=True, axis=0)
+    assert_array_equal(u, np.unique(a, axis=0))
+    v = np.array([4, 1, 4, 2, 2, 9], np.int32)
+    u2, inv = ht.unique(ht.array(v, split=split), sorted=True, return_inverse=True)
+    eu, einv = np.unique(v, return_inverse=True)
+    assert_array_equal(u2, eu)
+    np.testing.assert_array_equal(np.asarray(inv.resplit(None).larray).ravel(), einv)
+
+
+@pytest.mark.parametrize("largest", [True, False])
+@pytest.mark.parametrize("split", [None, 0])
+def test_topk_dim_sorted(largest, split):
+    a = RNG.normal(size=(6, 11)).astype(np.float32)
+    X = ht.array(a, split=split)
+    v, idx = ht.topk(X, 4, dim=1, largest=largest, sorted=True)
+    exp = np.sort(a, axis=1)
+    exp = exp[:, ::-1][:, :4] if largest else exp[:, :4]
+    assert_array_equal(v, exp, rtol=1e-5)
+    np.testing.assert_array_equal(
+        np.take_along_axis(a, np.asarray(idx.resplit(None).larray), axis=1),
+        np.asarray(v.resplit(None).larray),
+    )
+
+
+def test_pad_forms():
+    a = np.arange(12, dtype=np.float32).reshape(3, 4)
+    X = ht.array(a, split=0)
+    assert_array_equal(ht.pad(X, 1), np.pad(a, 1))
+    assert_array_equal(ht.pad(X, (1, 2)), np.pad(a, (1, 2)))
+    assert_array_equal(ht.pad(X, ((1, 0), (0, 2)), constant_values=5),
+                       np.pad(a, ((1, 0), (0, 2)), constant_values=5))
+
+
+def test_repeat_forms():
+    a = np.arange(6, dtype=np.int32).reshape(2, 3)
+    X = ht.array(a, split=0)
+    assert_array_equal(ht.repeat(X, 3), np.repeat(a, 3))
+    assert_array_equal(ht.repeat(X, 2, axis=0), np.repeat(a, 2, axis=0))
+    assert_array_equal(ht.repeat(X, 2, axis=1), np.repeat(a, 2, axis=1))
+    assert_array_equal(ht.repeat(X, np.array([1, 2, 3]), axis=1), np.repeat(a, [1, 2, 3], axis=1))
+
+
+@pytest.mark.parametrize("k", [0, 1, 2, 3, 4, -1])
+def test_rot90_k(k):
+    X = ht.array(T, split=0)
+    assert_array_equal(ht.rot90(X, k), np.rot90(T, k))
+
+
+def test_rot90_axes():
+    X = ht.array(T3 := RNG.normal(size=(4, 5, 6)).astype(np.float32), split=0)
+    assert_array_equal(ht.rot90(X, 1, axes=(1, 2)), np.rot90(T3, 1, axes=(1, 2)))
+
+
+@pytest.mark.parametrize("axis", [0, 1, 2, -1])
+def test_stack_axes(axis):
+    X = ht.array(T, split=0)
+    Y = ht.array(T * 2, split=0)
+    assert_array_equal(ht.stack([X, Y], axis=axis), np.stack([T, T * 2], axis=axis))
+
+
+def test_split_by_indices():
+    X = ht.array(np.arange(20, dtype=np.float32), split=0)
+    parts = ht.split(X, [3, 9, 15])
+    exps = np.split(np.arange(20, dtype=np.float32), [3, 9, 15])
+    assert len(parts) == len(exps)
+    for p, e in zip(parts, exps):
+        assert_array_equal(p, e)
+
+
+def test_dsplit_hsplit_vsplit():
+    a = np.arange(48, dtype=np.float32).reshape(4, 4, 3)
+    X = ht.array(a, split=0)
+    for hfn, nfn, arg in [
+        (ht.vsplit, np.vsplit, 2), (ht.hsplit, np.hsplit, 2), (ht.dsplit, np.dsplit, 3)
+    ]:
+        for p, e in zip(hfn(X, arg), nfn(a, arg)):
+            assert_array_equal(p, e)
+
+
+@pytest.mark.parametrize("split", [None, 0, 1])
+def test_diag_diagonal_offsets(split):
+    X = ht.array(T, split=split)
+    for off in (-2, -1, 0, 1, 2):
+        assert_array_equal(ht.diagonal(X, offset=off), np.diagonal(T, offset=off))
+    v = np.arange(5, dtype=np.float32)
+    for off in (-1, 0, 2):
+        assert_array_equal(ht.diag(ht.array(v, split=0), off), np.diag(v, off))
+
+
+def test_concatenate_many_and_empty_edge():
+    X = ht.array(T, split=0)
+    got = ht.concatenate([X, X, X], axis=0)
+    assert_array_equal(got, np.concatenate([T, T, T], axis=0))
+    assert got.split == 0
+
+
+@pytest.mark.parametrize("split", [None, 0, 1])
+def test_reshape_shapes(split):
+    a = np.arange(84, dtype=np.float32).reshape(12, 7)
+    X = ht.array(a, split=split)
+    for shape in [(7, 12), (84,), (2, 42), (4, 3, 7), (-1, 6)]:
+        assert_array_equal(ht.reshape(X, shape), a.reshape(shape))
+
+
+def test_squeeze_expand_negative_axes():
+    a = np.arange(6, dtype=np.float32).reshape(1, 6, 1)
+    X = ht.array(a, split=1)
+    assert_array_equal(ht.squeeze(X), a.squeeze())
+    assert_array_equal(ht.squeeze(X, axis=0), a.squeeze(axis=0))
+    assert_array_equal(ht.squeeze(X, axis=-1), a.squeeze(axis=-1))
+    Y = ht.array(np.arange(6, dtype=np.float32), split=0)
+    assert_array_equal(ht.expand_dims(Y, -1), np.arange(6, dtype=np.float32)[:, None])
+
+
+def test_flipud_fliplr_3d():
+    a = RNG.normal(size=(4, 5, 3)).astype(np.float32)
+    X = ht.array(a, split=0)
+    assert_array_equal(ht.flipud(X), np.flipud(a))
+    assert_array_equal(ht.fliplr(X), np.fliplr(a))
+    assert_array_equal(ht.flip(X, (0, 2)), np.flip(a, (0, 2)))
