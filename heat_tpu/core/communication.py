@@ -54,6 +54,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
+from ._compile import jitted
+
 __all__ = [
     "Communication",
     "XlaCommunication",
@@ -290,26 +292,119 @@ class XlaCommunication(Communication):
         Requires the leading axis divisible by the mesh size.
         """
         n = self.size
+        return self.permute(array, [(i, (i + shift) % n) for i in range(n)])
+
+    def permute(self, array: jax.Array, perm: Sequence[Tuple[int, int]]) -> jax.Array:
+        """Arbitrary point-to-point shard exchange: the reference's tagged
+        ``Isend``/``Recv`` pair schedules (e.g. resplit tile shuffle,
+        dndarray.py:2870-2921) as one :func:`jax.lax.ppermute` with an
+        explicit (src, dst) list.  Positions that receive nothing get
+        zeros, matching ppermute semantics."""
+        n = self.size
         if n == 1:
             return array
         if array.shape[0] % n != 0:
             raise ValueError(
-                f"ring_permute needs axis 0 ({array.shape[0]}) divisible by mesh size ({n})"
+                f"permute needs axis 0 ({array.shape[0]}) divisible by mesh size ({n})"
             )
-        perm = [(i, (i + shift) % n) for i in range(n)]
+        perm = tuple((int(s), int(d)) for s, d in perm)
         mesh = self._mesh
         axis = self.axis_name
 
-        @jax.jit
-        def _ring(x):
-            return jax.shard_map(
-                lambda s: jax.lax.ppermute(s, axis, perm),
-                mesh=mesh,
-                in_specs=PartitionSpec(axis),
-                out_specs=PartitionSpec(axis),
-            )(x)
+        def make():
+            def _p(x):
+                return jax.shard_map(
+                    lambda s: jax.lax.ppermute(s, axis, perm),
+                    mesh=mesh,
+                    in_specs=PartitionSpec(axis),
+                    out_specs=PartitionSpec(axis),
+                )(x)
 
-        return _ring(array)
+            return _p
+
+        return jitted(("comm.permute", self, perm), make)(array)
+
+    def _split_axis_of(self, array: jax.Array) -> Optional[int]:
+        """The mesh-sharded axis of a global array, or None if replicated."""
+        sharding = getattr(array, "sharding", None)
+        spec = getattr(sharding, "spec", None)
+        if spec is None:
+            return None
+        for ax, entry in enumerate(spec):
+            if entry is not None:
+                return ax
+        return None
+
+    def bcast(self, array: jax.Array, root: int = 0) -> jax.Array:
+        """Replicate mesh position ``root``'s shard everywhere: the
+        reference's ``Bcast`` (communication.py:463-475).  For an array
+        split along some axis, returns the root's block along that axis
+        (shape = root lshape) replicated on every device; a replicated
+        input is already everywhere and is returned unchanged."""
+        n = self.size
+        if n == 1:
+            return array
+        split = self._split_axis_of(array)
+        if split is None:
+            return array
+        _, _, slices = self.chunk(tuple(array.shape), split, rank=root)
+        block = array[slices]
+        return jax.device_put(block, self.sharding(block.ndim, None))
+
+    def scatter(self, array: jax.Array, axis: int = 0) -> jax.Array:
+        """Distribute a (replicated) array so each mesh position owns one
+        block along ``axis``: the reference's ``Scatter(v)``
+        (communication.py:955-1010) as a reshard-to-split."""
+        return self.apply_sharding(array, axis)
+
+    def gather(self, array: jax.Array, root: int = 0, axis: int = 0) -> jax.Array:
+        """Collect all shards: the reference's ``Gather(v)``
+        (communication.py:1011-1068).  Single-controller SPMD has no
+        privileged root — every position ends up with the full array, so
+        this is ``allgather``; ``root`` is accepted for API parity."""
+        del root
+        return self.allgather(array, axis=axis)
+
+    def reduce(self, array: jax.Array, op: str = "sum", root: int = 0) -> jax.Array:
+        """Reduce a per-shard quantity (reference ``Reduce``,
+        communication.py:552-559).  Like :meth:`gather`, the result is
+        available everywhere; ``root`` kept for parity."""
+        del root
+        return self.allreduce(array, op=op)
+
+    def scan(self, array: jax.Array, op: str = "sum", exclusive: bool = False) -> jax.Array:
+        """Prefix-combine across mesh positions along the split axis: the
+        reference's ``Scan``/``Exscan`` (communication.py:524-567), the
+        engine under distributed cumulative ops.  ``array`` is a stacked
+        per-shard partial of shape (size, ...); returns the (exclusive)
+        running combine with the same shape."""
+        if op == "sum":
+            out = jnp.cumsum(array, axis=0)
+            if exclusive:
+                out = jnp.concatenate([jnp.zeros_like(out[:1]), out[:-1]], axis=0)
+            return out
+        if op == "prod":
+            out = jnp.cumprod(array, axis=0)
+            if exclusive:
+                out = jnp.concatenate([jnp.ones_like(out[:1]), out[:-1]], axis=0)
+            return out
+        if op in ("max", "min"):
+            fn = jax.lax.cummax if op == "max" else jax.lax.cummin
+            out = fn(array, axis=0)
+            if exclusive:
+                # position 0 gets the operation's identity, consistent with
+                # the sum (0) / prod (1) branches
+                if jnp.issubdtype(array.dtype, jnp.inexact):
+                    ident = jnp.finfo(array.dtype).min if op == "max" else jnp.finfo(array.dtype).max
+                else:
+                    ident = jnp.iinfo(array.dtype).min if op == "max" else jnp.iinfo(array.dtype).max
+                out = jnp.concatenate([jnp.full_like(out[:1], ident), out[:-1]], axis=0)
+            return out
+        raise ValueError(f"unsupported scan op {op!r}")
+
+    def exscan(self, array: jax.Array, op: str = "sum") -> jax.Array:
+        """Exclusive scan (reference ``Exscan``, communication.py:524-551)."""
+        return self.scan(array, op=op, exclusive=True)
 
 
 def _constrained_copy(array: jax.Array, sh: NamedSharding) -> jax.Array:

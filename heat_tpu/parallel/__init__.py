@@ -26,6 +26,7 @@ communication over ICI.
 
 from .primitives import all_to_all_resplit, halo_exchange, ring_map
 from .ring_attention import ring_attention, ring_self_attention
+from .ulysses import ulysses_attention
 
 __all__ = [
     "all_to_all_resplit",
@@ -33,4 +34,5 @@ __all__ = [
     "ring_map",
     "ring_attention",
     "ring_self_attention",
+    "ulysses_attention",
 ]

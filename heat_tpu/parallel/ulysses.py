@@ -1,0 +1,88 @@
+"""Ulysses (DeepSpeed-style) sequence parallelism: all-to-all attention.
+
+Where ring attention rotates K/V blocks, Ulysses re-shards: the input
+arrives sequence-sharded, an all-to-all swaps the sharded axis from
+sequence to heads, every device then computes *full-sequence* attention
+for its own heads with zero communication, and a second all-to-all swaps
+back.  The sharded-axis swap is exactly the framework's ``resplit``
+(reference dndarray.py:2801-2921 — the Alltoallv axis swap, SURVEY.md §5.7);
+expressed on global arrays it is two sharding constraints and GSPMD emits
+the all-to-alls over ICI.
+
+No reference analog (HeAT has no attention); included because long-context
+sequence parallelism is a first-class capability of this framework.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec
+
+from ..core.communication import XlaCommunication, get_comm
+from ..core.dndarray import DNDarray
+
+__all__ = ["ulysses_attention"]
+
+
+def _attention(q, k, v, causal: bool):
+    """Plain exact attention on (B, S, H, D) with full sequence visible."""
+    scale = 1.0 / np.sqrt(q.shape[-1])
+    qt, kt, vt = (jnp.moveaxis(t, 2, 1) for t in (q, k, v))  # (B, H, S, D)
+    scores = jnp.einsum("bhqd,bhkd->bhqk", qt, kt) * scale
+    if causal:
+        S = q.shape[1]
+        scores = jnp.where(jnp.tril(jnp.ones((S, S), bool)), scores, -jnp.inf)
+    out = jnp.einsum("bhqk,bhkd->bhqd", jax.nn.softmax(scores, axis=-1), vt)
+    return jnp.moveaxis(out, 1, 2)  # (B, S, H, D)
+
+
+def ulysses_attention(
+    q,
+    k,
+    v,
+    causal: bool = False,
+    comm: Optional[XlaCommunication] = None,
+) -> jax.Array:
+    """Exact attention over sequence-sharded (seq, heads, dim) — or
+    (batch, seq, heads, dim) — inputs via the head↔sequence all-to-all.
+
+    Requires ``heads`` divisible by the mesh size (the Ulysses constraint);
+    falls back to plain attention (GSPMD-planned) otherwise.  The sequence
+    axis need not be divisible — the all-to-all path additionally needs it
+    to be, else the fallback also applies.
+    """
+    if isinstance(q, DNDarray):
+        comm = comm or q.comm
+        q, k, v = q.larray, k.larray, v.larray
+    comm = comm or get_comm()
+    size = comm.size
+
+    batched = q.ndim == 4
+    if not batched:
+        q, k, v = q[None], k[None], v[None]  # (1, S, H, D)
+    B, S, H, D = q.shape
+
+    mesh, name = comm.mesh, comm.axis_name
+    seq_sh = NamedSharding(mesh, PartitionSpec(None, name, None, None))
+    head_sh = NamedSharding(mesh, PartitionSpec(None, None, name, None))
+
+    if size == 1 or H % size != 0 or S % size != 0:
+        out = jax.jit(_attention, static_argnames="causal")(q, k, v, causal=causal)
+        return out if batched else out[0]
+
+    @jax.jit
+    def kernel(q, k, v):
+        # seq-sharded → head-sharded: GSPMD emits one all-to-all per operand
+        q_h, k_h, v_h = (jax.lax.with_sharding_constraint(t, head_sh) for t in (q, k, v))
+        out = _attention(q_h, k_h, v_h, causal)  # comm-free: full seq per head
+        # back to the caller's sequence sharding
+        return jax.lax.with_sharding_constraint(out, seq_sh)
+
+    q, k, v = (jax.device_put(t, seq_sh) for t in (q, k, v))
+    out = kernel(q, k, v)
+    return out if batched else out[0]

@@ -103,3 +103,91 @@ def test_custom_comm_subset():
     assert small.size == 1
     x = ht.array([1, 2, 3], comm=small)
     assert x.comm.size == 1
+
+
+def test_bcast_root_block():
+    import numpy as np
+    comm = ht.get_comm()
+    n = comm.size
+    a = ht.array(np.arange(4 * n, dtype=np.float32), split=0)
+    for root in (0, n - 1):
+        got = comm.bcast(a.larray, root=root)
+        off, lshape, _ = comm.chunk((4 * n,), 0, rank=root)
+        np.testing.assert_array_equal(
+            np.asarray(got), np.arange(off, off + lshape[0], dtype=np.float32)
+        )
+
+
+def test_scatter_gather_roundtrip():
+    import numpy as np
+    comm = ht.get_comm()
+    n = comm.size
+    data = np.arange(2 * n * 3, dtype=np.float32).reshape(2 * n, 3)
+    rep = comm.apply_sharding(ht.array(data).larray, None)
+    sc = comm.scatter(rep, axis=0)
+    back = comm.gather(sc)
+    np.testing.assert_array_equal(np.asarray(back), data)
+
+
+def test_reduce_matches_allreduce():
+    import numpy as np
+    comm = ht.get_comm()
+    parts = ht.array(np.arange(comm.size * 2, dtype=np.float32).reshape(comm.size, 2)).larray
+    np.testing.assert_allclose(
+        np.asarray(comm.reduce(parts, "sum")), np.asarray(comm.allreduce(parts, "sum"))
+    )
+
+
+def test_scan_exscan_ops():
+    import numpy as np
+    comm = ht.get_comm()
+    n = comm.size
+    parts = np.arange(1, n + 1, dtype=np.float32).reshape(n, 1)
+    x = ht.array(parts).larray
+    np.testing.assert_allclose(np.asarray(comm.scan(x, "sum")), parts.cumsum(0))
+    ex = np.asarray(comm.exscan(x, "sum"))
+    np.testing.assert_allclose(ex[0], 0.0)
+    np.testing.assert_allclose(ex[1:], parts.cumsum(0)[:-1])
+    np.testing.assert_allclose(np.asarray(comm.scan(x, "prod")), parts.cumprod(0))
+    np.testing.assert_allclose(np.asarray(comm.scan(x, "max")), np.maximum.accumulate(parts, 0))
+
+
+def test_permute_explicit_pairs():
+    import numpy as np
+    comm = ht.get_comm()
+    n = comm.size
+    if n < 2:
+        pytest.skip("needs >1 device")
+    a = ht.array(np.arange(n * 2, dtype=np.float32), split=0)
+    # full reversal ring: shard i -> shard n-1-i
+    perm = [(i, n - 1 - i) for i in range(n)]
+    got = comm.permute(a.larray, perm)
+    exp = np.arange(n * 2, dtype=np.float32).reshape(n, 2)[::-1].ravel()
+    np.testing.assert_array_equal(np.asarray(got), exp)
+
+
+def test_bcast_replicated_unchanged_and_split1():
+    import numpy as np
+    comm = ht.get_comm()
+    n = comm.size
+    data = np.arange(4 * n, dtype=np.float32)
+    rep = comm.apply_sharding(ht.array(data).larray, None)
+    got = comm.bcast(rep, root=0)
+    np.testing.assert_array_equal(np.asarray(got), data)  # unchanged
+    M = np.arange(2 * 3 * n, dtype=np.float32).reshape(2, 3 * n)
+    s1 = ht.array(M, split=1)
+    got = comm.bcast(s1.larray, root=n - 1)
+    _, _, slices = comm.chunk(M.shape, 1, rank=n - 1)
+    np.testing.assert_array_equal(np.asarray(got), M[slices])
+
+
+def test_exscan_minmax_identity():
+    import numpy as np
+    comm = ht.get_comm()
+    x = ht.array(np.array([[3.0], [1.0], [2.0]], np.float32)).larray
+    ex = np.asarray(comm.exscan(x, "max"))
+    assert ex[0, 0] == np.finfo(np.float32).min
+    np.testing.assert_allclose(ex[1:, 0], [3.0, 3.0])
+    exi = np.asarray(comm.exscan(ht.array(np.array([[3], [1], [2]], np.int32)).larray, "min"))
+    assert exi[0, 0] == np.iinfo(np.int32).max
+    np.testing.assert_array_equal(exi[1:, 0], [3, 1])

@@ -17,6 +17,17 @@ from heat_tpu.parallel import (
 )
 
 
+def _reference_attention(q, k, v, causal=False):
+    """Dense numpy attention oracle on (S, H, D)."""
+    qt, kt, vt = [np.moveaxis(a, 1, 0) for a in (q, k, v)]  # (H, S, D)
+    scores = qt @ np.swapaxes(kt, 1, 2) / np.sqrt(q.shape[-1])
+    if causal:
+        scores = np.where(np.tril(np.ones(scores.shape[-2:], bool)), scores, -np.inf)
+    p = np.exp(scores - scores.max(-1, keepdims=True))
+    p /= p.sum(-1, keepdims=True)
+    return np.moveaxis(p @ vt, 0, 1)  # (S, H, D)
+
+
 def _size():
     return ht.core.communication.get_comm().size
 
@@ -168,3 +179,42 @@ def test_ring_attention_nondivisible_fallback():
     q = jnp.asarray(rng.normal(size=(S, H, D)).astype(np.float32))
     out = ring_attention(q, q, q)
     assert out.shape == (S, H, D)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ulysses_attention_exact(causal):
+    comm = ht.get_comm()
+    size = comm.size
+    S, H, D = 4 * max(size, 2), 2 * size, 6
+    rng = np.random.default_rng(17)
+    q = rng.normal(size=(S, H, D)).astype(np.float32)
+    k = rng.normal(size=(S, H, D)).astype(np.float32)
+    v = rng.normal(size=(S, H, D)).astype(np.float32)
+    got = np.asarray(ht.parallel.ulysses_attention(jnp.asarray(q), jnp.asarray(k), jnp.asarray(v), causal=causal))
+    exp = _reference_attention(q, k, v, causal=causal)
+    np.testing.assert_allclose(got, exp, rtol=2e-4, atol=2e-5)
+
+
+def test_ulysses_matches_ring():
+    comm = ht.get_comm()
+    size = comm.size
+    S, H, D = 4 * max(size, 2), 2 * size, 5
+    rng = np.random.default_rng(18)
+    q = jnp.asarray(rng.normal(size=(S, H, D)).astype(np.float32))
+    k = jnp.asarray(rng.normal(size=(S, H, D)).astype(np.float32))
+    v = jnp.asarray(rng.normal(size=(S, H, D)).astype(np.float32))
+    u = np.asarray(ht.parallel.ulysses_attention(q, k, v, causal=True))
+    r = np.asarray(ht.parallel.ring_attention(q, k, v, causal=True))
+    np.testing.assert_allclose(u, r, rtol=2e-4, atol=2e-5)
+
+
+def test_ulysses_head_fallback():
+    # heads not divisible by mesh -> plain-attention fallback, same values
+    rng = np.random.default_rng(19)
+    S, H, D = 8, 3, 4
+    q = rng.normal(size=(S, H, D)).astype(np.float32)
+    k = rng.normal(size=(S, H, D)).astype(np.float32)
+    v = rng.normal(size=(S, H, D)).astype(np.float32)
+    got = np.asarray(ht.parallel.ulysses_attention(jnp.asarray(q), jnp.asarray(k), jnp.asarray(v)))
+    exp = _reference_attention(q, k, v, causal=False)
+    np.testing.assert_allclose(got, exp, rtol=2e-4, atol=2e-5)
