@@ -194,8 +194,10 @@ def load_csv(
     comm=None,
 ) -> DNDarray:
     """Load a CSV file (reference io.py:665-885 — byte-range partitioning by
-    rank with line-boundary fixup; a single controller parses once and
-    shards the result, which is strictly simpler and IO-bound either way)."""
+    rank with line-boundary fixup).  The partitioning runs in the native
+    threaded scanner (:mod:`heat_tpu.native`, C++ over mmap'd byte ranges
+    with the same line-ownership rule); the numpy parser is the fallback
+    for exotic encodings, ragged rows, or toolchain-less hosts."""
     if not isinstance(path, str):
         raise TypeError(f"path must be str, not {type(path)}")
     if not isinstance(sep, str):
@@ -203,13 +205,21 @@ def load_csv(
     if not isinstance(header_lines, int):
         raise TypeError(f"header_lines must be int, not {type(header_lines)}")
     dtype = types.canonical_heat_type(dtype)
-    data = np.genfromtxt(
-        path,
-        delimiter=sep,
-        skip_header=header_lines,
-        dtype=np.dtype(dtype._np_type),
-        encoding=encoding,
-    )
+    data = None
+    if encoding in ("utf-8", "ascii", "utf8"):
+        from .. import native
+
+        data = native.fastcsv_parse(path, header_lines=header_lines, sep=sep)
+        if data is not None:
+            data = data.astype(np.dtype(dtype._np_type), copy=False)
+    if data is None:
+        data = np.genfromtxt(
+            path,
+            delimiter=sep,
+            skip_header=header_lines,
+            dtype=np.dtype(dtype._np_type),
+            encoding=encoding,
+        )
     return factories.array(data, dtype=dtype, split=split, device=device, comm=comm)
 
 

@@ -1,0 +1,86 @@
+"""Native (C++) runtime component tests: the threaded CSV scanner backing
+ht.load_csv (reference io.py:665-885's byte-range partitioning on the IO
+controller's threads)."""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+import pytest
+
+import heat_tpu as ht
+from heat_tpu import native
+
+pytestmark = pytest.mark.skipif(
+    not native.fastcsv_available(), reason="no C++ toolchain for the native scanner"
+)
+
+
+def _write(tmp_path, name, text):
+    p = tmp_path / name
+    p.write_text(text)
+    return str(p)
+
+
+def test_parity_large_with_header(tmp_path):
+    rng = np.random.default_rng(0)
+    M = rng.normal(size=(4000, 9))
+    p = str(tmp_path / "m.csv")
+    np.savetxt(p, M, delimiter=",", header="h", comments="")
+    got = native.fastcsv_parse(p, header_lines=1)
+    np.testing.assert_allclose(got, np.genfromtxt(p, delimiter=",", skip_header=1), rtol=1e-12)
+
+
+def test_parity_forms(tmp_path):
+    cases = [
+        ("sci.csv", "1e-3;-2.5;+4\n0.5;nan;3\n", ";"),
+        ("col.csv", "1\n2\n3\n", ","),
+        ("row.csv", "1,2,3\n", ","),
+        ("noeol.csv", "1,2\n3,4", ","),
+        ("blank.csv", "1,2\n\n3,4\n", ","),
+    ]
+    for name, text, sep in cases:
+        p = _write(tmp_path, name, text)
+        got = native.fastcsv_parse(p, sep=sep)
+        exp = np.genfromtxt(p, delimiter=sep)
+        np.testing.assert_allclose(got, exp, rtol=1e-12)
+
+
+def test_missing_fields_are_nan(tmp_path):
+    p = _write(tmp_path, "gaps.csv", "1,,3\n4,5,\n")
+    got = native.fastcsv_parse(p)
+    exp = np.genfromtxt(p, delimiter=",")
+    np.testing.assert_array_equal(np.isnan(got), np.isnan(exp))
+    np.testing.assert_allclose(np.nan_to_num(got), np.nan_to_num(exp))
+
+
+def test_ragged_returns_none(tmp_path):
+    p = _write(tmp_path, "ragged.csv", "1,2\n3\n")
+    assert native.fastcsv_parse(p) is None
+
+
+def test_missing_file_returns_none(tmp_path):
+    assert native.fastcsv_parse(str(tmp_path / "nope.csv")) is None
+
+
+def test_load_csv_uses_native_and_shards(tmp_path):
+    rng = np.random.default_rng(1)
+    M = rng.normal(size=(97, 5)).astype(np.float32)  # prime rows: uneven shards
+    p = str(tmp_path / "data.csv")
+    np.savetxt(p, M, delimiter=",")
+    X = ht.load_csv(p, split=0)
+    assert X.split == 0
+    np.testing.assert_allclose(X.numpy(), M, rtol=1e-5)
+    Y = ht.load_csv(p, sep=",", dtype=ht.float64)
+    assert Y.dtype == ht.float64
+
+
+def test_load_csv_iris_dataset():
+    data_dir = os.path.join(os.path.dirname(ht.__file__), "datasets", "data")
+    iris = os.path.join(data_dir, "iris.csv")
+    if not os.path.exists(iris):
+        pytest.skip("no bundled iris.csv")
+    X = ht.load_csv(iris, sep=";", split=0)
+    assert X.shape[0] > 100 and X.ndim == 2
+    assert np.isfinite(X.numpy()).all()
