@@ -283,6 +283,43 @@ class DNDarray:
         there an implicit resplit(None) + .numpy())."""
         return np.asarray(self.__array)
 
+    def copy(self) -> "DNDarray":
+        """An independent copy of this array (reference dndarray.py: ``copy``
+        → memory.copy)."""
+        from . import memory
+
+        return memory.copy(self)
+
+    def is_distributed(self) -> bool:
+        """True when data lives split across more than one mesh position
+        (reference dndarray.py:1771-1779)."""
+        return self.__split is not None and self.__comm.is_distributed()
+
+    @property
+    def numdims(self) -> int:
+        """Deprecated alias of :attr:`ndim` (reference dndarray.py:245)."""
+        warnings.warn("numdims is deprecated, use ndim instead", DeprecationWarning, stacklevel=2)
+        return self.ndim
+
+    def save(self, path: str, *args, **kwargs) -> None:
+        """Save to HDF5/NetCDF/CSV by file extension (reference
+        dndarray.py:3104)."""
+        from . import io
+
+        io.save(self, path, *args, **kwargs)
+
+    def save_hdf5(self, path: str, dataset: str, mode: str = "w", **kwargs) -> None:
+        """Save to an HDF5 dataset (reference dndarray.py:3132)."""
+        from . import io
+
+        io.save_hdf5(self, path, dataset, mode, **kwargs)
+
+    def save_netcdf(self, path: str, variable: str, mode: str = "w", **kwargs) -> None:
+        """Save to a NetCDF variable (reference dndarray.py:3162)."""
+        from . import io
+
+        io.save_netcdf(self, path, variable, mode, **kwargs)
+
     def __array__(self, dtype=None):
         arr = np.asarray(self.__array)
         return arr.astype(dtype) if dtype is not None else arr
@@ -838,6 +875,10 @@ class DNDarray:
         from . import rounding
 
         return rounding.abs(self, out, dtype)
+
+    def absolute(self, out=None, dtype=None):
+        """Alias of :meth:`abs` (reference heat/core/dndarray.py:506)."""
+        return self.abs(out, dtype)
 
     def fabs(self, out=None):
         from . import rounding

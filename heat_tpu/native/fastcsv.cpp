@@ -18,6 +18,7 @@
 #include <cstdio>
 #include <cstdlib>
 #include <cstring>
+#include <string>
 #include <thread>
 #include <vector>
 
@@ -97,6 +98,13 @@ int64_t count_rows(const char* d, size_t start, size_t end, char sep) {
 double parse_field(const char* d, size_t p, size_t field_end, bool at_map_end) {
     if (p == field_end) return __builtin_nan("");
     if (!at_map_end) {
+        // strtod skips leading whitespace without bound — on an
+        // all-whitespace field it would run past the terminator (and past
+        // the mapping on a page-aligned file).  Resolve such fields to NaN
+        // here so strtod always starts inside the field.
+        size_t q = p;
+        while (q < field_end && isspace(static_cast<unsigned char>(d[q]))) ++q;
+        if (q == field_end) return __builtin_nan("");
         char* endp = nullptr;
         double v = strtod(d + p, &endp);
         size_t stop = static_cast<size_t>(endp - d);
@@ -113,20 +121,18 @@ double parse_field(const char* d, size_t p, size_t field_end, bool at_map_end) {
 }
 
 // Parse rows of `cols` sep-separated doubles from [start, end) into out.
-// Empty/unparseable fields become NaN (genfromtxt semantics).  Returns
-// rows parsed, or -2 on a column-count mismatch.
+// Empty/unparseable fields become NaN (genfromtxt semantics).  `map_end` is
+// the mapped-file size, so the final field of a file with no trailing
+// newline takes the bounded-copy path in parse_field.  Returns rows parsed,
+// or -2 on a column-count mismatch.
 int64_t parse_rows(const char* d, size_t start, size_t end, char sep,
-                   int64_t cols, double* out) {
+                   int64_t cols, double* out, size_t map_end) {
     int64_t row = 0;
     size_t pos = start;
     while (pos < end) {
         const char* nl = static_cast<const char*>(memchr(d + pos, '\n', end - pos));
         size_t line_end = nl ? static_cast<size_t>(nl - d) : end;
-        bool blank = true;
-        for (size_t i = pos; i < line_end; ++i) {
-            if (!isspace(static_cast<unsigned char>(d[i]))) { blank = false; break; }
-        }
-        if (!blank) {
+        if (!is_blank(d, pos, line_end, sep)) {
             // field count must match exactly (genfromtxt raises on ragged)
             int64_t nsep = 0;
             for (size_t i = pos; i < line_end; ++i)
@@ -141,19 +147,7 @@ int64_t parse_rows(const char* d, size_t start, size_t end, char sep,
                         memchr(d + p, sep, line_end - p));
                     field_end = static_cast<size_t>(s - d);
                 }
-                char buf[64];
-                size_t len = field_end - p;
-                if (len == 0 || len >= sizeof(buf)) {
-                    dst[c] = __builtin_nan("");
-                } else {
-                    memcpy(buf, d + p, len);
-                    buf[len] = '\0';
-                    char* endp = nullptr;
-                    double v = strtod(buf, &endp);
-                    // trailing whitespace ok; anything else -> NaN
-                    while (endp && isspace(static_cast<unsigned char>(*endp))) ++endp;
-                    dst[c] = (endp && *endp == '\0' && endp != buf) ? v : __builtin_nan("");
-                }
+                dst[c] = parse_field(d, p, field_end, field_end == map_end);
                 p = field_end + 1;
             }
             ++row;
@@ -183,17 +177,14 @@ int64_t fcsv_scan(const char* path, int64_t header_lines, char sep,
     Mapped m = map_file(path);
     if (!m.ok()) return -1;
     size_t start = skip_lines(m.data, m.size, header_lines);
-    *out_rows = count_rows(m.data, start, m.size);
+    *out_rows = count_rows(m.data, start, m.size, sep);
     *out_cols = 0;
     // columns from the first non-blank line
     size_t pos = start;
     while (pos < m.size) {
         const char* nl = static_cast<const char*>(memchr(m.data + pos, '\n', m.size - pos));
         size_t line_end = nl ? static_cast<size_t>(nl - m.data) : m.size;
-        bool blank = true;
-        for (size_t i = pos; i < line_end; ++i)
-            if (!isspace(static_cast<unsigned char>(m.data[i]))) { blank = false; break; }
-        if (!blank) {
+        if (!is_blank(m.data, pos, line_end, sep)) {
             int64_t cols = 1;
             for (size_t i = pos; i < line_end; ++i)
                 if (m.data[i] == sep) ++cols;
@@ -234,7 +225,7 @@ int64_t fcsv_parse(const char* path, int64_t header_lines, char sep,
     {
         std::vector<std::thread> th;
         for (int64_t t = 0; t < T; ++t)
-            th.emplace_back([&, t] { counts[t] = count_rows(m.data, bounds[t], bounds[t + 1]); });
+            th.emplace_back([&, t] { counts[t] = count_rows(m.data, bounds[t], bounds[t + 1], sep); });
         for (auto& x : th) x.join();
     }
     std::vector<int64_t> offs(T + 1, 0);
@@ -248,7 +239,7 @@ int64_t fcsv_parse(const char* path, int64_t header_lines, char sep,
         for (int64_t t = 0; t < T; ++t)
             th.emplace_back([&, t] {
                 status[t] = parse_rows(m.data, bounds[t], bounds[t + 1], sep, cols,
-                                       out + offs[t] * cols);
+                                       out + offs[t] * cols, m.size);
             });
         for (auto& x : th) x.join();
     }

@@ -143,3 +143,32 @@ def test_to_device():
     x = ht.arange(4, split=0)
     y = x.to_device("cpu")
     np.testing.assert_array_equal(y.numpy(), x.numpy())
+
+
+def test_copy_independent():
+    x = ht.arange(10, split=0)
+    y = x.copy()
+    assert y is not x
+    np.testing.assert_array_equal(y.numpy(), x.numpy())
+    assert y.split == x.split and y.dtype == x.dtype
+
+
+def test_is_distributed():
+    assert ht.arange(10, split=0).is_distributed() == (ht.get_comm().size > 1)
+    assert not ht.arange(10, split=None).is_distributed()
+
+
+def test_absolute_and_numdims():
+    x = ht.array([-1.0, 2.0, -3.0], split=0)
+    np.testing.assert_array_equal(x.absolute().numpy(), [1.0, 2.0, 3.0])
+    assert x.numdims == x.ndim == 1
+
+
+def test_save_method(tmp_path):
+    if not ht.io.supports_hdf5():
+        pytest.skip("h5py not available")
+    x = ht.arange(24, split=0).reshape((4, 6))
+    p = str(tmp_path / "arr.h5")
+    x.save(p, "data")
+    y = ht.load(p, dataset="data", split=0)
+    np.testing.assert_array_equal(y.numpy(), x.numpy())
