@@ -73,9 +73,12 @@ def heat_kmeans_rate(data: np.ndarray, init: np.ndarray):
     X = ht.array(data, split=0)
     init_nd = ht.array(init)
     _timed_fit(KMeans, init_nd, X, ITERS)  # warmup: compile the fused loop
-    lo, hi = ITERS, 5 * ITERS
-    t_lo = min(_timed_fit(KMeans, init_nd, X, lo) for _ in range(3))
-    t_hi = min(_timed_fit(KMeans, init_nd, X, hi) for _ in range(3))
+    # slope window must dwarf tunnel jitter (tens of ms): at ~60 us/iter a
+    # 30->150 window spans only ~8 ms of real work, so the measurement
+    # drowns; 200->1000 spans ~50 ms and the slope stabilizes
+    lo, hi = 200, 1000
+    t_lo = min(_timed_fit(KMeans, init_nd, X, lo) for _ in range(5))
+    t_hi = min(_timed_fit(KMeans, init_nd, X, hi) for _ in range(5))
     per_iter = max((t_hi - t_lo) / (hi - lo), 1e-9)
     return 1.0 / per_iter, X
 
