@@ -16,6 +16,7 @@ import jax.numpy as jnp
 from . import _operations
 from . import types
 from .dndarray import DNDarray
+from .sanitation import merge_keepdims
 from .stride_tricks import sanitize_axis
 
 __all__ = [
@@ -182,7 +183,7 @@ def cumprod(a, axis, dtype=None, out=None):
 cumproduct = cumprod
 
 
-def diff(a, n: int = 1, axis: int = -1):
+def diff(a, n: int = 1, axis: int = -1, prepend=None, append=None):
     """n-th discrete difference along ``axis``
     (reference arithmetics.py:286-344 — hand-written neighbor Send/Recv;
     here one global jnp.diff)."""
@@ -194,19 +195,44 @@ def diff(a, n: int = 1, axis: int = -1):
 
     sanitize_in(a)
     axis = sanitize_axis(a.shape, axis)
-    result = jnp.diff(a.larray, n=n, axis=axis)
+
+    def _edge(v):
+        if v is None:
+            return None
+        arr = v.larray if isinstance(v, DNDarray) else jnp.asarray(v)
+        if arr.ndim == 0:
+            eshape = list(a.shape)
+            eshape[axis] = 1
+            arr = jnp.broadcast_to(arr, eshape)
+        return arr
+
+    edges = {"prepend": _edge(prepend), "append": _edge(append)}
+    edges = {k: v for k, v in edges.items() if v is not None}
+    # numpy semantics: result dtype promotes across the input and both edges
+    rtype = jnp.result_type(a.larray, *edges.values())
+    kw = {k: v.astype(rtype) for k, v in edges.items()}
+    result = jnp.diff(a.larray.astype(rtype), n=n, axis=axis, **kw)
     result = a.comm.apply_sharding(result, a.split)
     return DNDarray(
-        result, tuple(result.shape), a.dtype, a.split, a.device, a.comm, a.balanced
+        result,
+        tuple(result.shape),
+        types.canonical_heat_type(result.dtype),
+        a.split,
+        a.device,
+        a.comm,
+        a.balanced,
     )
 
 
-def sum(x, axis=None, out=None, keepdims=None):
+def sum(x, axis=None, out=None, keepdims=None, keepdim=None):
     """Sum reduction (reference arithmetics.py:878-924; the cross-split
-    Allreduce of _operations.py:425-429 is compiler-inserted here)."""
+    Allreduce of _operations.py:425-429 is compiler-inserted here).
+    ``keepdim`` is the reference spelling; ``keepdims`` the numpy one."""
+    keepdims = merge_keepdims(keepdims, keepdim)
     return _operations.__reduce_op(jnp.sum, x, axis, out, neutral=0, keepdims=keepdims)
 
 
-def prod(x, axis=None, out=None, keepdims=None):
+def prod(x, axis=None, out=None, keepdims=None, keepdim=None):
     """Product reduction (reference arithmetics.py:787-833)."""
+    keepdims = merge_keepdims(keepdims, keepdim)
     return _operations.__reduce_op(jnp.prod, x, axis, out, neutral=1, keepdims=keepdims)

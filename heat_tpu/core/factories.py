@@ -135,11 +135,16 @@ def array(
     return _wrap(garr, dtype, split, device, comm)
 
 
-def asarray(obj, dtype=None, device=None) -> DNDarray:
-    """No-copy ``array`` (numpy-parity convenience)."""
-    if isinstance(obj, DNDarray) and (dtype is None or obj.dtype is types.canonical_heat_type(dtype)):
+def asarray(obj, dtype=None, order="C", is_split=None, device=None) -> DNDarray:
+    """No-copy ``array`` (reference factories.py:438-571)."""
+    sanitize_memory_layout(None, order)
+    if (
+        isinstance(obj, DNDarray)
+        and is_split is None
+        and (dtype is None or obj.dtype is types.canonical_heat_type(dtype))
+    ):
         return obj
-    return array(obj, dtype=dtype, copy=False, device=device)
+    return array(obj, dtype=dtype, copy=False, is_split=is_split, device=device)
 
 
 def arange(*args, dtype=None, split=None, device=None, comm=None) -> DNDarray:
@@ -231,9 +236,10 @@ def full_like(a, fill_value, dtype=types.float32, split=None, device=None, comm=
     return __factory_like(a, dtype, split, full, device, comm, order, fill_value=fill_value)
 
 
-def eye(shape, dtype=types.float32, split=None, device=None, comm=None) -> DNDarray:
+def eye(shape, dtype=types.float32, split=None, device=None, comm=None, order="C") -> DNDarray:
     """Identity-like matrix (reference factories.py:572-643 — there each rank
     computes its diagonal offset; here one global jnp.eye)."""
+    sanitize_memory_layout(None, order)
     if isinstance(shape, (int, np.integer)):
         gshape = (int(shape), int(shape))
     else:

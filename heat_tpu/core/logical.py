@@ -10,6 +10,7 @@ from __future__ import annotations
 import jax.numpy as jnp
 
 from . import _operations, types
+from .sanitation import merge_keepdims
 from .dndarray import DNDarray
 
 __all__ = [
@@ -24,15 +25,17 @@ __all__ = [
 ]
 
 
-def all(x, axis=None, out=None, keepdims=None):
+def all(x, axis=None, out=None, keepdims=None, keepdim=None):
     """True where all elements (along axis) are nonzero
     (reference logical.py:24-86; the MPI.LAND Allreduce is XLA's)."""
+    keepdims = merge_keepdims(keepdims, keepdim)
     return _operations.__reduce_op(jnp.all, x, axis, out, neutral=1, keepdims=keepdims)
 
 
-def any(x, axis=None, out=None, keepdims=False):
+def any(x, axis=None, out=None, keepdims=None, keepdim=None):
     """True where any element (along axis) is nonzero
     (reference logical.py:133-180)."""
+    keepdims = merge_keepdims(keepdims, keepdim)
     return _operations.__reduce_op(jnp.any, x, axis, out, neutral=0, keepdims=keepdims)
 
 

@@ -294,34 +294,34 @@ def shape(a: DNDarray) -> tuple:
     return a.gshape
 
 
-def split(x: DNDarray, indices_or_sections, axis: int = 0) -> List[DNDarray]:
+def split(ary: DNDarray, indices_or_sections, axis: int = 0) -> List[DNDarray]:
     """Split into sub-arrays (reference manipulations.py:2162-2318)."""
-    sanitize_in(x)
-    axis = sanitize_axis(x.shape, axis)
+    sanitize_in(ary)
+    axis = sanitize_axis(ary.shape, axis)
     if isinstance(indices_or_sections, (int, np.integer)):
-        if x.shape[axis] % int(indices_or_sections) != 0:
+        if ary.shape[axis] % int(indices_or_sections) != 0:
             raise ValueError("array split does not result in an equal division")
     if isinstance(indices_or_sections, DNDarray):
         indices_or_sections = np.asarray(indices_or_sections.larray)
-    parts = jnp.split(x.larray, indices_or_sections, axis=axis)
-    return [_rewrap(x, p, x.split, x.dtype) for p in parts]
+    parts = jnp.split(ary.larray, indices_or_sections, axis=axis)
+    return [_rewrap(ary, p, ary.split, ary.dtype) for p in parts]
 
 
-def dsplit(x: DNDarray, indices_or_sections) -> List[DNDarray]:
+def dsplit(ary: DNDarray, indices_or_sections) -> List[DNDarray]:
     """(reference manipulations.py:2319-2347)"""
-    return split(x, indices_or_sections, axis=2)
+    return split(ary, indices_or_sections, axis=2)
 
 
-def hsplit(x: DNDarray, indices_or_sections) -> List[DNDarray]:
+def hsplit(ary: DNDarray, indices_or_sections) -> List[DNDarray]:
     """(reference manipulations.py:2348-2380)"""
-    if x.ndim < 2:
-        return split(x, indices_or_sections, axis=0)
-    return split(x, indices_or_sections, axis=1)
+    if ary.ndim < 2:
+        return split(ary, indices_or_sections, axis=0)
+    return split(ary, indices_or_sections, axis=1)
 
 
-def vsplit(x: DNDarray, indices_or_sections) -> List[DNDarray]:
+def vsplit(ary: DNDarray, indices_or_sections) -> List[DNDarray]:
     """(reference manipulations.py:2381-2413)"""
-    return split(x, indices_or_sections, axis=0)
+    return split(ary, indices_or_sections, axis=0)
 
 
 def squeeze(x: DNDarray, axis=None) -> DNDarray:
@@ -388,17 +388,17 @@ def row_stack(arrays) -> DNDarray:
     return concatenate(reshaped, axis=0)
 
 
-def hstack(arrays) -> DNDarray:
+def hstack(tup) -> DNDarray:
     """(reference manipulations.py: hstack)"""
-    arrays = list(arrays)
+    arrays = list(tup)
     if all(a.ndim == 1 for a in arrays):
         return concatenate(arrays, axis=0)
     return concatenate(arrays, axis=1)
 
 
-def vstack(arrays) -> DNDarray:
+def vstack(tup) -> DNDarray:
     """(reference manipulations.py: vstack)"""
-    return row_stack(list(arrays))
+    return row_stack(list(tup))
 
 
 def unique(a: DNDarray, sorted: bool = False, return_inverse: bool = False, axis=None):

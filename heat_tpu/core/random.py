@@ -39,7 +39,9 @@ __all__ = [
     "randint",
     "randn",
     "random",
+    "random_sample",
     "randperm",
+    "ranf",
     "sample",
     "seed",
     "set_state",
@@ -51,12 +53,12 @@ __seed: int = 0
 __counter: int = 0
 
 
-def seed(new_seed: Optional[int] = None) -> None:
+def seed(seed: Optional[int] = None) -> None:
     """(Re-)seed the global generator (reference random.py:588-605)."""
     global __seed, __counter
-    if new_seed is None:
-        new_seed = int(np.random.SeedSequence().entropy % (2**63))
-    __seed = int(new_seed)
+    if seed is None:
+        seed = int(np.random.SeedSequence().entropy % (2**63))
+    __seed = int(seed)
     __counter = 0
 
 
@@ -107,13 +109,17 @@ def rand(*args, dtype=types.float32, split=None, device=None, comm=None) -> DNDa
     return _finalize(garr, dtype, split, device, comm)
 
 
-def random(shape=None, dtype=types.float32, split=None, device=None, comm=None) -> DNDarray:
-    """numpy-style alias for :func:`rand` taking a shape tuple."""
-    shape = () if shape is None else sanitize_shape(shape)
+def random_sample(shape=None, dtype=types.float32, split=None, device=None, comm=None) -> DNDarray:
+    """Uniform [0, 1) samples for a shape tuple
+    (reference random.py:550-585; aliases ``random``/``ranf``/``sample``;
+    no/empty shape yields a single sample of shape (1,) as there)."""
+    if not shape:
+        shape = (1,)
+    shape = sanitize_shape(shape)
     return rand(*shape, dtype=dtype, split=split, device=device, comm=comm)
 
 
-sample = random
+random = ranf = sample = random_sample
 
 
 def uniform(low=0.0, high=1.0, size=None, dtype=types.float32, split=None, device=None, comm=None) -> DNDarray:

@@ -16,6 +16,7 @@ import numpy as np
 import jax.numpy as jnp
 
 __all__ = [
+    "merge_keepdims",
     "sanitize_in",
     "sanitize_infinity",
     "sanitize_in_tensor",
@@ -24,6 +25,15 @@ __all__ = [
     "sanitize_sequence",
     "scalar_to_1d",
 ]
+
+
+def merge_keepdims(keepdims, keepdim) -> bool:
+    """Reconcile the numpy (``keepdims``) and reference/torch (``keepdim``)
+    spellings with one rule everywhere: an explicit ``keepdims`` wins,
+    otherwise ``keepdim`` applies, otherwise False."""
+    if keepdims is None:
+        keepdims = keepdim
+    return bool(keepdims) if keepdims is not None else False
 
 
 def sanitize_in(x: Any) -> None:

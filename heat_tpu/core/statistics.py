@@ -20,7 +20,7 @@ import jax.numpy as jnp
 from . import _operations, factories, types
 from ._compile import jitted
 from .dndarray import DNDarray
-from .sanitation import sanitize_in
+from .sanitation import merge_keepdims, sanitize_in
 from .stride_tricks import sanitize_axis
 
 __all__ = [
@@ -244,8 +244,9 @@ def skew(x: DNDarray, axis=None, unbiased: bool = True):
     return _wrap_reduced(x, g1, axis)
 
 
-def max(x, axis=None, out=None, keepdims=None):
+def max(x, axis=None, out=None, keepdims=None, keepdim=None):
     """Maximum along axes (reference statistics.py:616-727)."""
+    keepdims = merge_keepdims(keepdims, keepdim)
     return _operations.__reduce_op(jnp.max, x, axis, out, keepdims=keepdims)
 
 
@@ -267,13 +268,17 @@ def mean(x, axis=None):
     return _wrap_reduced(x, fn(x.larray), axis)
 
 
-def median(x: DNDarray, axis=None, out=None, keepdims: bool = False):
-    """Median = 50th percentile (reference statistics.py:845-877)."""
+def median(x: DNDarray, axis=None, keepdim=None, out=None, keepdims=None):
+    """Median = 50th percentile (reference statistics.py:845-877 —
+    signature there is ``median(x, axis, keepdim)``, so ``keepdim`` keeps
+    the third positional slot)."""
+    keepdims = merge_keepdims(keepdims, keepdim)
     return percentile(x, 50.0, axis=axis, out=out, keepdims=keepdims)
 
 
-def min(x, axis=None, out=None, keepdims=None):
+def min(x, axis=None, out=None, keepdims=None, keepdim=None):
     """Minimum along axes (reference statistics.py:1058-1123)."""
+    keepdims = merge_keepdims(keepdims, keepdim)
     return _operations.__reduce_op(jnp.min, x, axis, out, keepdims=keepdims)
 
 
@@ -282,9 +287,10 @@ def minimum(x1, x2, out=None):
     return _operations.__binary_op(jnp.minimum, x1, x2, out)
 
 
-def percentile(x: DNDarray, q, axis=None, out=None, interpolation: str = "linear", keepdims: bool = False):
+def percentile(x: DNDarray, q, axis=None, out=None, interpolation: str = "linear", keepdims=None, keepdim=None):
     """q-th percentile(s) along an axis (reference statistics.py:1171-1422 —
     distributed via resplit + partition gather; here XLA's global sort)."""
+    keepdims = merge_keepdims(keepdims, keepdim)
     sanitize_in(x)
     axis = sanitize_axis(x.shape, axis)
     method = {"linear": "linear", "lower": "lower", "higher": "higher", "midpoint": "midpoint", "nearest": "nearest"}[interpolation]

@@ -55,8 +55,8 @@ def arctan(x, out=None):
 atan = arctan
 
 
-def arctan2(t1, t2):
-    """Quadrant-aware inverse tangent of t1/t2
+def arctan2(x1, x2):
+    """Quadrant-aware inverse tangent of x1/x2
     (reference trigonometrics.py:129-171)."""
     from . import _operations as ops
 
@@ -65,7 +65,7 @@ def arctan2(t1, t2):
         b = b.astype(jnp.float32) if jnp.issubdtype(b.dtype, jnp.integer) else b
         return jnp.arctan2(a, b)
 
-    return ops.__binary_op(_atan2, t1, t2)
+    return ops.__binary_op(_atan2, x1, x2)
 
 
 atan2 = arctan2

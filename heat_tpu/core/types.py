@@ -290,15 +290,15 @@ def heat_type_of(obj: Any) -> type:
     raise TypeError(f"cannot determine heat type of {type(obj)}")
 
 
-def heat_type_is_exact(a_type: Any) -> builtins.bool:
+def heat_type_is_exact(ht_dtype: Any) -> builtins.bool:
     """True for integer/bool types (reference types.py helper)."""
-    t = canonical_heat_type(a_type)
+    t = canonical_heat_type(ht_dtype)
     return issubclass(t, integer) or t is bool
 
 
-def heat_type_is_inexact(a_type: Any) -> builtins.bool:
+def heat_type_is_inexact(ht_dtype: Any) -> builtins.bool:
     """True for floating types."""
-    return issubclass(canonical_heat_type(a_type), floating)
+    return issubclass(canonical_heat_type(ht_dtype), floating)
 
 
 def issubdtype(arg1: Any, arg2: type) -> builtins.bool:

@@ -121,3 +121,62 @@ def test_where_nonzero():
     assert_array_equal(w, np.where(a > 0, a, -1))
     with pytest.raises(TypeError):
         ht.where(x > 0, x)
+
+
+def test_keepdim_reference_spelling():
+    """Reference (torch-spelled) ``keepdim`` kwarg works on every reduction
+    (reference arithmetics.py:878, statistics.py:616/1058, logical.py:24)."""
+    a = np.arange(12, dtype=np.float32).reshape(3, 4)
+    x = ht.array(a, split=0)
+    assert_array_equal(ht.sum(x, axis=0, keepdim=True), a.sum(0, keepdims=True))
+    assert_array_equal(ht.prod(x + 1, axis=1, keepdim=True), (a + 1).prod(1, keepdims=True))
+    assert_array_equal(ht.max(x, axis=0, keepdim=True), a.max(0, keepdims=True))
+    assert_array_equal(ht.min(x, axis=1, keepdim=True), a.min(1, keepdims=True))
+    assert_array_equal(ht.all(x > -1, axis=0, keepdim=True), (a > -1).all(0, keepdims=True))
+    assert_array_equal(ht.any(x > 5, axis=1, keepdim=True), (a > 5).any(1, keepdims=True))
+    med = ht.median(x, axis=0, keepdim=True)
+    np.testing.assert_allclose(med.numpy(), np.median(a, axis=0, keepdims=True))
+    # reference positional form: median(x, axis, keepdim)
+    np.testing.assert_allclose(
+        ht.median(x, 0, True).numpy(), np.median(a, axis=0, keepdims=True))
+
+
+def test_diff_prepend_append():
+    """``prepend``/``append`` edges (reference arithmetics.py:286-344)."""
+    a = np.array([2.0, 4.0, 7.0, 11.0], dtype=np.float32)
+    x = ht.array(a, split=0)
+    np.testing.assert_allclose(
+        ht.diff(x, prepend=0.0).numpy(), np.diff(a, prepend=0.0))
+    np.testing.assert_allclose(
+        ht.diff(x, append=ht.array([20.0])).numpy(), np.diff(a, append=[20.0]))
+    b = np.arange(12, dtype=np.float32).reshape(3, 4)
+    y = ht.array(b, split=0)
+    np.testing.assert_allclose(
+        ht.diff(y, axis=1, prepend=0.0).numpy(), np.diff(b, axis=1, prepend=0.0))
+
+
+def test_reference_keyword_names():
+    """Keyword-call compatibility with reference parameter names
+    (manipulations.py split/stack families, trigonometrics.arctan2,
+    factories.asarray/eye, random.seed/random_sample, types helpers)."""
+    a = np.arange(12, dtype=np.float32).reshape(4, 3)
+    x = ht.array(a, split=0)
+    parts = ht.vsplit(ary=x, indices_or_sections=2)
+    assert len(parts) == 2 and parts[0].shape == (2, 3)
+    assert ht.hsplit(ary=x, indices_or_sections=3)[0].shape == (4, 1)
+    assert ht.split(ary=x, indices_or_sections=2, axis=0)[1].shape == (2, 3)
+    z = ht.array(np.arange(8.0).reshape(2, 2, 2))
+    assert ht.dsplit(ary=z, indices_or_sections=2)[0].shape == (2, 2, 1)
+    assert ht.hstack(tup=[ht.ones(3), ht.zeros(3)]).shape == (6,)
+    assert ht.vstack(tup=[ht.ones(3), ht.zeros(3)]).shape == (2, 3)
+    assert_array_equal(
+        ht.arctan2(x1=ht.ones(3), x2=ht.ones(3)), np.arctan2(np.ones(3, np.float32), 1))
+    assert ht.asarray([1, 2, 3], order="C").shape == (3,)
+    assert ht.eye(3, order="C").shape == (3, 3)
+    ht.random.seed(seed=7)
+    s = ht.random.random_sample((2, 3))
+    assert s.shape == (2, 3)
+    assert ht.random.random_sample().shape == (1,)  # reference random.py:580
+    assert ht.random.ranf is ht.random.random_sample is ht.random.sample
+    assert ht.types.heat_type_is_exact(ht_dtype=ht.int64)
+    assert ht.types.heat_type_is_inexact(ht_dtype=ht.float64)
