@@ -1,0 +1,49 @@
+"""KNN classification of the iris dataset with leave-some-out validation.
+
+TPU-native counterpart of reference examples/classification/demo_knn.py:
+loads the bundled iris HDF5, holds out a random slice of labelled samples,
+fits :class:`heat_tpu.classification.KNN`, and reports accuracy.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+import numpy as np
+
+import heat_tpu as ht
+from heat_tpu.classification import KNN
+
+DATA = os.path.join(os.path.dirname(ht.__file__), "datasets", "data", "iris.h5")
+
+
+def calculate_accuracy(new_y: ht.DNDarray, verification_y: ht.DNDarray) -> float:
+    """Fraction of correctly labelled samples (discrete classes)."""
+    if new_y.gshape != verification_y.gshape:
+        raise ValueError(
+            f"Expecting results of same length, got {new_y.gshape}, {verification_y.gshape}"
+        )
+    count = ht.sum(ht.where(new_y == verification_y, 1, 0))
+    return float(count) / new_y.gshape[0]
+
+
+def main() -> None:
+    x = ht.load_hdf5(DATA, dataset="data", split=0)
+    # iris ships 50 samples per class, in class order
+    y = ht.array(np.repeat([0, 1, 2], 50), split=0)
+
+    # hold out every 5th sample for validation
+    mask = np.arange(150) % 5 == 0
+    train_x = ht.array(x.numpy()[~mask], split=0)
+    train_y = ht.array(y.numpy()[~mask], split=0)
+    test_x = ht.array(x.numpy()[mask], split=0)
+    test_y = ht.array(y.numpy()[mask], split=0)
+
+    knn = KNN(train_x, train_y, 5)
+    predicted = knn.predict(test_x)
+    print(f"KNN(5) iris accuracy: {calculate_accuracy(predicted, test_y):.3f}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,39 @@
+"""Lasso regression on the bundled diabetes dataset.
+
+TPU-native counterpart of reference examples/lasso/demo.py: loads the
+diabetes HDF5, normalizes features, sweeps the L1 penalty, and prints the
+coefficient paths (the reference plots them; here they go to stdout).
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+import numpy as np
+
+import heat_tpu as ht
+from heat_tpu.regression import Lasso
+
+DATA = os.path.join(os.path.dirname(ht.__file__), "datasets", "data", "diabetes.h5")
+
+
+def main() -> None:
+    x = ht.load_hdf5(DATA, dataset="x", split=0)
+    y = ht.load_hdf5(DATA, dataset="y", split=0)
+
+    # normalize: zero mean, unit variance per feature
+    x = (x - ht.mean(x, axis=0)) / ht.sqrt(ht.var(x, axis=0))
+
+    print("lam      nonzero  coefficients (first 5)")
+    for lam in (0.01, 0.05, 0.1, 0.5, 1.0):
+        estimator = Lasso(lam=lam, max_iter=100)
+        estimator.fit(x, y)
+        theta = np.asarray(estimator.coef_.numpy()).ravel()
+        nz = int((np.abs(theta) > 1e-8).sum())
+        head = [round(float(v), 3) for v in theta[:5]]
+        print(f"{lam:<8} {nz:<8} {head}")
+
+
+if __name__ == "__main__":
+    main()

@@ -47,6 +47,10 @@ class _KCluster(ClusteringMixin, BaseEstimator):
     n_clusters, init, max_iter, tol, random_state : as in the reference.
     """
 
+    #: estimator-specific "++" spelling of probability_based init
+    #: (reference kmeans.py:46-47, kmedians.py:31-32, kmedoids.py:31-32)
+    _init_plus_plus_alias: Optional[str] = None
+
     def __init__(
         self,
         metric: Callable,
@@ -56,6 +60,9 @@ class _KCluster(ClusteringMixin, BaseEstimator):
         tol: float,
         random_state: Optional[int],
     ):
+        # isinstance guard: DNDarray overloads == elementwise
+        if isinstance(init, str) and init == self._init_plus_plus_alias:
+            init = "probability_based"
         self.n_clusters = n_clusters
         self.init = init
         self.max_iter = max_iter

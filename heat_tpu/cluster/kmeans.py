@@ -39,6 +39,8 @@ class KMeans(_KCluster):
     random_state : int or None
     """
 
+    _init_plus_plus_alias = "kmeans++"
+
     def __init__(
         self,
         n_clusters: int = 8,
@@ -47,8 +49,6 @@ class KMeans(_KCluster):
         tol: float = 1e-4,
         random_state: Optional[int] = None,
     ):
-        if isinstance(init, str) and init == "kmeans++":
-            init = "probability_based"
         super().__init__(
             metric=lambda x, y: distance.cdist(x, y, quadratic_expansion=True),
             n_clusters=n_clusters,

@@ -44,6 +44,8 @@ def _masked_median(arr, labels, k):
 class KMedians(_KCluster):
     """K-Medians estimator (reference kmedians.py:5-42)."""
 
+    _init_plus_plus_alias = "kmedians++"
+
     def __init__(
         self,
         n_clusters: int = 8,

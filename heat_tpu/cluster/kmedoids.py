@@ -22,6 +22,8 @@ __all__ = ["KMedoids"]
 class KMedoids(_KCluster):
     """K-Medoids estimator (reference kmedoids.py:5-42)."""
 
+    _init_plus_plus_alias = "kmedoids++"
+
     def __init__(
         self,
         n_clusters: int = 8,

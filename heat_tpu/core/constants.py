@@ -13,9 +13,15 @@ inf = float("inf")
 nan = float("nan")
 """IEEE not-a-number."""
 
-# aliases (numpy/reference parity)
+# aliases (numpy/reference parity; the uppercase module-level names
+# INF/NAN/NINF/PI/E mirror reference constants.py:6-10)
 Euler = e
 Inf = inf
 Infty = inf
 Infinity = inf
 NaN = nan
+INF = inf
+NAN = nan
+NINF = -inf
+PI = pi
+E = e

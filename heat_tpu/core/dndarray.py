@@ -765,15 +765,15 @@ class DNDarray:
 
         return arithmetics.pow(self, other)
 
-    def prod(self, axis=None, out=None, keepdims=None):
+    def prod(self, axis=None, out=None, keepdims=None, keepdim=None):
         from . import arithmetics
 
-        return arithmetics.prod(self, axis, out, keepdims)
+        return arithmetics.prod(self, axis, out, keepdims, keepdim)
 
-    def sum(self, axis=None, out=None, keepdims=None):
+    def sum(self, axis=None, out=None, keepdims=None, keepdim=None):
         from . import arithmetics
 
-        return arithmetics.sum(self, axis, out, keepdims)
+        return arithmetics.sum(self, axis, out, keepdims, keepdim)
 
     def cumsum(self, axis=0):
         from . import arithmetics
@@ -916,15 +916,15 @@ class DNDarray:
         return rounding.trunc(self, out)
 
     # -- logical -------------------------------------------------------- #
-    def all(self, axis=None, out=None, keepdims=None):
+    def all(self, axis=None, out=None, keepdims=None, keepdim=None):
         from . import logical
 
-        return logical.all(self, axis, out, keepdims)
+        return logical.all(self, axis, out, keepdims, keepdim)
 
-    def any(self, axis=None, out=None, keepdims=False):
+    def any(self, axis=None, out=None, keepdims=None, keepdim=None):
         from . import logical
 
-        return logical.any(self, axis, out, keepdims)
+        return logical.any(self, axis, out, keepdims, keepdim)
 
     def allclose(self, other, rtol=1e-05, atol=1e-08, equal_nan=False):
         from . import logical
@@ -947,25 +947,25 @@ class DNDarray:
 
         return statistics.argmin(self, axis, out, **kwargs)
 
-    def max(self, axis=None, out=None, keepdims=None):
+    def max(self, axis=None, out=None, keepdims=None, keepdim=None):
         from . import statistics
 
-        return statistics.max(self, axis, out, keepdims)
+        return statistics.max(self, axis, out, keepdims, keepdim)
 
-    def min(self, axis=None, out=None, keepdims=None):
+    def min(self, axis=None, out=None, keepdims=None, keepdim=None):
         from . import statistics
 
-        return statistics.min(self, axis, out, keepdims)
+        return statistics.min(self, axis, out, keepdims, keepdim)
 
     def mean(self, axis=None):
         from . import statistics
 
         return statistics.mean(self, axis)
 
-    def median(self, axis=None, keepdims=False):
+    def median(self, axis=None, keepdim=None, keepdims=None):
         from . import statistics
 
-        return statistics.median(self, axis, keepdims=keepdims)
+        return statistics.median(self, axis, keepdim, keepdims=keepdims)
 
     def var(self, axis=None, ddof=0, **kwargs):
         from . import statistics

@@ -113,6 +113,9 @@ def random_sample(shape=None, dtype=types.float32, split=None, device=None, comm
     """Uniform [0, 1) samples for a shape tuple
     (reference random.py:550-585; aliases ``random``/``ranf``/``sample``;
     no/empty shape yields a single sample of shape (1,) as there)."""
+    # falsy shapes (None, (), 0) all yield one sample, matching the
+    # reference's `if not shape` exactly (diverges from numpy, which
+    # returns an empty array for shape=0)
     if not shape:
         shape = (1,)
     shape = sanitize_shape(shape)

@@ -172,3 +172,19 @@ def test_save_method(tmp_path):
     x.save(p, "data")
     y = ht.load(p, dataset="data", split=0)
     np.testing.assert_array_equal(y.numpy(), x.numpy())
+
+
+def test_method_keepdim_spelling():
+    """DNDarray reduction methods accept the reference 'keepdim' kwarg and
+    its positional slot (reference dndarray.py delegation methods)."""
+    a = np.arange(12, dtype=np.float32).reshape(3, 4)
+    x = ht.array(a, split=0)
+    assert x.sum(axis=0, keepdim=True).shape == (1, 4)
+    assert x.prod(axis=1, keepdim=True).shape == (3, 1)
+    assert x.max(axis=0, keepdim=True).shape == (1, 4)
+    assert x.min(axis=1, keepdim=True).shape == (3, 1)
+    assert (x > 0).all(axis=0, keepdim=True).shape == (1, 4)
+    assert (x > 5).any(axis=1, keepdim=True).shape == (3, 1)
+    assert x.median(0, True).shape == (1, 4)
+    np.testing.assert_allclose(
+        x.sum(0, None, None, True).numpy(), a.sum(0, keepdims=True))

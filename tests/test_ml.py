@@ -216,3 +216,24 @@ def test_parter():
     n = 30
     expected = 1.0 / (np.arange(n)[:, None] - np.arange(n)[None, :] + 0.5)
     np.testing.assert_allclose(P.numpy(), expected, rtol=1e-5)
+
+
+def test_plus_plus_init_aliases():
+    """'kmeans++'/'kmedians++'/'kmedoids++' map to probability_based init
+    (reference kmeans.py:46-47, kmedians.py:31-32, kmedoids.py:31-32)."""
+    rng = np.random.default_rng(3)
+    data = np.concatenate(
+        [rng.normal(loc=c, scale=0.3, size=(40, 2)).astype(np.float32) for c in (-4, 0, 4)]
+    )
+    x = ht.array(data, split=0)
+    for cls, alias in [
+        (ht.cluster.KMeans, "kmeans++"),
+        (ht.cluster.KMedians, "kmedians++"),
+        (ht.cluster.KMedoids, "kmedoids++"),
+    ]:
+        est = cls(n_clusters=3, init=alias, random_state=5)
+        est.fit(x)
+        centers = np.sort(est.cluster_centers_.numpy()[:, 0])
+        np.testing.assert_allclose(centers, [-4, 0, 4], atol=0.5)
+    with pytest.raises(ValueError):
+        ht.cluster.KMeans(n_clusters=3, init="bogus").fit(x)
