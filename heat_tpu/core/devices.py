@@ -101,6 +101,14 @@ if tpu is None:
             __registry["tpu"] = _dev
             break
 
+# export the accelerator singletons that exist, mirroring the reference's
+# conditional `gpu` definition (devices.py:66-74): present => importable
+# as ht.tpu / ht.gpu, absent => the attribute stays None and unexported
+if tpu is not None:
+    __all__.append("tpu")
+if gpu is not None:
+    __all__.append("gpu")
+
 __default_device: Device = None
 
 

@@ -124,3 +124,20 @@ def test_constants_uppercase_aliases():
     assert constants.INF == float("inf")
     assert constants.NINF == -float("inf")
     assert np.isnan(constants.NAN)
+
+
+def test_conditional_accelerator_singletons():
+    """ht.tpu / ht.gpu are exported only when the platform exists, like the
+    reference's conditional gpu singleton (reference devices.py:66-74).
+    Tests run on the cpu platform, so neither may be exported."""
+    from heat_tpu.core import devices
+
+    assert devices.cpu is not None
+    if devices.tpu is None:
+        assert not hasattr(ht, "tpu")
+    else:
+        assert ht.tpu is devices.tpu
+    if devices.gpu is None:
+        assert not hasattr(ht, "gpu")
+    else:
+        assert ht.gpu is devices.gpu
