@@ -479,12 +479,24 @@ class DNDarray:
     # indexing (reference dndarray.py:1476-1726, 3190-3339)               #
     # ------------------------------------------------------------------ #
     def __process_key(self, key):
-        """Convert DNDarray keys to jax arrays, pass everything else through."""
-        if isinstance(key, DNDarray):
-            return key.larray
+        """Convert DNDarray (and numpy-style list) keys to jax arrays, pass
+        everything else through.  Lists are advanced-index arrays in
+        numpy/reference semantics (dndarray.py:1476) but rejected raw by
+        jax, so they are wrapped here."""
+
+        def one(k):
+            if isinstance(k, DNDarray):
+                return k.larray
+            if isinstance(k, (list, np.ndarray)):
+                arr = np.asarray(k)
+                if arr.size == 0:  # numpy: a[[]] selects nothing, not float64
+                    arr = arr.astype(np.int32)
+                return jnp.asarray(arr)
+            return k
+
         if isinstance(key, tuple):
-            return tuple(k.larray if isinstance(k, DNDarray) else k for k in key)
-        return key
+            return tuple(one(k) for k in key)
+        return one(key)
 
     def __result_split(self, key, result_ndim: int) -> Optional[int]:
         """Split bookkeeping for indexing results.

@@ -188,3 +188,31 @@ def test_method_keepdim_spelling():
     assert x.median(0, True).shape == (1, 4)
     np.testing.assert_allclose(
         x.sum(0, None, None, True).numpy(), a.sum(0, keepdims=True))
+
+
+def test_list_and_numpy_advanced_keys():
+    """Python-list and numpy-array keys behave as advanced indices, as in
+    numpy and the reference's distributed __getitem__/__setitem__
+    (reference dndarray.py:1476-1726, 3190-3339)."""
+    a = np.arange(120, dtype=np.float32).reshape(10, 12)
+    for split in (None, 0, 1):
+        x = ht.array(a, split=split)
+        np.testing.assert_array_equal(x[[1, 3, 5]].numpy(), a[[1, 3, 5]])
+        np.testing.assert_array_equal(x[[1, 2], [3, 4]].numpy(), a[[1, 2], [3, 4]])
+        np.testing.assert_array_equal(x[np.array([0, 2])].numpy(), a[[0, 2]])
+        y = ht.array(a.copy(), split=split)
+        y[[0, 1]] = -5.0
+        b = a.copy()
+        b[[0, 1]] = -5.0
+        np.testing.assert_array_equal(y.numpy(), b)
+
+
+def test_empty_and_bool_list_keys():
+    a = np.arange(12, dtype=np.float32).reshape(3, 4)
+    x = ht.array(a, split=0)
+    assert x[[]].shape == a[[]].shape == (0, 4)
+    np.testing.assert_array_equal(
+        x[[True, False, True]].numpy(), a[[True, False, True]])
+    y = ht.array(a.copy(), split=0)
+    y[[]] = 99.0
+    np.testing.assert_array_equal(y.numpy(), a)
