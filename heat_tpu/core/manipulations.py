@@ -106,6 +106,13 @@ def concatenate(arrays, axis: int = 0) -> DNDarray:
     axis = sanitize_axis(a0.shape, axis)
     out_type = a0.dtype
     for a in arrays[1:]:
+        if a.ndim != a0.ndim:
+            raise ValueError("DNDarrays must have the same number of dimensions")
+        if any(i != axis and s != t for i, (s, t) in enumerate(zip(a0.shape, a.shape))):
+            raise ValueError(
+                f"Arrays cannot be concatenated, shapes must be the same in "
+                f"every axis except the selected axis: {a0.shape}, {a.shape}"
+            )
         out_type = types.promote_types(out_type, a.dtype)
     garr = jnp.concatenate(
         [a.larray.astype(out_type.jax_type()) for a in arrays], axis=axis

@@ -1,0 +1,134 @@
+"""Numpy-oracle conformance batteries: indexing, python protocols, and
+manipulation semantics across splits (reference: the scenario style of
+heat/core/tests/test_dndarray.py and test_manipulations.py — every case
+asserts identical global results whatever the mesh size)."""
+
+import numpy as np
+import pytest
+
+import heat_tpu as ht
+
+
+A = np.arange(120, dtype=np.float32).reshape(10, 12)
+
+
+@pytest.mark.parametrize("split", [None, 0, 1])
+def test_getitem_battery(split):
+    x = ht.array(A, split=split)
+    cases = [
+        (lambda: x[3], lambda: A[3]),
+        (lambda: x[-2], lambda: A[-2]),
+        (lambda: x[2:7], lambda: A[2:7]),
+        (lambda: x[1:9:3], lambda: A[1:9:3]),
+        (lambda: x[::-1], lambda: A[::-1]),
+        (lambda: x[:, 2:5], lambda: A[:, 2:5]),
+        (lambda: x[3, 4], lambda: A[3, 4]),
+        (lambda: x[..., 1], lambda: A[..., 1]),
+        (lambda: x[None], lambda: A[None]),
+        (lambda: x[[1, 3, 5]], lambda: A[[1, 3, 5]]),
+        (lambda: x[ht.array(np.array([0, 2]))], lambda: A[[0, 2]]),
+        (lambda: x[x > 50], lambda: A[A > 50]),
+        (lambda: x[[1, 2], [3, 4]], lambda: A[[1, 2], [3, 4]]),
+    ]
+    for i, (got, want) in enumerate(cases):
+        g = got()
+        g = g.numpy() if hasattr(g, "numpy") else np.asarray(g)
+        np.testing.assert_array_equal(g, want(), err_msg=f"case {i}")
+
+
+@pytest.mark.parametrize("split", [None, 0, 1])
+def test_setitem_battery(split):
+    y = ht.array(A.copy(), split=split)
+    y[2:4] = -1.0
+    b = A.copy()
+    b[2:4] = -1
+    np.testing.assert_array_equal(y.numpy(), b)
+
+    y = ht.array(A.copy(), split=split)
+    y[:, 1] = ht.arange(10, dtype=ht.float32)
+    b = A.copy()
+    b[:, 1] = np.arange(10)
+    np.testing.assert_array_equal(y.numpy(), b)
+
+    y = ht.array(A.copy(), split=split)
+    y[y > 100] = 0.0
+    b = A.copy()
+    b[b > 100] = 0
+    np.testing.assert_array_equal(y.numpy(), b)
+
+
+def test_python_protocols():
+    a = np.arange(6, dtype=np.float32).reshape(2, 3)
+    x = ht.array(a, split=0)
+    np.testing.assert_array_equal(np.asarray(x), a)
+    assert len(x) == 2
+    np.testing.assert_array_equal(np.stack([r.numpy() for r in x]), a)
+    assert float(ht.array(3.5)) == 3.5
+    assert int(ht.array(7)) == 7
+    assert bool(ht.array(True)) is True
+    assert ht.array(2.5).item() == 2.5
+    assert x.tolist() == a.tolist()
+    np.testing.assert_array_equal(x.T.numpy(), a.T)
+    assert x.astype(ht.int32).dtype is ht.int32
+    assert x.astype(ht.float32, copy=False) is x
+    np.testing.assert_array_equal((-x).numpy(), -a)
+    np.testing.assert_array_equal((+x).numpy(), a)
+    np.testing.assert_array_equal(abs(-x).numpy(), a)
+    np.testing.assert_array_equal((1 + x).numpy(), 1 + a)
+    np.testing.assert_array_equal((1 - x).numpy(), 1 - a)
+    np.testing.assert_allclose((2 / (x + 1)).numpy(), 2 / (a + 1))
+    y = ht.array(a.copy(), split=0)
+    y += 1
+    np.testing.assert_array_equal(y.numpy(), a + 1)
+
+
+def test_manipulations_semantics():
+    a = np.arange(12, dtype=np.float32).reshape(3, 4)
+    b = np.arange(8, dtype=np.float32).reshape(2, 4)
+    x, y = ht.array(a, split=0), ht.array(b, split=0)
+
+    c = ht.concatenate((x, y), axis=0)
+    assert c.split == 0
+    np.testing.assert_array_equal(c.numpy(), np.concatenate([a, b]))
+    # dtype promotion across operands (reference manipulations.py:141-470)
+    ci = ht.concatenate((x, ht.array(b.astype(np.int32), split=0)), axis=0)
+    assert ci.dtype is ht.float32
+    # reference error contract: shape/ndim mismatches are ValueError
+    with pytest.raises(ValueError):
+        ht.concatenate((x, ht.array(np.ones((2, 3), np.float32))), axis=0)
+    with pytest.raises(ValueError):
+        ht.concatenate((x, ht.array(np.ones((2, 3, 4), np.float32))), axis=0)
+
+    r = ht.reshape(x, (4, 3))
+    assert r.split == 0
+    np.testing.assert_array_equal(r.numpy(), a.reshape(4, 3))
+    with pytest.raises(ValueError):
+        ht.reshape(x, (5, 3))
+
+    np.testing.assert_array_equal(
+        ht.diag(ht.arange(3, dtype=ht.float32)).numpy(),
+        np.diag(np.arange(3, dtype=np.float32)))
+    np.testing.assert_array_equal(ht.diagonal(x).numpy(), np.diagonal(a))
+    np.testing.assert_array_equal(ht.diag(x, 1).numpy(), np.diag(a, 1))
+
+    np.testing.assert_array_equal(
+        ht.pad(x, ((1, 1), (0, 0))).numpy(), np.pad(a, ((1, 1), (0, 0))))
+    np.testing.assert_array_equal(
+        ht.pad(x, 1, constant_values=9).numpy(), np.pad(a, 1, constant_values=9))
+    np.testing.assert_array_equal(
+        ht.repeat(x, 2, axis=0).numpy(), np.repeat(a, 2, axis=0))
+    np.testing.assert_array_equal(ht.repeat(x, 2).numpy(), np.repeat(a, 2))
+
+    assert ht.expand_dims(x, 1).shape == (3, 1, 4)
+    assert ht.squeeze(ht.expand_dims(x, 1)).shape == (3, 4)
+    with pytest.raises(ValueError):
+        ht.squeeze(x, axis=0)
+    np.testing.assert_array_equal(ht.flatten(x).numpy(), a.ravel())
+    np.testing.assert_array_equal(ht.fliplr(x).numpy(), np.fliplr(a))
+    np.testing.assert_array_equal(ht.flipud(x).numpy(), np.flipud(a))
+
+    st = ht.stack((x, x), axis=0)
+    assert st.shape == (2, 3, 4)
+    u, inv = ht.unique(
+        ht.array(np.array([3, 1, 3, 2]), split=0), sorted=True, return_inverse=True)
+    np.testing.assert_array_equal(u.numpy()[inv.numpy()], [3, 1, 3, 2])
