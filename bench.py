@@ -141,10 +141,38 @@ def aux_metrics(data: np.ndarray, X):
     return cdist_gbs, moments_gbs
 
 
+def lasso_rate(data: np.ndarray, X):
+    """Coordinate-descent sweeps/s through the framework Lasso (the fourth
+    headline config, benchmarks/lasso).  tol=-1 disables early exit so the
+    device while_loop runs exactly max_iter sweeps — slope timing as for
+    KMeans."""
+    import heat_tpu as ht
+    from heat_tpu.regression import Lasso
+
+    yv = ht.array(
+        (data @ np.arange(1, F + 1, dtype=np.float32) / F
+         + np.random.default_rng(1).normal(size=data.shape[0]).astype(np.float32))
+    )
+
+    def timed(iters):
+        est = Lasso(lam=0.1, max_iter=iters, tol=-1.0)
+        t0 = time.perf_counter()
+        est.fit(X, yv)
+        _ = float(est.coef_.numpy()[0, 0])  # readback fence
+        return time.perf_counter() - t0
+
+    timed(8)  # compile
+    lo, hi = 20, 220
+    t_lo = min(timed(lo) for _ in range(3))
+    t_hi = min(timed(hi) for _ in range(3))
+    return 1.0 / max((t_hi - t_lo) / (hi - lo), 1e-9)
+
+
 def main():
     data, centers = make_blobs()
     heat_rate, X = heat_kmeans_rate(data, centers)
     cdist_gbs, moments_gbs = aux_metrics(data, X)
+    lasso_sweeps = lasso_rate(data, X)
     numpy_rate = numpy_kmeans_rate(data, centers)
     print(
         json.dumps(
@@ -156,6 +184,7 @@ def main():
                 "baseline_numpy_iter_per_sec": round(numpy_rate, 2),
                 "cdist_gb_per_sec": round(cdist_gbs, 2),
                 "moments_gb_per_sec": round(moments_gbs, 2),
+                "lasso_sweeps_per_sec": round(lasso_sweeps, 2),
                 "config": f"n={N} f={F} k={K} iters={ITERS}",
             }
         )

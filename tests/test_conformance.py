@@ -132,3 +132,50 @@ def test_manipulations_semantics():
     u, inv = ht.unique(
         ht.array(np.array([3, 1, 3, 2]), split=0), sorted=True, return_inverse=True)
     np.testing.assert_array_equal(u.numpy()[inv.numpy()], [3, 1, 3, 2])
+
+
+def test_linalg_semantics():
+    rng = np.random.default_rng(1)
+    a = rng.normal(size=(4, 6)).astype(np.float32)
+    x = ht.array(a, split=0)
+    np.testing.assert_array_equal(ht.transpose(x).numpy(), a.T)
+    b3 = rng.normal(size=(2, 3, 4)).astype(np.float32)
+    np.testing.assert_array_equal(
+        ht.transpose(ht.array(b3, split=0), (2, 0, 1)).numpy(), b3.transpose(2, 0, 1))
+    np.testing.assert_array_equal(ht.tril(x, k=-1).numpy(), np.tril(a, -1))
+    np.testing.assert_array_equal(ht.triu(x, k=2).numpy(), np.triu(a, 2))
+    v = ht.array(rng.normal(size=(6,)).astype(np.float32), split=0)
+    w = ht.array(rng.normal(size=(6,)).astype(np.float32))
+    assert np.isclose(float(ht.dot(v, w)), np.dot(v.numpy(), w.numpy()), rtol=1e-5)
+    assert np.isclose(float(ht.linalg.norm(v)), np.linalg.norm(v.numpy()), rtol=1e-5)
+    np.testing.assert_allclose(
+        ht.outer(v, w).numpy(), np.outer(v.numpy(), w.numpy()), rtol=1e-5)
+    proj = ht.linalg.projection(v, w).numpy()
+    expect = (np.dot(v.numpy(), w.numpy()) / np.dot(w.numpy(), w.numpy())) * w.numpy()
+    np.testing.assert_allclose(proj, expect, rtol=1e-4)
+    np.testing.assert_allclose((x @ v).numpy(), a @ v.numpy(), rtol=1e-5)
+    assert np.isclose(float(v @ w), np.dot(v.numpy(), w.numpy()), rtol=1e-5)
+    qr = ht.linalg.qr(x)
+    assert hasattr(qr, "Q") and hasattr(qr, "R")
+
+
+def test_types_statistics_semantics():
+    assert ht.promote_types(ht.uint8, ht.int8) is ht.int16
+    assert ht.promote_types(ht.int64, ht.float32) is ht.float32
+    assert ht.can_cast(ht.int64, ht.float32)
+    assert not ht.can_cast(ht.int64, ht.float32, casting="safe")
+    assert not ht.can_cast(ht.float32, ht.int32, casting="intuitive")
+    assert ht.can_cast(ht.float32, ht.int32, casting="unsafe")
+    assert ht.finfo(ht.float32).max == np.finfo(np.float32).max
+    assert ht.iinfo(ht.int32).min == np.iinfo(np.int32).min
+
+    a = np.array([[1.0, 2.0, 3.0], [4.0, 5.0, 6.0]], dtype=np.float32)
+    X = ht.array(a, split=0)
+    assert np.isclose(float(ht.var(X, ddof=1)), a.var(ddof=1))
+    np.testing.assert_allclose(ht.std(X, axis=0).numpy(), a.std(0))
+    np.testing.assert_allclose(ht.cov(X).numpy(), np.cov(a), atol=1e-5)
+    np.testing.assert_allclose(
+        ht.average(X, axis=0, weights=ht.array(np.array([1.0, 3.0]))).numpy(),
+        np.average(a, axis=0, weights=[1, 3]))
+    np.testing.assert_allclose(
+        ht.percentile(X, [25.0, 75.0]).numpy(), np.percentile(a, [25, 75]))
