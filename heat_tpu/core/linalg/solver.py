@@ -9,6 +9,7 @@ from __future__ import annotations
 
 from typing import Optional, Tuple
 
+import jax
 import jax.numpy as jnp
 
 from .. import factories, types
@@ -17,6 +18,36 @@ from ..sanitation import sanitize_in
 from . import basics
 
 __all__ = ["cg", "lanczos"]
+
+
+@jax.jit
+def _cg_loop(arr, bv, xv):
+    """Full conjugate-gradient iteration on device; jitted once at module
+    level so repeat solves of the same shape replay the cached program."""
+    # stable carry dtype: promote all operands to one inexact type up front
+    ctype = jnp.result_type(arr.dtype, bv.dtype, xv.dtype, jnp.float32)
+    arr, bv, xv = arr.astype(ctype), bv.astype(ctype), xv.astype(ctype)
+    r0 = bv - arr @ xv
+    init = (jnp.int32(0), xv, r0, r0, jnp.dot(r0, r0))
+
+    def cond(s):
+        it, _, _, _, rsold = s
+        # ~(x < tol) rather than x >= tol: NaN must keep iterating so bad
+        # inputs propagate instead of silently returning x0
+        return jnp.logical_and(it < bv.shape[0], ~(jnp.sqrt(rsold) < 1e-10))
+
+    def body(s):
+        it, x, r, p, rsold = s
+        Ap = arr @ p
+        alpha = rsold / jnp.dot(p, Ap)
+        x = x + alpha * p
+        r = r - alpha * Ap
+        rsnew = jnp.dot(r, r)
+        p = r + (rsnew / rsold) * p
+        return it + 1, x, r, p, rsnew
+
+    _, x, _, _, _ = jax.lax.while_loop(cond, body, init)
+    return x
 
 
 def cg(A: DNDarray, b: DNDarray, x0: DNDarray, out: Optional[DNDarray] = None) -> DNDarray:
@@ -31,25 +62,19 @@ def cg(A: DNDarray, b: DNDarray, x0: DNDarray, out: Optional[DNDarray] = None) -
     if x0.ndim != 1:
         raise RuntimeError("c needs to be a 1D vector")
 
-    r = b - basics.matmul(A, x0)
-    p = r
-    rsold = basics.matmul(r, r).item()
-    x = x0
-
-    for _ in range(len(b)):
-        Ap = basics.matmul(A, p)
-        alpha = rsold / basics.matmul(p, Ap).item()
-        x = x + alpha * p
-        r = r - alpha * Ap
-        rsnew = basics.matmul(r, r).item()
-        if jnp.sqrt(rsnew) < 1e-10:
-            if out is not None:
-                out.larray = x.larray
-                return out
-            return x
-        p = r + (rsnew / rsold) * p
-        rsold = rsnew
-
+    # the whole iteration as ONE device while_loop (the reference,
+    # solver.py:39-52, pays three host round-trips per step for the
+    # .item() reductions; here the convergence test stays on device)
+    xres = _cg_loop(A.larray, b.larray, x0.larray)
+    x = DNDarray(
+        x0.comm.apply_sharding(xres, x0.split),
+        tuple(xres.shape),
+        types.canonical_heat_type(xres.dtype),
+        x0.split,
+        x0.device,
+        x0.comm,
+        True,
+    )
     if out is not None:
         out.larray = x.larray
         return out

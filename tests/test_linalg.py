@@ -156,3 +156,19 @@ def test_lanczos():
     np.testing.assert_allclose(ev_t[-3:], ev_a[-3:], rtol=1e-2, atol=1e-2)
     with pytest.raises(RuntimeError):
         ht.linalg.lanczos(ht.ones((3, 4)), 2)
+
+
+def test_cg_dtype_promotion_and_nan():
+    """cg promotes mixed/integer inputs to a common inexact carry dtype and
+    propagates NaN instead of silently returning x0 (the device while_loop
+    replaces the reference's per-step host .item() checks, solver.py:39-52)."""
+    rng = np.random.default_rng(0)
+    M = rng.normal(size=(8, 8)).astype(np.float32)
+    spd = M @ M.T + 8 * np.eye(8, dtype=np.float32)
+    b = rng.normal(size=(8,)).astype(np.float32)
+    sol = ht.linalg.cg(ht.array(spd), ht.array(b), ht.zeros(8, dtype=ht.int32))
+    assert np.abs(spd @ sol.numpy() - b).max() < 1e-4
+    bn = b.copy()
+    bn[0] = np.nan
+    sol_nan = ht.linalg.cg(ht.array(spd), ht.array(bn), ht.zeros(8))
+    assert np.isnan(sol_nan.numpy()).any()
