@@ -15,15 +15,22 @@ import os as _os
 # so enable it before any jax arrays exist.  Defaults everywhere remain
 # 32-bit (TPU-friendly); set HEAT_TPU_DISABLE_X64=1 to hard-disable.
 if _os.environ.get("HEAT_TPU_DISABLE_X64", "0") != "1":
+    import importlib.util as _ilu
+
     import jax as _jax
 
-    # Force backend/plugin discovery before mutating config: with the
-    # experimental 'axon' TPU plugin, flipping x64 before the first backend
-    # init corrupts plugin registration and every later jax.devices() fails.
-    try:
-        _jax.devices()
-    except RuntimeError:
-        pass
+    # With the experimental 'axon' TPU plugin, flipping x64 before the
+    # first backend init corrupts plugin registration (every later
+    # jax.devices() fails), so force discovery first — but ONLY when that
+    # plugin is importable: on every other platform the import must stay
+    # backend-free so jax.distributed.initialize()/ht.init_multihost()
+    # can run after `import heat_tpu` (jax requires distributed init
+    # before any backend touch).
+    if _ilu.find_spec("axon") is not None:
+        try:
+            _jax.devices()
+        except RuntimeError:
+            pass
     _jax.config.update("jax_enable_x64", True)
 
 from .version import __version__
@@ -39,3 +46,14 @@ from . import regression
 from . import spatial
 from . import utils
 from . import datasets
+
+
+def __getattr__(name):
+    """Lazy accelerator singletons: ``ht.tpu`` / ``ht.gpu`` exist iff the
+    platform does (reference's conditional gpu, devices.py:66-74), probed
+    on first access so importing heat_tpu never initializes a backend."""
+    if name in ("tpu", "gpu"):
+        dev = core.devices._accelerator(name)
+        if dev is not None:
+            return dev
+    raise AttributeError(f"module 'heat_tpu' has no attribute {name!r}")

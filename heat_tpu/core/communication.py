@@ -64,6 +64,7 @@ __all__ = [
     "use_comm",
     "sanitize_comm",
     "comm_for_device",
+    "init_multihost",
 ]
 
 #: Name of the (single) mesh axis every DNDarray is sharded over.  The
@@ -464,3 +465,44 @@ def comm_for_device(platform: str) -> XlaCommunication:
     if platform not in _platform_comms:
         _platform_comms[platform] = XlaCommunication(jax.devices(platform))
     return _platform_comms[platform]
+
+
+def init_multihost(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+    local_device_ids=None,
+) -> XlaCommunication:
+    """Bootstrap multi-host execution and install a global communicator.
+
+    The multi-host analog of the reference's ``mpirun``-launched
+    ``MPI_WORLD`` (communication.py:1123): each host calls this once at
+    startup (arguments may be omitted on TPU pods / managed clusters,
+    where JAX discovers the coordinator from the environment); afterwards
+    ``get_comm()`` spans every chip of every host, with collectives riding
+    ICI within a slice and DCN across slices.
+
+    Safe to call when the distributed runtime is already up — it then just
+    (re)installs the all-devices communicator.
+    """
+    if not jax.distributed.is_initialized():
+        try:
+            jax.distributed.initialize(
+                coordinator_address=coordinator_address,
+                num_processes=num_processes,
+                process_id=process_id,
+                local_device_ids=local_device_ids,
+            )
+        except RuntimeError as e:
+            if "must be called before" in str(e):
+                raise RuntimeError(
+                    "init_multihost() must run before anything touches the "
+                    "XLA backend. Call it immediately after `import heat_tpu` "
+                    "and before creating arrays; if your environment "
+                    "initializes a backend at import (e.g. the axon plugin's "
+                    "x64 workaround), set HEAT_TPU_DISABLE_X64=1."
+                ) from e
+            raise
+    comm = XlaCommunication(jax.devices())
+    use_comm(comm)
+    return comm

@@ -72,6 +72,12 @@ cpu = Device("cpu")
 
 __registry = {"cpu": cpu}
 
+# name -> Device | None, filled on first access.  Probing calls
+# jax.devices(), which initializes the XLA backend — deferring it keeps
+# `import heat_tpu` backend-free so jax.distributed / init_multihost can
+# run first (jax requires distributed init before any backend touch).
+_probe_cache: dict = {}
+
 
 def __probe_platform(name: str) -> Optional[Device]:
     try:
@@ -84,39 +90,43 @@ def __probe_platform(name: str) -> Optional[Device]:
     return None
 
 
-tpu = __probe_platform("tpu")
-"""The TPU device, or None when no TPU platform is present (analogous to the
-conditional ``gpu`` singleton, reference devices.py:66-74)."""
+def _accelerator(name: str) -> Optional[Device]:
+    """The 'tpu'/'gpu' singleton, probed lazily (None when absent).
 
-gpu = __probe_platform("gpu")
-"""The GPU device, or None when no GPU platform is present."""
+    The experimental 'axon' tunnel platform exposes TPU chips under a
+    custom platform name; it surfaces as ``tpu`` when the canonical name
+    is absent."""
+    if name not in _probe_cache:
+        dev = __probe_platform(name)
+        if dev is None and name == "tpu":
+            dev = __probe_platform("axon")
+            if dev is not None:
+                __registry["tpu"] = dev
+        _probe_cache[name] = dev
+    return _probe_cache[name]
 
-# the experimental 'axon' tunnel platform exposes TPU chips under a custom
-# platform name; surface it as `tpu` when the canonical name is absent
-if tpu is None:
-    for _plat in ("axon",):
-        _dev = __probe_platform(_plat)
-        if _dev is not None:
-            tpu = _dev
-            __registry["tpu"] = _dev
-            break
 
-# export the accelerator singletons that exist, mirroring the reference's
-# conditional `gpu` definition (devices.py:66-74): present => importable
-# as ht.tpu / ht.gpu, absent => the attribute stays None and unexported
-if tpu is not None:
-    __all__.append("tpu")
-if gpu is not None:
-    __all__.append("gpu")
+def __getattr__(name: str):
+    """PEP 562: ``devices.tpu`` / ``devices.gpu`` are probed on first
+    access, mirroring the reference's conditional ``gpu`` singleton
+    (devices.py:66-74) without touching the backend at import time.
+
+    Trade-off: star-imports (``from heat_tpu import *``) do not consult
+    this hook, so they bind only ``cpu``; use attribute access
+    (``ht.tpu``) for accelerators — the lazy probe is what keeps
+    ``import heat_tpu`` backend-free for :func:`ht.init_multihost`."""
+    if name in ("tpu", "gpu"):
+        return _accelerator(name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
 
 __default_device: Device = None
 
 
 def _accelerator_or_cpu() -> Device:
-    if tpu is not None:
-        return tpu
-    if gpu is not None:
-        return gpu
+    for name in ("tpu", "gpu"):
+        dev = _accelerator(name)
+        if dev is not None:
+            return dev
     return cpu
 
 
@@ -145,7 +155,9 @@ def sanitize_device(device: Optional[Union[str, Device]]) -> Device:
     name = str(device).strip().lower()
     if name in __registry:
         return __registry[name]
-    dev = __probe_platform(name)
+    # route tpu/gpu through the lazy singleton (it knows the axon->tpu
+    # platform aliasing); other names probe directly
+    dev = _accelerator(name) if name in ("tpu", "gpu") else __probe_platform(name)
     if dev is not None:
         return dev
     raise ValueError(f"Unknown device or platform not available: {device!r}")

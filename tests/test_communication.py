@@ -5,6 +5,8 @@ transformations, tested for geometry and value preservation)."""
 import numpy as np
 import pytest
 
+import os
+
 import heat_tpu as ht
 from heat_tpu.core.communication import XlaCommunication, get_comm, sanitize_comm, use_comm
 
@@ -191,3 +193,39 @@ def test_exscan_minmax_identity():
     exi = np.asarray(comm.exscan(ht.array(np.array([[3], [1], [2]], np.int32)).larray, "min"))
     assert exi[0, 0] == np.iinfo(np.int32).max
     np.testing.assert_array_equal(exi[1:, 0], [3, 1])
+
+
+def test_init_multihost_single_process():
+    """init_multihost bootstraps the jax distributed runtime (the analog of
+    mpirun-launched MPI_WORLD, reference communication.py:1123) and installs
+    an all-devices communicator; idempotent on re-call.  Runs in a fresh
+    subprocess because distributed init must precede backend init."""
+    import subprocess
+    import sys
+
+    script = (
+        "import socket, jax\n"
+        "jax.config.update('jax_platforms', 'cpu')\n"
+        "jax.config.update('jax_num_cpu_devices', 4)\n"
+        "s = socket.socket(); s.bind(('127.0.0.1', 0)); port = s.getsockname()[1]; s.close()\n"
+        "import heat_tpu as ht\n"
+        "comm = ht.init_multihost(f'127.0.0.1:{port}', num_processes=1, process_id=0)\n"
+        "assert comm.size == 4, comm.size\n"
+        "assert jax.process_count() == 1\n"
+        "comm2 = ht.init_multihost(f'127.0.0.1:{port}', num_processes=1, process_id=0)\n"
+        "assert comm2.size == comm.size\n"
+        "assert float(ht.arange(8, split=0).sum()) == 28.0\n"
+        "print('MULTIHOST_OK')\n"
+    )
+    env = dict(os.environ)
+    env["HEAT_TPU_DISABLE_X64"] = "1"  # keep the import backend-free
+    env.pop("JAX_PLATFORMS", None)
+    res = subprocess.run(
+        [sys.executable, "-c", script],
+        capture_output=True,
+        text=True,
+        timeout=240,
+        env=env,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    )
+    assert "MULTIHOST_OK" in res.stdout, res.stdout + res.stderr
