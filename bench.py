@@ -75,11 +75,15 @@ def heat_kmeans_rate(data: np.ndarray, init: np.ndarray):
     _timed_fit(KMeans, init_nd, X, ITERS)  # warmup: compile the fused loop
     # slope window must dwarf tunnel jitter (tens of ms): at ~60 us/iter a
     # 30->150 window spans only ~8 ms of real work, so the measurement
-    # drowns; 200->1000 spans ~50 ms and the slope stabilizes
-    lo, hi = 200, 1000
-    t_lo = min(_timed_fit(KMeans, init_nd, X, lo) for _ in range(5))
-    t_hi = min(_timed_fit(KMeans, init_nd, X, hi) for _ in range(5))
-    per_iter = max((t_hi - t_lo) / (hi - lo), 1e-9)
+    # drowns; 200->1800 spans ~100 ms and the slope stabilizes.  lo/hi
+    # samples interleave so slow drift (thermal, shared-chip contention)
+    # hits both ends of the slope equally.
+    lo, hi = 200, 1800
+    t_lo, t_hi = [], []
+    for _ in range(6):
+        t_lo.append(_timed_fit(KMeans, init_nd, X, lo))
+        t_hi.append(_timed_fit(KMeans, init_nd, X, hi))
+    per_iter = max((min(t_hi) - min(t_lo)) / (hi - lo), 1e-9)
     return 1.0 / per_iter, X
 
 

@@ -172,3 +172,17 @@ def test_cg_dtype_promotion_and_nan():
     bn[0] = np.nan
     sol_nan = ht.linalg.cg(ht.array(spd), ht.array(bn), ht.zeros(8))
     assert np.isnan(sol_nan.numpy()).any()
+
+
+@pytest.mark.parametrize("shape", [(21, 7), (7, 21), (14, 14), (40, 3)])
+@pytest.mark.parametrize("split", [None, 0, 1])
+def test_qr_sweep(shape, split):
+    """Reconstruction, orthonormality, and triangularity across shapes and
+    splits (reference linalg/tests/test_qr.py:19-60 sweeps)."""
+    rng = np.random.default_rng(0)
+    A = rng.normal(size=shape).astype(np.float32)
+    q, r = ht.linalg.qr(ht.array(A, split=split))
+    qn, rn = q.numpy(), r.numpy()
+    np.testing.assert_allclose(qn @ rn, A, atol=1e-4)
+    np.testing.assert_allclose(qn.T @ qn, np.eye(qn.shape[1]), atol=1e-4)
+    np.testing.assert_allclose(rn, np.triu(rn), atol=1e-6)

@@ -86,3 +86,19 @@ def test_dtype_validation():
         ht.random.rand(5, dtype=ht.int32)
     with pytest.raises(ValueError):
         ht.random.randint(0, 5, size=(3,), dtype=ht.float32)
+
+
+def test_split_independent_streams():
+    """The same seed yields the same global sequence whatever the split —
+    the counter-based contract (reference random.py:25-163)."""
+    ht.random.seed(42)
+    a = ht.random.rand(10000, split=0).numpy()
+    ht.random.seed(42)
+    b = ht.random.rand(10000, split=None).numpy()
+    np.testing.assert_array_equal(a, b)
+
+
+def test_randn_moments_large():
+    ht.random.seed(1)
+    r = ht.random.randn(200000, split=0).numpy()
+    assert abs(r.mean()) < 0.01 and abs(r.std() - 1) < 0.01
