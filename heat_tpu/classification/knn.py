@@ -45,8 +45,13 @@ class KNN(ClassificationMixin, BaseEstimator):
         self.x = x
         if y.ndim == 1:
             self.y = KNN.label_to_one_hot(y)
-        else:
+        elif y.ndim == 2:
             self.y = y
+        else:
+            raise ValueError(
+                "Expected labels of shape (n_samples,) or (n_samples, n_classes) "
+                f"but got {y.shape}"
+            )
 
     @staticmethod
     def label_to_one_hot(a: DNDarray) -> DNDarray:
@@ -67,7 +72,15 @@ class KNN(ClassificationMixin, BaseEstimator):
     def fit(self, x: DNDarray, y: DNDarray):
         """Store the training set (lazy learner; reference knn.py:51-82)."""
         self.x = x
-        self.y = KNN.label_to_one_hot(y) if y.ndim == 1 else y
+        if y.ndim == 1:
+            self.y = KNN.label_to_one_hot(y)
+        elif y.ndim == 2:
+            self.y = y
+        else:
+            raise ValueError(
+                "Expected labels of shape (n_samples,) or (n_samples, n_classes) "
+                f"but got {y.shape}"
+            )
 
     def predict(self, x: DNDarray) -> DNDarray:
         """Majority vote of the k nearest training samples

@@ -237,3 +237,55 @@ def test_plus_plus_init_aliases():
         np.testing.assert_allclose(centers, [-4, 0, 4], atol=0.5)
     with pytest.raises(ValueError):
         ht.cluster.KMeans(n_clusters=3, init="bogus").fit(x)
+
+
+def test_gaussiannb_vs_sklearn_oracle():
+    """Posterior probabilities match sklearn's GaussianNB to 1e-3
+    (reference gaussianNB.py is a port of sklearn's; test_gaussiannb.py
+    compares against precomputed sklearn outputs)."""
+    sklearn = pytest.importorskip("sklearn.naive_bayes")
+    rng = np.random.default_rng(2)
+    X = np.concatenate(
+        [rng.normal(loc=c, scale=0.5, size=(40, 3)).astype(np.float32) for c in (-3, 0, 3)]
+    )
+    yv = np.repeat([0, 1, 2], 40)
+    g = ht.naive_bayes.GaussianNB().fit(ht.array(X, split=0), ht.array(yv, split=0))
+    sk = sklearn.GaussianNB().fit(X, yv)
+    np.testing.assert_allclose(
+        g.predict_proba(ht.array(X, split=0)).numpy(), sk.predict_proba(X), atol=1e-3)
+    np.testing.assert_array_equal(
+        g.predict(ht.array(X, split=0)).numpy(), sk.predict(X))
+
+
+def test_knn_label_forms():
+    """KNN accepts (n,) class ids or (n, c) one-hot labels and always
+    predicts class ids (reference knn.py:60-101)."""
+    rng = np.random.default_rng(2)
+    X = np.concatenate(
+        [rng.normal(loc=c, scale=0.5, size=(40, 3)).astype(np.float32) for c in (-3, 0, 3)]
+    )
+    yv = np.repeat([0, 1, 2], 40)
+    Xh = ht.array(X, split=0)
+    from heat_tpu.classification import KNN
+
+    k1 = KNN(Xh, ht.array(yv, split=0), 5)
+    assert (k1.predict(Xh).numpy() == yv).mean() == 1.0
+    onehot = np.eye(3, dtype=np.float32)[yv]
+    k2 = KNN(Xh, ht.array(onehot, split=0), 5)
+    assert (k2.predict(Xh).numpy() == yv).mean() == 1.0
+    with pytest.raises(ValueError):
+        KNN(Xh, ht.array(np.zeros((120, 3, 1), np.float32)), 5)
+
+
+def test_spectral_recovers_clusters():
+    rng = np.random.default_rng(2)
+    X = np.concatenate(
+        [rng.normal(loc=c, scale=0.5, size=(40, 3)).astype(np.float32) for c in (-3, 0, 3)]
+    )
+    yv = np.repeat([0, 1, 2], 40)
+    sp = ht.cluster.Spectral(n_clusters=3, gamma=1.0, metric="rbf", n_lanczos=30)
+    lab = sp.fit_predict(ht.array(X, split=0)).numpy()
+    from itertools import permutations
+
+    acc = max((lab == np.array([p[i] for i in yv])).mean() for p in permutations(range(3)))
+    assert acc > 0.95
