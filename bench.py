@@ -79,11 +79,13 @@ def heat_kmeans_rate(data: np.ndarray, init: np.ndarray):
     # samples interleave so slow drift (thermal, shared-chip contention)
     # hits both ends of the slope equally.
     lo, hi = 200, 1800
-    t_lo, t_hi = [], []
-    for _ in range(6):
-        t_lo.append(_timed_fit(KMeans, init_nd, X, lo))
-        t_hi.append(_timed_fit(KMeans, init_nd, X, hi))
-    per_iter = max((min(t_hi) - min(t_lo)) / (hi - lo), 1e-9)
+    diffs = []
+    for _ in range(7):  # odd count: index len//2 is the exact median
+        t_lo = _timed_fit(KMeans, init_nd, X, lo)
+        t_hi = _timed_fit(KMeans, init_nd, X, hi)
+        diffs.append(t_hi - t_lo)
+    diffs.sort()
+    per_iter = max(diffs[len(diffs) // 2] / (hi - lo), 1e-9)
     return 1.0 / per_iter, X
 
 
@@ -132,15 +134,22 @@ def aux_metrics(data: np.ndarray, X):
             return time.perf_counter() - t0
 
         sample(lo)  # warmup (compile)
-        t_lo = min(sample(lo) for _ in range(3))  # min defeats tunnel jitter
-        t_hi = min(sample(hi) for _ in range(3))
-        return max((t_hi - t_lo) / (hi - lo), 1e-9)
+        # paired lo/hi samples back-to-back, slope = median of the paired
+        # differences: drift hits both ends of a pair equally and a single
+        # contended sample cannot flip the sign the way min-of-each-end can
+        diffs = []
+        for _ in range(5):
+            t_lo = sample(lo)
+            t_hi = sample(hi)
+            diffs.append(t_hi - t_lo)
+        diffs.sort()
+        return max(diffs[len(diffs) // 2] / (hi - lo), 1e-9)
 
-    cdist_t = slope(cdist_loop, sub, 5, 25)
+    cdist_t = slope(cdist_loop, sub, 5, 45)
     cdist_gbs = SUB * SUB * 4 / cdist_t / 1e9  # distance-tile bytes per rep
 
     xj = X.larray
-    mom_t = slope(moments_loop, xj, 20, 120)
+    mom_t = slope(moments_loop, xj, 20, 320)
     moments_gbs = xj.size * 4 * 2 / mom_t / 1e9  # mean+std passes per rep
     return cdist_gbs, moments_gbs
 
@@ -167,9 +176,13 @@ def lasso_rate(data: np.ndarray, X):
 
     timed(8)  # compile
     lo, hi = 20, 220
-    t_lo = min(timed(lo) for _ in range(3))
-    t_hi = min(timed(hi) for _ in range(3))
-    return 1.0 / max((t_hi - t_lo) / (hi - lo), 1e-9)
+    diffs = []
+    for _ in range(5):  # paired, slope = median of paired differences
+        t_lo = timed(lo)
+        t_hi = timed(hi)
+        diffs.append(t_hi - t_lo)
+    diffs.sort()
+    return 1.0 / max(diffs[len(diffs) // 2] / (hi - lo), 1e-9)
 
 
 def main():

@@ -34,24 +34,12 @@ class KNN(ClassificationMixin, BaseEstimator):
 
     def __init__(self, x: DNDarray, y: DNDarray, num_neighbours: int):
         sanitize_in(x)
-        sanitize_in(y)
-        if x.shape[0] != y.shape[0]:
-            raise ValueError(f"Number of samples and labels needs to be the same, got {x.shape[0]}, {y.shape[0]}")
         if not isinstance(num_neighbours, int) or not 0 < num_neighbours <= x.shape[0]:
             raise ValueError(
                 f"num_neighbours must be an int in [1, {x.shape[0]}], got {num_neighbours}"
             )
         self.num_neighbours = num_neighbours
-        self.x = x
-        if y.ndim == 1:
-            self.y = KNN.label_to_one_hot(y)
-        elif y.ndim == 2:
-            self.y = y
-        else:
-            raise ValueError(
-                "Expected labels of shape (n_samples,) or (n_samples, n_classes) "
-                f"but got {y.shape}"
-            )
+        self.fit(x, y)
 
     @staticmethod
     def label_to_one_hot(a: DNDarray) -> DNDarray:
@@ -70,7 +58,14 @@ class KNN(ClassificationMixin, BaseEstimator):
         )
 
     def fit(self, x: DNDarray, y: DNDarray):
-        """Store the training set (lazy learner; reference knn.py:51-82)."""
+        """Store the training set (lazy learner; reference knn.py:51-82).
+        The single label-validation path — __init__ delegates here."""
+        sanitize_in(x)
+        sanitize_in(y)
+        if x.shape[0] != y.shape[0]:
+            raise ValueError(
+                f"Number of samples and labels needs to be the same, got {x.shape[0]}, {y.shape[0]}"
+            )
         self.x = x
         if y.ndim == 1:
             self.y = KNN.label_to_one_hot(y)
