@@ -33,11 +33,6 @@ class KNN(ClassificationMixin, BaseEstimator):
     """
 
     def __init__(self, x: DNDarray, y: DNDarray, num_neighbours: int):
-        sanitize_in(x)
-        if not isinstance(num_neighbours, int) or not 0 < num_neighbours <= x.shape[0]:
-            raise ValueError(
-                f"num_neighbours must be an int in [1, {x.shape[0]}], got {num_neighbours}"
-            )
         self.num_neighbours = num_neighbours
         self.fit(x, y)
 
@@ -65,6 +60,11 @@ class KNN(ClassificationMixin, BaseEstimator):
         if x.shape[0] != y.shape[0]:
             raise ValueError(
                 f"Number of samples and labels needs to be the same, got {x.shape[0]}, {y.shape[0]}"
+            )
+        k = self.num_neighbours
+        if not isinstance(k, int) or not 0 < k <= x.shape[0]:
+            raise ValueError(
+                f"num_neighbours must be an int in [1, {x.shape[0]}], got {k}"
             )
         self.x = x
         if y.ndim == 1:
