@@ -37,8 +37,10 @@ def main() -> None:
     w("(`python scripts/gen_api_doc.py` regenerates this file). Reference\n")
     w("parity citations live in each docstring.\n")
 
-    def section(title, lookup_mods, names, prefix="ht."):
+    def section(title, lookup_mods, names, prefix="ht.", note=None):
         w(f"\n## {title}\n\n")
+        if note:
+            w(note + "\n\n")
         w("| Name | Kind | Summary |\n|---|---|---|\n")
         for n in sorted(set(names)):
             obj = None
@@ -46,7 +48,10 @@ def main() -> None:
                 obj = getattr(m, n, None)
                 if obj is not None:
                     break
-            if obj is None or inspect.ismodule(obj):
+            if obj is None:
+                print(f"warning: {title}: listed name {n!r} not resolvable", file=sys.stderr)
+                continue
+            if inspect.ismodule(obj):
                 continue
             kind = "class" if inspect.isclass(obj) else ("fn" if callable(obj) else "const")
             doc = first_line(obj).replace("|", "\\|")
@@ -90,7 +95,16 @@ def main() -> None:
 
     section("Container", [core], ["DNDarray"])
     section("Types", [types], exported(types))
-    section("Devices", [devices], exported(devices) + ["tpu", "gpu"])
+    section(
+        "Devices",
+        [devices],
+        exported(devices),
+        note=(
+            "`ht.tpu` / `ht.gpu` singletons are probed lazily and exist "
+            "only where the platform does (see heat_tpu/core/devices.py); "
+            "they are intentionally not listed per-environment here."
+        ),
+    )
     section("Communication", [communication], exported(communication))
     section("Factories", [factories], exported(factories))
     section("Arithmetics", [arithmetics], exported(arithmetics))

@@ -1,0 +1,93 @@
+"""Deterministic fuzz: random op chains compared against a numpy oracle.
+
+Complements the scenario tests with breadth: each case builds a random
+array (random shape / dtype / split), applies a random chain of unary,
+binary, reduction, and manipulation ops, and asserts the heat_tpu result
+matches numpy elementwise.  Seeded, so failures reproduce exactly.
+(Reference analog: assert_func_equal's dtype x split sweeps,
+heat/core/tests/test_suites/basic_test.py:141.)
+"""
+
+import numpy as np
+import pytest
+
+import heat_tpu as ht
+
+UNARY = [
+    (ht.exp, np.exp, (-2, 2)),
+    (ht.log, np.log, (0.1, 10)),
+    (ht.sqrt, np.sqrt, (0, 10)),
+    (ht.sin, np.sin, (-3, 3)),
+    (ht.tanh, np.tanh, (-3, 3)),
+    (ht.abs, np.abs, (-5, 5)),
+    (ht.floor, np.floor, (-5, 5)),
+    (ht.ceil, np.ceil, (-5, 5)),
+    (lambda x: -x, lambda a: -a, (-5, 5)),
+]
+
+BINARY = [
+    (ht.add, np.add),
+    (ht.sub, np.subtract),
+    (ht.mul, np.multiply),
+    (ht.maximum, np.maximum),
+    (ht.minimum, np.minimum),
+    (lambda a, b: ht.div(a, b + 3.0), lambda a, b: a / (b + 3.0)),
+]
+
+REDUCE = [
+    (lambda x, ax: ht.sum(x, axis=ax), lambda a, ax: a.sum(axis=ax)),
+    (lambda x, ax: ht.mean(x, axis=ax), lambda a, ax: a.mean(axis=ax)),
+    (lambda x, ax: ht.max(x, axis=ax), lambda a, ax: a.max(axis=ax)),
+    (lambda x, ax: ht.min(x, axis=ax), lambda a, ax: a.min(axis=ax)),
+]
+
+MANIP = [
+    (lambda x: ht.flip(x, 0), lambda a: np.flip(a, 0)),
+    (lambda x: ht.expand_dims(x, 0), lambda a: np.expand_dims(a, 0)),
+    (lambda x: x.T, lambda a: a.T),
+    (lambda x: ht.sort(x, axis=-1)[0], lambda a: np.sort(a, axis=-1)),
+]
+
+
+@pytest.mark.parametrize("case", range(40))
+def test_fuzz_op_chains(case):
+    rng = np.random.default_rng(1000 + case)
+    ndim = int(rng.integers(1, 4))
+    shape = tuple(int(rng.integers(2, 7)) for _ in range(ndim))
+    split = rng.choice([None] + list(range(ndim)))
+    split = None if split is None else int(split)
+
+    lo, hi = -4.0, 4.0
+    a = rng.uniform(lo, hi, size=shape).astype(np.float32)
+    x = ht.array(a, split=split)
+
+    for _ in range(int(rng.integers(1, 5))):
+        kind = rng.choice(["unary", "binary", "reduce", "manip"])
+        if kind == "unary":
+            f, g, (vlo, vhi) = UNARY[int(rng.integers(len(UNARY)))]
+            # rescale into the op's domain with the SAME affine transform on
+            # both sides (scalars from the oracle), keeping the distributed
+            # chain intact so earlier-op divergence stays visible
+            amin, amax = float(a.min()), float(a.max())
+            spread = (amax - amin) or 1.0
+            scale = np.float32((vhi - vlo) / spread)
+            shift = np.float32(vlo - amin * (vhi - vlo) / spread)
+            a = (a * scale + shift).astype(np.float32)
+            x = x * scale + shift
+            x, a = f(x), g(a)
+        elif kind == "binary":
+            f, g = BINARY[int(rng.integers(len(BINARY)))]
+            b = rng.uniform(0.5, 2.0, size=a.shape).astype(np.float32)
+            y = ht.array(b, split=x.split)
+            x, a = f(x, y), g(a, b)
+        elif kind == "reduce" and a.ndim > 1:
+            f, g = REDUCE[int(rng.integers(len(REDUCE)))]
+            ax = int(rng.integers(a.ndim))
+            x, a = f(x, ax), g(a, ax)
+        elif kind == "manip" and a.ndim >= 1:
+            f, g = MANIP[int(rng.integers(len(MANIP)))]
+            x, a = f(x), g(a)
+        a = np.asarray(a, dtype=np.float32)
+
+    got = np.asarray(x.numpy(), dtype=np.float32)
+    np.testing.assert_allclose(got, a, rtol=2e-5, atol=2e-5)
