@@ -69,3 +69,25 @@ def assert_func_equal(
                 assert_array_equal(result, expected, rtol=rtol, atol=atol)
             else:
                 np.testing.assert_allclose(result, expected, rtol=rtol, atol=atol)
+
+
+def run_in_fresh_python(script: str, env_overrides=None, drop_env=(), timeout=240):
+    """Run ``script`` in a fresh interpreter from the repo root and return
+    the CompletedProcess.  For tests that must control what happens before
+    jax backend initialization (multihost bootstrap, import hygiene)."""
+    import os
+    import subprocess
+    import sys
+
+    env = dict(os.environ)
+    for k in drop_env:
+        env.pop(k, None)
+    env.update(env_overrides or {})
+    return subprocess.run(
+        [sys.executable, "-c", script],
+        capture_output=True,
+        text=True,
+        timeout=timeout,
+        env=env,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    )

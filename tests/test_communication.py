@@ -12,7 +12,7 @@ import jax.numpy as jnp
 import heat_tpu as ht
 from heat_tpu.core.communication import XlaCommunication, get_comm, sanitize_comm, use_comm
 
-from suite import assert_array_equal
+from suite import assert_array_equal, run_in_fresh_python
 
 
 def test_comm_basics():
@@ -202,9 +202,6 @@ def test_init_multihost_single_process():
     mpirun-launched MPI_WORLD, reference communication.py:1123) and installs
     an all-devices communicator; idempotent on re-call.  Runs in a fresh
     subprocess because distributed init must precede backend init."""
-    import subprocess
-    import sys
-
     script = (
         "import socket, jax\n"
         "jax.config.update('jax_platforms', 'cpu')\n"
@@ -219,16 +216,10 @@ def test_init_multihost_single_process():
         "assert float(ht.arange(8, split=0).sum()) == 28.0\n"
         "print('MULTIHOST_OK')\n"
     )
-    env = dict(os.environ)
-    env["HEAT_TPU_DISABLE_X64"] = "1"  # keep the import backend-free
-    env.pop("JAX_PLATFORMS", None)
-    res = subprocess.run(
-        [sys.executable, "-c", script],
-        capture_output=True,
-        text=True,
-        timeout=240,
-        env=env,
-        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    res = run_in_fresh_python(
+        script,
+        env_overrides={"HEAT_TPU_DISABLE_X64": "1"},  # keep the import backend-free
+        drop_env=("JAX_PLATFORMS",),
     )
     assert "MULTIHOST_OK" in res.stdout, res.stdout + res.stderr
 
@@ -302,3 +293,20 @@ def test_resplit_all_transitions():
             y = x.resplit(s_to)
             assert y.split == s_to
             np.testing.assert_array_equal(y.numpy(), a)
+
+
+def test_import_is_backend_free():
+    """`import heat_tpu` must not initialize an XLA backend (the guarantee
+    init_multihost depends on).  Runs in a subprocess without the axon
+    plugin on the path; the x64 flip and lazy device probing must leave
+    jax's backend registry untouched."""
+    script = (
+        "import sys\n"
+        "sys.path = [p for p in sys.path if 'axon' not in p]\n"
+        "import heat_tpu\n"
+        "import jax._src.xla_bridge as xb\n"
+        "assert not xb._backends, f'backends initialized at import: {list(xb._backends)}'\n"
+        "print('BACKEND_FREE_OK')\n"
+    )
+    res = run_in_fresh_python(script, drop_env=("PYTHONPATH",))  # drop the axon site dir
+    assert "BACKEND_FREE_OK" in res.stdout, res.stdout + res.stderr
