@@ -18,6 +18,11 @@ __all__ = [
     "allclose",
     "any",
     "isclose",
+    "isfinite",
+    "isinf",
+    "isnan",
+    "isneginf",
+    "isposinf",
     "logical_and",
     "logical_not",
     "logical_or",
@@ -54,6 +59,32 @@ def isclose(x, y, rtol: float = 1e-05, atol: float = 1e-08, equal_nan: bool = Fa
         return jnp.isclose(a, b, rtol=rtol, atol=atol, equal_nan=equal_nan)
 
     return _operations.__binary_op(_isclose, x, y)
+
+
+def isfinite(x, out=None):
+    """Elementwise finiteness test (extension; numpy semantics).
+    The reference (heat 0.5.1) has no isfinite/isinf/isnan family."""
+    return _operations.__local_op(jnp.isfinite, x, out, no_cast=True)
+
+
+def isinf(x, out=None):
+    """Elementwise +/-inf test (extension; numpy semantics)."""
+    return _operations.__local_op(jnp.isinf, x, out, no_cast=True)
+
+
+def isnan(x, out=None):
+    """Elementwise NaN test (extension; numpy semantics)."""
+    return _operations.__local_op(jnp.isnan, x, out, no_cast=True)
+
+
+def isneginf(x, out=None):
+    """Elementwise -inf test (extension; numpy semantics)."""
+    return _operations.__local_op(jnp.isneginf, x, out, no_cast=True)
+
+
+def isposinf(x, out=None):
+    """Elementwise +inf test (extension; numpy semantics)."""
+    return _operations.__local_op(jnp.isposinf, x, out, no_cast=True)
 
 
 def logical_and(t1, t2):

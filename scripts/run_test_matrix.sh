@@ -1,11 +1,12 @@
 #!/usr/bin/env bash
 # The reference CI runs the same suite under MPI world sizes 1..4 and 7
 # (.travis.yml:17-21); here the analog is the virtual-device count of the
-# CPU mesh.  Usage: scripts/run_test_matrix.sh [sizes...]  (default 1 2 4 7)
+# CPU mesh.  Usage: scripts/run_test_matrix.sh [sizes...]
+# Default covers 1/2/4 plus the awkward primes 3 and 7 (uneven shards).
 set -uo pipefail
 cd "$(dirname "$0")/.."
 sizes=("$@")
-[ $# -eq 0 ] && sizes=(1 2 4 7)
+[ $# -eq 0 ] && sizes=(1 2 3 4 7)
 fail=0
 for n in "${sizes[@]}"; do
     echo "=== mesh size $n ==="

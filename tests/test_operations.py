@@ -180,3 +180,26 @@ def test_reference_keyword_names():
     assert ht.random.ranf is ht.random.random_sample is ht.random.sample
     assert ht.types.heat_type_is_exact(ht_dtype=ht.int64)
     assert ht.types.heat_type_is_inexact(ht_dtype=ht.float64)
+
+
+def test_special_values_semantics():
+    """inf/nan propagation matches numpy; the isfinite/isinf/isnan family
+    (extensions beyond the reference, which has none) works across splits."""
+    inf, nan = np.inf, np.nan
+    a = np.array([1.0, inf, -inf, nan, 0.0], dtype=np.float32)
+    for split in (None, 0):
+        x = ht.array(a, split=split)
+        np.testing.assert_array_equal(ht.isinf(x).numpy(), np.isinf(a))
+        np.testing.assert_array_equal(ht.isnan(x).numpy(), np.isnan(a))
+        np.testing.assert_array_equal(ht.isfinite(x).numpy(), np.isfinite(a))
+        np.testing.assert_array_equal(ht.isposinf(x).numpy(), np.isposinf(a))
+        np.testing.assert_array_equal(ht.isneginf(x).numpy(), np.isneginf(a))
+        assert not bool((x == x).numpy()[3])  # nan != nan
+        assert not ht.allclose(x, x)
+        assert ht.allclose(x, x, equal_nan=True)
+        assert np.isnan(float(ht.sum(x)))
+    b = np.array([0.0, 1.0, -1.0], dtype=np.float32)
+    y = ht.array(b)
+    with np.errstate(divide="ignore", invalid="ignore"):
+        np.testing.assert_array_equal((y / 0.0).numpy(), b / 0.0)
+        np.testing.assert_array_equal(ht.log(y).numpy(), np.log(b))
