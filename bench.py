@@ -151,7 +151,51 @@ def aux_metrics(data: np.ndarray, X):
     xj = X.larray
     mom_t = slope(moments_loop, xj, 20, 320)
     moments_gbs = xj.size * 4 * 2 / mom_t / 1e9  # mean+std passes per rep
-    return cdist_gbs, moments_gbs
+
+    @jax.jit
+    def allreduce_loop(x, reps):
+        # the BASELINE "allreduce bandwidth" config: the global-sum
+        # reduction path ht.sum lowers to (on one chip the cross-device
+        # psum degenerates to the local tree reduction; multi-chip adds
+        # the ICI stage on top of this same kernel)
+        def body(i, carry):
+            return jnp.sum(x + carry) * 1e-20
+
+        return jax.lax.fori_loop(0, reps, body, jnp.float32(0.0))
+
+    ar_t = slope(allreduce_loop, xj, 20, 320)
+    allreduce_gbs = xj.size * 4 / ar_t / 1e9
+    return cdist_gbs, moments_gbs, allreduce_gbs
+
+
+def qr_svd_ms():
+    """Tall-skinny QR + SVD wall-clock (BASELINE config 5: resplit-heavy
+    linalg on a tall-skinny split DNDarray).  Slope-timed like everything
+    else: k back-to-back QR+SVD pairs behind ONE fence, per-pair time =
+    median paired difference between k=1 and k=5 regions, cancelling the
+    fixed tunnel/fence latency."""
+    import heat_tpu as ht
+
+    A = ht.random.randn(131072, 64, split=0)
+
+    def region(k):
+        t0 = time.perf_counter()
+        acc = 0.0
+        for _ in range(k):
+            q, r = ht.linalg.qr(A)
+            u, s, vt = ht.linalg.svd(A)
+            acc = s
+        float(acc.sum())  # single fence for the whole region
+        return time.perf_counter() - t0
+
+    region(1)  # compile
+    diffs = []
+    for _ in range(3):
+        t1 = region(1)
+        t5 = region(5)
+        diffs.append(t5 - t1)
+    diffs.sort()
+    return diffs[1] / 4 * 1e3
 
 
 def lasso_rate(data: np.ndarray, X):
@@ -188,8 +232,9 @@ def lasso_rate(data: np.ndarray, X):
 def main():
     data, centers = make_blobs()
     heat_rate, X = heat_kmeans_rate(data, centers)
-    cdist_gbs, moments_gbs = aux_metrics(data, X)
+    cdist_gbs, moments_gbs, allreduce_gbs = aux_metrics(data, X)
     lasso_sweeps = lasso_rate(data, X)
+    qr_ms = qr_svd_ms()
     numpy_rate = numpy_kmeans_rate(data, centers)
     print(
         json.dumps(
@@ -201,7 +246,9 @@ def main():
                 "baseline_numpy_iter_per_sec": round(numpy_rate, 2),
                 "cdist_gb_per_sec": round(cdist_gbs, 2),
                 "moments_gb_per_sec": round(moments_gbs, 2),
+                "allreduce_gb_per_sec": round(allreduce_gbs, 2),
                 "lasso_sweeps_per_sec": round(lasso_sweeps, 2),
+                "qr_svd_tall_skinny_ms": round(qr_ms, 2),
                 "config": f"n={N} f={F} k={K} iters={ITERS}",
             }
         )
