@@ -24,7 +24,7 @@ with :func:`jax.lax.ppermute` / sharding-transformations doing the
 communication over ICI.
 """
 
-from .primitives import all_to_all_resplit, halo_exchange, ring_map
+from .primitives import all_to_all_resplit, halo_exchange, ring_map, ring_source
 from .ring_attention import ring_attention, ring_self_attention
 from .ulysses import ulysses_attention
 
@@ -32,6 +32,7 @@ __all__ = [
     "all_to_all_resplit",
     "halo_exchange",
     "ring_map",
+    "ring_source",
     "ring_attention",
     "ring_self_attention",
     "ulysses_attention",

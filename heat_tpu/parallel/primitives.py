@@ -18,13 +18,25 @@ from jax.sharding import NamedSharding, PartitionSpec
 from ..core.communication import XlaCommunication, get_comm
 from ..core.dndarray import DNDarray
 
-__all__ = ["all_to_all_resplit", "halo_exchange", "ring_map"]
+__all__ = ["all_to_all_resplit", "halo_exchange", "ring_map", "ring_source"]
 
 
 def _unpack(x, comm: Optional[XlaCommunication]):
     if isinstance(x, DNDarray):
         return x.larray, x.comm
     return x, (comm or get_comm())
+
+
+def ring_source(position: int, round: int, size: int) -> int:
+    """Origin of the rotating block seen by ``position`` at ``round``.
+
+    With the +1 rotation used by :func:`ring_map`, after ``round`` hops the
+    block at mesh position p started at ``(p - round) % size``.  Consumers
+    of ragged inputs combine this with ``comm.valid_counts(n)`` to know how
+    many rows of the rotating block are real data — the analog of the
+    reference's per-rank Probe'd recv sizes (spatial/distance.py:271-287).
+    """
+    return (position - round) % size
 
 
 def ring_map(
@@ -43,19 +55,21 @@ def ring_map(
     every block.
 
     Returns an array with a leading ``size`` axis of per-round results,
-    sharded like ``x``.  Requires ``x.shape[axis] % size == 0``.
+    sharded like ``x``.  Any axis length is accepted: non-divisible axes
+    are zero-padded to the canonical layout (``comm.pad_to_shards``), so
+    ``fn`` sees equal ``shard_width``-row blocks whose trailing rows may be
+    padding — mask with ``comm.valid_counts`` + :func:`ring_source` when
+    the computation isn't padding-invariant.
     """
     arr, comm = _unpack(x, comm)
     size = comm.size
     if axis != 0:
         arr = jnp.moveaxis(arr, axis, 0)
-    if arr.shape[0] % max(size, 1) != 0:
-        raise ValueError(
-            f"ring_map needs axis {axis} ({arr.shape[0]}) divisible by mesh size ({size})"
-        )
     if size == 1:
         out = fn(arr, arr, 0)
         return out[None]
+    if arr.shape[0] % size != 0:
+        arr = comm.pad_to_shards(arr, axis=0)
 
     mesh, name = comm.mesh, comm.axis_name
     perm = [(i, (i + 1) % size) for i in range(size)]
@@ -106,7 +120,12 @@ def halo_exchange(
     ``(prev_halos, next_halos)`` where each is sharded like ``x`` and holds,
     per shard, the strip received from the neighbor (first/last shard
     receive zeros, mirroring the reference's absent-neighbor behavior).
-    Requires axis 0 divisible by the mesh size and local length ≥ halo.
+
+    Any axis-0 length is accepted via canonical zero-padding: with the
+    ceil-division layout, the predecessor of every non-empty shard is a
+    *full* shard, so the plain block-edge strips remain exact, and strips
+    that reach past the global end come back zero-filled — the natural
+    boundary semantics for stencils.  Requires ``halo_size ≤ shard_width``.
     """
     arr, comm = _unpack(x, comm)
     size = comm.size
@@ -115,12 +134,13 @@ def halo_exchange(
     if size == 1 or halo_size == 0:
         z = jnp.zeros((halo_size,) + arr.shape[1:], arr.dtype)
         return z, z
-    if arr.shape[0] % size != 0:
+    if comm.shard_width(arr.shape[0]) < halo_size:
         raise ValueError(
-            f"halo_exchange needs axis 0 ({arr.shape[0]}) divisible by mesh size ({size})"
+            f"halo_size ({halo_size}) exceeds the shard width "
+            f"({comm.shard_width(arr.shape[0])})"
         )
-    if arr.shape[0] // size < halo_size:
-        raise ValueError("halo_size exceeds the local shard length")
+    if arr.shape[0] % size != 0:
+        arr = comm.pad_to_shards(arr, axis=0)
 
     mesh, name = comm.mesh, comm.axis_name
     fwd = [(i, i + 1) for i in range(size - 1)]  # my tail → next's halo_prev

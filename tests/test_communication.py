@@ -188,13 +188,16 @@ def test_bcast_replicated_unchanged_and_split1():
 def test_exscan_minmax_identity():
     import numpy as np
     comm = ht.get_comm()
-    x = ht.array(np.array([[3.0], [1.0], [2.0]], np.float32)).larray
-    ex = np.asarray(comm.exscan(x, "max"))
+    n = comm.size
+    rng = np.random.default_rng(3)
+    parts = rng.integers(1, 50, size=(n, 1)).astype(np.float32)
+    ex = np.asarray(comm.exscan(ht.array(parts).larray, "max"))
     assert ex[0, 0] == np.finfo(np.float32).min
-    np.testing.assert_allclose(ex[1:, 0], [3.0, 3.0])
-    exi = np.asarray(comm.exscan(ht.array(np.array([[3], [1], [2]], np.int32)).larray, "min"))
+    np.testing.assert_allclose(ex[1:, 0], np.maximum.accumulate(parts[:, 0])[:-1])
+    iparts = rng.integers(-50, 50, size=(n, 1)).astype(np.int32)
+    exi = np.asarray(comm.exscan(ht.array(iparts).larray, "min"))
     assert exi[0, 0] == np.iinfo(np.int32).max
-    np.testing.assert_array_equal(exi[1:, 0], [3, 1])
+    np.testing.assert_array_equal(exi[1:, 0], np.minimum.accumulate(iparts[:, 0])[:-1])
 
 
 def test_init_multihost_single_process():
@@ -310,3 +313,78 @@ def test_import_is_backend_free():
     )
     res = run_in_fresh_python(script, drop_env=("PYTHONPATH",))  # drop the axon site dir
     assert "BACKEND_FREE_OK" in res.stdout, res.stdout + res.stderr
+
+
+def test_ragged_shard_helpers():
+    """shard_width / padded_size / valid_counts describe the canonical
+    padded layout for any axis length (the analog of the reference's
+    counts/displs vectors, communication.py:138-169)."""
+    comm = ht.get_comm()
+    n = comm.size
+    for length in (0, 1, n - 1, n, n + 1, 2 * n + 3, 23):
+        if length < 0:
+            continue
+        c = comm.shard_width(length)
+        assert c == (-(-length // n) if length else 0)
+        assert comm.padded_size(length) == n * c
+        vc = comm.valid_counts(length)
+        assert len(vc) == n
+        assert sum(vc) == length
+        assert all(0 <= v <= c for v in vc)
+        # valid counts are a full prefix of c's followed by the remainder
+        tail = [v for v in vc if v < c]
+        assert all(v == 0 for v in tail[1:])
+
+
+def test_pad_unpad_roundtrip():
+    comm = ht.get_comm()
+    n = comm.size
+    for length in (1, n + 1, 2 * n + 3, 23):
+        x = jnp.arange(length * 2, dtype=jnp.float32).reshape(length, 2)
+        xp = comm.pad_to_shards(x, axis=0)
+        assert xp.shape[0] == comm.padded_size(length)
+        np.testing.assert_array_equal(np.asarray(comm.unpad(xp, length, 0)), np.asarray(x))
+        # padding is zeros
+        np.testing.assert_array_equal(np.asarray(xp)[length:], 0.0)
+
+
+def test_ragged_permute_and_ring():
+    """permute/ring_permute accept non-divisible axis lengths: the input is
+    zero-padded to the canonical layout, blocks move whole, and
+    valid_counts identifies the real rows per destination (replaces the
+    round-1 divisibility ValueError)."""
+    comm = ht.get_comm()
+    n = comm.size
+    if n < 2:
+        pytest.skip("needs >1 device")
+    length = 2 * n + 3  # never divisible by n (remainder 3 for n>3, etc.)
+    if length % n == 0:
+        length += 1
+    x = jnp.arange(length * 2, dtype=jnp.float32).reshape(length, 2)
+    xp = np.asarray(comm.pad_to_shards(x, axis=0))
+    c = comm.shard_width(length)
+    out = np.asarray(comm.ring_permute(x, shift=1))
+    assert out.shape[0] == comm.padded_size(length)
+    for d in range(n):
+        s = (d - 1) % n
+        np.testing.assert_array_equal(out[d * c : (d + 1) * c], xp[s * c : (s + 1) * c])
+    # reversal permutation on the ragged layout
+    rev = np.asarray(comm.permute(x, [(i, n - 1 - i) for i in range(n)]))
+    for d in range(n):
+        s = n - 1 - d
+        np.testing.assert_array_equal(rev[d * c : (d + 1) * c], xp[s * c : (s + 1) * c])
+
+
+def test_alltoall_honors_recv_axis():
+    """alltoall re-splits data laid out at recv_axis to send_axis; the
+    global view is unchanged (reference __alltoall_like axis permutation,
+    communication.py:764-881)."""
+    comm = ht.get_comm()
+    n = comm.size
+    a = jnp.arange(2 * n * 3 * n, dtype=jnp.float32).reshape(2 * n, 3 * n)
+    out = comm.alltoall(a, send_axis=1, recv_axis=0)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(a))
+    # result is laid out along send_axis when divisible
+    spec = getattr(out.sharding, "spec", None)
+    if n > 1 and spec is not None:
+        assert tuple(spec) in ((None, comm.axis_name), (None, comm.axis_name, None))
