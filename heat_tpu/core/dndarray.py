@@ -108,6 +108,7 @@ class DNDarray:
         self.__balanced = True if balanced is None else bool(balanced)
         self.__halo_prev = None
         self.__halo_next = None
+        self.__halo_size = 0
 
     # ------------------------------------------------------------------ #
     # metadata properties (reference dndarray.py:95-360)                  #
@@ -160,6 +161,7 @@ class DNDarray:
         if tuple(array.shape) != self.__gshape:
             self.__gshape = tuple(int(s) for s in array.shape)
         self.__array = array
+        self._invalidate_halos()
 
     @property
     def lloc(self) -> LocalIndex:
@@ -276,6 +278,7 @@ class DNDarray:
             )
         self.__array = casted
         self.__dtype = dtype
+        self._invalidate_halos()
         return self
 
     def numpy(self) -> np.ndarray:
@@ -418,6 +421,7 @@ class DNDarray:
         self.__array = self.__comm.resplit(self.__array, axis)
         self.__split = axis
         self.__balanced = True
+        self._invalidate_halos()
         return self
 
     def resplit(self, axis: Optional[int] = None) -> "DNDarray":
@@ -430,34 +434,47 @@ class DNDarray:
     # halo exchange (reference dndarray.py:390-483)                       #
     # ------------------------------------------------------------------ #
     def get_halo(self, halo_size: int) -> None:
-        """Fetch boundary slabs from mesh neighbors.
+        """Fetch every shard's neighbor boundary strips via one ppermute
+        pair (:func:`heat_tpu.parallel.halo_exchange`).
 
-        The reference posts Isend/Irecv pairs with prev/next ranks
-        (dndarray.py:390-463) and stores the received strips.  Here the
-        strips are global-array slices — the data each shard boundary needs —
-        computed lazily; a fused shard_map/ppermute kernel is the hot-path
-        variant for stencil workloads (see parallel.halo).
+        The reference posts Isend/Irecv pairs with prev/next ranks and
+        stores the received strips per rank (dndarray.py:390-463).  Here
+        :attr:`halo_prev` / :attr:`halo_next` become *global sharded*
+        arrays whose split axis has length ``size * halo_size``: position
+        p's block holds the strip it received from its predecessor /
+        successor.  Strips reaching past the global edges are zero-filled
+        (the reference leaves them absent — a per-rank None; equal-shard
+        layouts need a uniform shape, and zeros are the natural stencil
+        boundary).
         """
         if not isinstance(halo_size, int):
             raise TypeError(f"halo_size needs to be an integer, but was {type(halo_size)}")
         if halo_size < 0:
             raise ValueError(f"halo_size needs to be a non-negative integer, but was {halo_size}")
         if self.__split is None or halo_size == 0:
-            self.__halo_prev = None
-            self.__halo_next = None
+            self._invalidate_halos()
             return
-        # strips adjacent to the position-0 shard: nothing precedes the
-        # global start (halo_prev empty, like the reference's rank 0), and
-        # halo_next is the first halo_size rows of the next shard
-        n = self.__gshape[self.__split]
-        off, lshape, _ = self.__comm.chunk(self.__gshape, self.__split, rank=0)
-        sl_prev = [slice(None)] * self.ndim
-        sl_next = [slice(None)] * self.ndim
-        sl_prev[self.__split] = slice(max(off - halo_size, 0), off)
-        end = off + lshape[self.__split]
-        sl_next[self.__split] = slice(end, min(end + halo_size, n))
-        self.__halo_prev = self.__array[tuple(sl_prev)]
-        self.__halo_next = self.__array[tuple(sl_next)]
+        from ..parallel.primitives import halo_exchange
+
+        arr = self.__array
+        if self.__split != 0:
+            arr = jnp.moveaxis(arr, self.__split, 0)
+        # halo_exchange validates halo_size <= shard_width (raising before
+        # any state here changes)
+        prev, nxt = halo_exchange(arr, halo_size, comm=self.__comm)
+        if self.__split != 0:
+            prev = jnp.moveaxis(prev, 0, self.__split)
+            nxt = jnp.moveaxis(nxt, 0, self.__split)
+        self.__halo_prev = prev
+        self.__halo_next = nxt
+        self.__halo_size = halo_size
+
+    def _invalidate_halos(self) -> None:
+        """Drop cached halo strips; called whenever the backing array or
+        layout changes (halos describe a specific array + split)."""
+        self.__halo_prev = None
+        self.__halo_next = None
+        self.__halo_size = 0
 
     @property
     def halo_prev(self):
@@ -469,11 +486,54 @@ class DNDarray:
 
     @property
     def array_with_halos(self) -> jax.Array:
-        """The position-0 shard extended by its halos
-        (reference dndarray.py:363-365,465-483)."""
-        if self.__split is None:
+        """Every shard extended by its neighbor strips
+        (reference dndarray.py:363-365, 465-483).
+
+        A global sharded array whose split axis has length
+        ``size * (shard_width + 2 * halo_size)``: position p's block is
+        ``[prev strip | shard p (zero-padded to shard_width) | next
+        strip]``.  Stencil consumers map over the blocks and keep rows
+        ``[halo_size, halo_size + shard_width)``, then unpad with
+        ``comm.valid_counts`` — see tests/test_extended_dndarray.py for
+        the pattern.  Without halos (or replicated) this is the plain
+        backing array.
+        """
+        h = self.__halo_size
+        if self.__split is None or not h:
             return self.__array
-        return self.__array
+        comm = self.__comm
+        split = self.__split
+        arr = self.__array
+        prev, nxt = self.__halo_prev, self.__halo_next
+        if split != 0:
+            arr = jnp.moveaxis(arr, split, 0)
+            prev = jnp.moveaxis(prev, split, 0)
+            nxt = jnp.moveaxis(nxt, split, 0)
+        arr = comm.pad_to_shards(arr, axis=0)
+        from jax.sharding import PartitionSpec
+
+        from ._compile import jitted
+
+        def make():
+            spec = PartitionSpec(comm.axis_name)
+
+            def kernel(p, b, nx):
+                return jnp.concatenate([p, b, nx], axis=0)
+
+            def _f(p, b, nx):
+                return jax.shard_map(
+                    kernel,
+                    mesh=comm.mesh,
+                    in_specs=(spec, spec, spec),
+                    out_specs=spec,
+                )(p, b, nx)
+
+            return _f
+
+        out = jitted(("dnd.halo_concat", comm), make)(prev, arr, nxt)
+        if split != 0:
+            out = jnp.moveaxis(out, 0, split)
+        return out
 
     # ------------------------------------------------------------------ #
     # indexing (reference dndarray.py:1476-1726, 3190-3339)               #
@@ -557,6 +617,7 @@ class DNDarray:
         self.__array = self.__comm.apply_sharding(
             self.__array.at[jkey].set(value), self.__split
         )
+        self._invalidate_halos()
 
     def fill_diagonal(self, value) -> "DNDarray":
         """Fill the main diagonal in place (reference dndarray.py:1161)."""
@@ -567,6 +628,7 @@ class DNDarray:
         self.__array = self.__comm.apply_sharding(
             self.__array.at[idx, idx].set(jnp.asarray(value, self.__array.dtype)), self.__split
         )
+        self._invalidate_halos()
         return self
 
     # ------------------------------------------------------------------ #
@@ -606,6 +668,7 @@ class DNDarray:
                 f"doesn't match the broadcast shape {tuple(res.shape)}"
             )
         self.__array, self.__dtype, self.__split = res.larray, res.dtype, res.split
+        self._invalidate_halos()
         return self
 
     def __sub__(self, other):

@@ -147,17 +147,85 @@ def test_lshape_map_tiles_global():
         assert off == T.shape[split]
 
 
-def test_halo_values():
-    X = ht.array(np.arange(32, dtype=np.float32).reshape(16, 2), split=0)
-    X.get_halo(2)
-    wh = X.array_with_halos
-    # the halo-extended local block must be a contiguous slice of the global
-    arr = np.asarray(wh)
-    flat = np.arange(32, dtype=np.float32).reshape(16, 2)
-    # find arr as a window of flat
-    n = arr.shape[0]
-    found = any(np.array_equal(arr, flat[i : i + n]) for i in range(16 - n + 1))
-    assert found
+def _check_halos(data, split, h):
+    """Per-shard halo assertions: every position's strips are the exact
+    global neighbor rows, zero-filled past the edges."""
+    X = ht.array(data, split=split)
+    X.get_halo(h)
+    comm = X.comm
+    n_dev = comm.size
+    n = data.shape[split]
+    c = comm.shard_width(n)
+    moved = np.moveaxis(data, split, 0)
+    padded = np.zeros((n_dev * c,) + moved.shape[1:], moved.dtype)
+    padded[:n] = moved
+    prev = np.moveaxis(np.asarray(X.halo_prev), split, 0)
+    nxt = np.moveaxis(np.asarray(X.halo_next), split, 0)
+    for p in range(n_dev):
+        start = p * c
+        want_prev = np.zeros((h,) + moved.shape[1:], moved.dtype)
+        if p > 0:
+            want_prev = padded[start - h : start]
+        np.testing.assert_array_equal(prev[p * h : (p + 1) * h], want_prev)
+        want_next = np.zeros((h,) + moved.shape[1:], moved.dtype)
+        if p < n_dev - 1:
+            want_next = padded[(p + 1) * c : (p + 1) * c + h]
+        np.testing.assert_array_equal(nxt[p * h : (p + 1) * h], want_next)
+    # extended blocks: [prev | shard | next] per position
+    wh = np.moveaxis(np.asarray(X.array_with_halos), split, 0)
+    w = c + 2 * h
+    assert wh.shape[0] == n_dev * w
+    for p in range(n_dev):
+        blk = wh[p * w : (p + 1) * w]
+        np.testing.assert_array_equal(blk[:h], prev[p * h : (p + 1) * h])
+        np.testing.assert_array_equal(blk[h : h + c], padded[p * c : (p + 1) * c])
+        np.testing.assert_array_equal(blk[h + c :], nxt[p * h : (p + 1) * h])
+
+
+def test_halo_values_per_shard():
+    """get_halo delivers real neighbor strips to every mesh position
+    (reference dndarray.py:390-463); checked for split=0, split=1, and a
+    ragged (non-divisible) length."""
+    data = np.arange(32, dtype=np.float32).reshape(16, 2)
+    _check_halos(data, 0, 2)
+    _check_halos(data.T.copy(), 1, 2)
+    n_dev = ht.get_comm().size
+    ragged = np.arange((3 * n_dev + 1) * 2, dtype=np.float32).reshape(3 * n_dev + 1, 2)
+    if ht.get_comm().shard_width(ragged.shape[0]) >= 2:
+        _check_halos(ragged, 0, 2)
+
+
+def test_halo_stencil():
+    """A 3-point stencil written against array_with_halos reproduces the
+    zero-boundary global stencil on every mesh size — the acceptance test
+    for real halo exchange (VERDICT round 1, item 2)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec
+
+    n = 16 if ht.get_comm().size != 7 else 23  # ragged on the prime mesh
+    data = np.arange(n, dtype=np.float32).reshape(n, 1) ** 0.5
+    X = ht.array(data, split=0)
+    comm = X.comm
+    h = 1
+    X.get_halo(h)
+    wh = X.array_with_halos  # blocks of c + 2h rows
+    c = comm.shard_width(n)
+
+    def stencil(block):
+        # 3-point average over the extended block; keep the interior
+        s = (block[:-2] + block[1:-1] + block[2:]) / 3.0
+        return s[: c]
+
+    spec = PartitionSpec(comm.axis_name)
+    out = jax.jit(
+        jax.shard_map(stencil, mesh=comm.mesh, in_specs=spec, out_specs=spec)
+    )(wh)
+    got = np.asarray(comm.unpad(out, n, 0))
+    padded = np.zeros((n + 2, 1), np.float32)
+    padded[1:-1] = data
+    want = (padded[:-2] + padded[1:-1] + padded[2:]) / 3.0
+    np.testing.assert_allclose(got, want, rtol=1e-6)
 
 
 # ------------------------------------------------------------------ metadata
@@ -232,3 +300,22 @@ def test_repr_and_str_split():
     big = ht.arange(100_000, split=0)
     s2 = str(big)
     assert "..." in s2 or len(s2) < 5000  # summarized, not 100k numbers
+
+
+def test_halo_invalidation_on_mutation():
+    """Cached halos describe a specific (array, split): resplit_ and
+    backing-array mutation drop them; a failed get_halo leaves prior state
+    untouched (all-or-nothing)."""
+    x = ht.array(np.ones((8, 8), np.float32), split=0)
+    x.get_halo(1)
+    assert x.halo_prev is not None
+    x.resplit_(1)
+    assert x.halo_prev is None
+    assert np.asarray(x.array_with_halos).shape == (8, 8)  # plain array again
+    y = ht.array(np.arange(8, dtype=np.float32), split=0)
+    y.get_halo(1)
+    with pytest.raises(ValueError):
+        y.get_halo(999)
+    assert y.halo_prev is not None  # prior exchange still valid
+    y[0] = 5.0
+    assert y.halo_prev is None  # mutation invalidates
