@@ -4,19 +4,37 @@ Reference: heat/core/linalg/qr.py:10-988 — a tiled CAQR over
 ``SquareDiagTiles`` with per-tile Householder factorizations, pairwise tile
 row merges, async Q-factor shipping, and a column-cyclic split=1 loop.
 
-TPU-first design (per SURVEY.md §7 build plan, item 8): **TSQR**
-(communication-avoiding tall-skinny QR).  For a row-split matrix, each shard
-computes a local QR; the stacked R factors are QR'd again; one round of
-all-gather replaces the reference's point-to-point tile choreography.  The
-merge tree is expressed with ``shard_map`` when the row count divides the
-mesh, falling back to XLA's own lowering otherwise.  split=1 and replicated
-inputs use on-device ``jnp.linalg.qr`` directly (same as reference
-split=None, qr.py:70-94).
+TPU-first design (per SURVEY.md §7 build plan, item 8):
+
+* **split=0 (row-sharded), m ≥ n: TSQR** (communication-avoiding
+  tall-skinny QR).  Each shard computes a local QR; the stacked R factors
+  are QR'd again; one all-gather replaces the reference's point-to-point
+  tile choreography.  Non-divisible row counts go through the canonical
+  zero-padding (``comm.pad_to_shards``): zero rows leave R untouched and —
+  because the stage-2 Q's rows matching zero R-stack rows vanish — drop
+  out of Q exactly, so ragged TSQR is exact for full-column-rank inputs
+  (the same caveat any QR has for deficient ones).
+* **split=1 (column-sharded), m ≥ n: blocked CGS2** — a panel loop in the
+  spirit of the reference's column-cyclic ``__split1_qr_loop``
+  (qr.py:817-988): each panel is orthogonalized against the accumulated Q
+  by two classical Gram-Schmidt projections (MXU matmuls; provably stable
+  for κ(A) ≲ 1/√ε) and factored locally.  ``tiles_per_proc`` subdivides
+  each mesh position's panel, matching the reference's latency/parallelism
+  knob (qr.py:31-36).
+* replicated or wide (m < n) inputs use on-device ``jnp.linalg.qr`` (same
+  as reference split=None, qr.py:70-94).
+
+The one remaining distributed fallback — split=0 with more than
+``m / n`` devices, where shards are wider than tall and TSQR's local QR
+does not reduce — gathers with a ``UserWarning`` (the R stack would be as
+large as the matrix itself, so gathering is also the bandwidth-optimal
+choice there).
 """
 
 from __future__ import annotations
 
 import collections
+import warnings
 from typing import Optional, Tuple
 
 import numpy as np
@@ -26,6 +44,7 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec
 
 from .. import factories, types
+from .._compile import jitted
 from ..dndarray import DNDarray
 from ..sanitation import sanitize_in
 
@@ -40,6 +59,7 @@ def _tsqr(a: DNDarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
     Stage 1: per-shard local QR inside shard_map (runs on every device in
     parallel).  Stage 2: the (size·n, n) stack of R factors — tiny — is
     QR'd once, and local Qs are corrected by the matching R-block.
+    Handles any row count via canonical zero-padding.
     """
     comm = a.comm
     mesh = comm.mesh
@@ -48,41 +68,127 @@ def _tsqr(a: DNDarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
     size = comm.size
     arr = a.larray
 
-    if size == 1 or m % size != 0 or m // size < n:
-        # not shard-decomposable: one on-device QR (XLA distributes)
-        q, r = jnp.linalg.qr(arr)
-        return q, r
+    if size == 1:
+        return jnp.linalg.qr(arr)
+    if comm.shard_width(m) < n:
+        # shards wider than tall: local QR would not reduce and the R
+        # stack would match the full matrix — gather and factor once
+        warnings.warn(
+            f"qr: {m}x{n} split=0 over {size} devices leaves shards with "
+            f"fewer rows ({comm.shard_width(m)}) than columns ({n}); "
+            "gathering for a single on-device QR (use fewer devices or a "
+            "taller matrix for distributed TSQR)",
+            stacklevel=3,
+        )
+        return jnp.linalg.qr(arr)
 
-    def _local_qr(block):
-        q, r = jnp.linalg.qr(block)
-        return q, r
+    arr_p = comm.pad_to_shards(arr, axis=0)
 
-    local_qr = jax.shard_map(
-        _local_qr,
-        mesh=mesh,
-        in_specs=PartitionSpec(axis, None),
-        out_specs=(PartitionSpec(axis, None), PartitionSpec(axis, None)),
-    )
-    q1, r1 = jax.jit(local_qr)(arr)  # q1: (m, n) row-split; r1: (size*n, n)
-
-    # stage 2 on the gathered R stack (size*n × n — small, replicated)
-    r1_full = comm.allgather(r1)
-    q2, r = jnp.linalg.qr(r1_full)  # q2: (size*n, n)
-
-    # combine: each shard's Q_local @ Q2-block
     from .basics import _precision
 
-    def _combine(q1_blk, q2_blk):
-        return jnp.matmul(q1_blk, q2_blk, precision=_precision())
+    def make():
+        def _local_qr(block):
+            q, r = jnp.linalg.qr(block)
+            return q, r  # plain tuple: QRResult confuses shard_map out_specs
 
-    combine = jax.shard_map(
-        _combine,
-        mesh=mesh,
-        in_specs=(PartitionSpec(axis, None), PartitionSpec(axis, None)),
-        out_specs=PartitionSpec(axis, None),
-    )
-    q = jax.jit(combine)(q1, q2)
-    return q, r
+        local_qr = jax.shard_map(
+            _local_qr,
+            mesh=mesh,
+            in_specs=PartitionSpec(axis, None),
+            out_specs=(PartitionSpec(axis, None), PartitionSpec(axis, None)),
+        )
+
+        def _combine(q1_blk, q2_blk):
+            return jnp.matmul(q1_blk, q2_blk, precision=_precision())
+
+        combine = jax.shard_map(
+            _combine,
+            mesh=mesh,
+            in_specs=(PartitionSpec(axis, None), PartitionSpec(axis, None)),
+            out_specs=PartitionSpec(axis, None),
+        )
+
+        def _f(x):
+            q1, r1 = local_qr(x)  # q1: (padded_m, n) row-split; r1: (size*n, n)
+            # stage 2 on the R stack (size*n × n — small, replicated)
+            r1_full = jax.lax.with_sharding_constraint(r1, comm.sharding(2, None))
+            q2, r = jnp.linalg.qr(r1_full)  # q2: (size*n, n)
+            q = combine(q1, q2)
+            return q, r
+
+        return _f
+
+    q, r = jitted(("qr.tsqr", comm), make)(arr_p)
+    return comm.unpad(q, m, 0), r
+
+
+def _cgs2_split1(a: DNDarray, tiles_per_proc: int) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Blocked classical Gram-Schmidt with reorthogonalization over column
+    panels (the TPU formulation of the reference's column-cyclic split=1
+    loop, qr.py:817-988).
+
+    Panels follow the mesh layout (one per position, subdivided by
+    ``tiles_per_proc``), so each projection is a large MXU matmul whose
+    collectives GSPMD schedules over ICI; no panel is ever gathered.
+    """
+    comm = a.comm
+    m, n = a.shape
+    arr = a.larray
+
+    # panel plan: each position's column block, split into tiles_per_proc
+    c = comm.shard_width(n)
+    bounds = []
+    for r in range(comm.size):
+        start, stop = r * c, min((r + 1) * c, n)
+        if start >= stop:
+            continue
+        width = stop - start
+        t = max(1, min(int(tiles_per_proc), width))
+        tw = -(-width // t)
+        for j in range(t):
+            s2 = start + j * tw
+            e2 = min(s2 + tw, stop)
+            if s2 < e2:
+                bounds.append((s2, e2))
+
+    def make():
+        from .basics import _precision
+
+        def _f(x):
+            q_panels = []
+            rows = []
+            q_acc = None  # (m, k) accumulated orthonormal columns
+            for (s, e) in bounds:
+                panel = x[:, s:e]
+                if q_acc is None:
+                    y = jnp.zeros((0, e - s), x.dtype)
+                else:
+                    # CGS2: project out the accumulated basis twice
+                    y1 = jnp.matmul(q_acc.T, panel, precision=_precision())
+                    panel = panel - jnp.matmul(q_acc, y1, precision=_precision())
+                    y2 = jnp.matmul(q_acc.T, panel, precision=_precision())
+                    panel = panel - jnp.matmul(q_acc, y2, precision=_precision())
+                    y = y1 + y2
+                qk, rkk = jnp.linalg.qr(panel)
+                q_panels.append(qk)
+                # R rows for this panel: [Y; Rkk; 0] padded to n rows later
+                rows.append((s, e, y, rkk))
+                q_acc = qk if q_acc is None else jnp.concatenate([q_acc, qk], axis=1)
+                q_acc = jax.lax.with_sharding_constraint(
+                    q_acc, comm.sharding(2, 1 if q_acc.shape[1] % comm.size == 0 else None)
+                )
+            q = jnp.concatenate(q_panels, axis=1)
+            r_full = jnp.zeros((n, n), x.dtype)
+            for (s, e, y, rkk) in rows:
+                if y.shape[0]:
+                    r_full = r_full.at[: y.shape[0], s:e].set(y)
+                r_full = r_full.at[s:e, s:e].set(rkk)
+            return q, r_full
+
+        return _f
+
+    key = ("qr.cgs2", comm, tuple(bounds), (m, n), str(arr.dtype))
+    return jitted(key, make)(arr)
 
 
 def qr(
@@ -93,25 +199,31 @@ def qr(
 ) -> QR:
     """Reduced QR factorization ``a = Q @ R`` (reference qr.py:10-302).
 
-    ``tiles_per_proc`` is accepted for API parity; the TSQR formulation has
-    no tile-count knob (the reference uses it to trade latency for
-    parallelism inside its tile grid, qr.py:31-36).
+    ``tiles_per_proc`` subdivides each mesh position's column panel in the
+    split=1 path (the reference's latency/parallelism knob, qr.py:31-36);
+    the split=0 TSQR formulation has no tile-count knob and ignores it.
     """
     sanitize_in(a)
     if not isinstance(tiles_per_proc, (int, np.integer)):
         raise TypeError(f"tiles_per_proc must be an int, got {type(tiles_per_proc)}")
+    if tiles_per_proc < 1:
+        raise ValueError(f"tiles_per_proc must be >= 1, got {tiles_per_proc}")
     if a.ndim != 2:
         raise ValueError(f"qr requires a 2-D DNDarray, got {a.ndim}-d")
 
     dtype = a.dtype if types.heat_type_is_inexact(a.dtype) else types.float32
     arr = a.larray.astype(dtype.jax_type())
+    aa = a if (a.dtype is dtype and arr is a.larray) else DNDarray(
+        arr, a.shape, dtype, a.split, a.device, a.comm, True
+    )
 
     if a.split == 0 and a.shape[0] >= a.shape[1]:
-        aa = a if a.dtype is dtype else a.astype(dtype)
-        q_g, r_g = _tsqr(aa if aa.larray is arr else DNDarray(arr, a.shape, dtype, a.split, a.device, a.comm, True))
+        q_g, r_g = _tsqr(aa)
+    elif a.split == 1 and a.shape[0] >= a.shape[1] and a.comm.size > 1:
+        q_g, r_g = _cgs2_split1(aa, int(tiles_per_proc))
     else:
-        # replicated, split=1, or wide matrices: on-device QR, XLA plans
-        # the distribution (reference split=1 loop qr.py:817-988)
+        # replicated or wide matrices: on-device QR, XLA plans the
+        # distribution (reference split=None, qr.py:70-94)
         q_g, r_g = jnp.linalg.qr(arr)
 
     comm, device = a.comm, a.device
@@ -120,7 +232,7 @@ def qr(
         r = DNDarray(comm.apply_sharding(r_g, r_split), tuple(r_g.shape), dtype, r_split, device, comm, True)
         return QR(None, r)
 
-    q_split = 0 if a.split == 0 else a.split
+    q_split = a.split
     q = DNDarray(comm.apply_sharding(q_g, q_split), tuple(q_g.shape), dtype, q_split, device, comm, True)
     r_split = None if a.split != 1 else 1
     r = DNDarray(comm.apply_sharding(r_g, r_split), tuple(r_g.shape), dtype, r_split, device, comm, True)

@@ -131,14 +131,14 @@ def halo_exchange(
     size = comm.size
     if halo_size < 0:
         raise ValueError(f"halo_size needs to be non-negative, got {halo_size}")
-    if size == 1 or halo_size == 0:
-        z = jnp.zeros((halo_size,) + arr.shape[1:], arr.dtype)
-        return z, z
-    if comm.shard_width(arr.shape[0]) < halo_size:
+    if halo_size and comm.shard_width(arr.shape[0]) < halo_size:
         raise ValueError(
             f"halo_size ({halo_size}) exceeds the shard width "
             f"({comm.shard_width(arr.shape[0])})"
         )
+    if size == 1 or halo_size == 0:
+        z = jnp.zeros((halo_size,) + arr.shape[1:], arr.dtype)
+        return z, z
     if arr.shape[0] % size != 0:
         arr = comm.pad_to_shards(arr, axis=0)
 

@@ -186,3 +186,41 @@ def test_qr_sweep(shape, split):
     np.testing.assert_allclose(qn @ rn, A, atol=1e-4)
     np.testing.assert_allclose(qn.T @ qn, np.eye(qn.shape[1]), atol=1e-4)
     np.testing.assert_allclose(rn, np.triu(rn), atol=1e-6)
+
+
+@pytest.mark.parametrize("m", [17, 100, 1000])
+@pytest.mark.parametrize("split", [0, 1])
+def test_qr_generality_no_fallback(m, split):
+    """VERDICT r1 item 3 acceptance: distributed QR for m∈{17,100,1000} ×
+    split∈{0,1} with no silent gather — ragged row counts go through padded
+    TSQR (split=0) / blocked CGS2 panels (split=1)."""
+    import warnings as _w
+
+    n = 8
+    comm = ht.get_comm()
+    rng = np.random.default_rng(m)
+    A = rng.normal(size=(m, n)).astype(np.float32)
+    x = ht.array(A, split=split)
+    expect_gather = split == 0 and comm.shard_width(m) < n
+    with _w.catch_warnings(record=True) as rec:
+        _w.simplefilter("always")
+        q, r = ht.linalg.qr(x)
+        gathered = any("gathering" in str(w.message) for w in rec)
+    assert gathered == expect_gather  # never silent, never needless
+    qn, rn = q.numpy(), r.numpy()
+    np.testing.assert_allclose(qn.T @ qn, np.eye(n), atol=5e-4)
+    np.testing.assert_allclose(qn @ rn, A, atol=5e-4 * max(1.0, np.abs(A).max()))
+    np.testing.assert_allclose(rn, np.triu(rn), atol=1e-6)
+
+
+def test_qr_tiles_per_proc_split1():
+    """tiles_per_proc subdivides split=1 panels (reference qr.py:31-36);
+    results stay correct for several tile counts, and invalid values raise."""
+    rng = np.random.default_rng(5)
+    A = rng.normal(size=(50, 12)).astype(np.float32)
+    for t in (1, 2, 3):
+        q, r = ht.linalg.qr(ht.array(A, split=1), tiles_per_proc=t)
+        np.testing.assert_allclose(q.numpy() @ r.numpy(), A, atol=1e-4)
+        np.testing.assert_allclose(q.numpy().T @ q.numpy(), np.eye(12), atol=1e-4)
+    with pytest.raises(ValueError):
+        ht.linalg.qr(ht.array(A, split=1), tiles_per_proc=0)
