@@ -46,6 +46,18 @@ def _freeze(kwargs: dict):
         return None
 
 
+def _canonical_result(result):
+    """Map op results whose jnp dtype has no heat analog back into the
+    lattice.  jax promotes unsigned accumulations to uint16/32/64; the heat
+    hierarchy — like the reference's (types.py:62-210) — carries uint8 as
+    its only unsigned type, and the reference's torch kernels return int64
+    for integer reductions, so wide-unsigned results cast to int64."""
+    kind = np.dtype(result.dtype).kind
+    if kind == "u" and np.dtype(result.dtype).itemsize > 1:
+        return result.astype(jnp.int64)
+    return result
+
+
 def __binary_op(
     operation: Callable,
     t1,
@@ -110,6 +122,7 @@ def __binary_op(
         result = fn(a1, a2)
     else:
         result = operation(a1, a2, **fn_kwargs)
+    result = _canonical_result(result)
     out_dtype = types.canonical_heat_type(result.dtype)
 
     # split of the result: anchor's split, adjusted for broadcasting
@@ -160,6 +173,7 @@ def __local_op(
         result = fn(arr)
     else:
         result = operation(arr.astype(cast) if cast else arr, **kwargs)
+    result = _canonical_result(result)
     dtype = types.canonical_heat_type(result.dtype)
     result = x.comm.apply_sharding(result, x.split if result.ndim else None)
     wrapped = DNDarray(result, tuple(result.shape), dtype, x.split, x.device, x.comm, x.balanced)
@@ -209,6 +223,7 @@ def __reduce_op(
         result = reduction(x.larray, axis=axis, keepdims=keepdims, **kwargs)
         if cast is not None:
             result = result.astype(cast)
+    result = _canonical_result(result)
     out_dtype = types.canonical_heat_type(result.dtype)
 
     # split bookkeeping (reference :446-456)
@@ -258,6 +273,7 @@ def __cum_op(
         )(operation(a, axis=axis)),
     )
     result = fn(x.larray)
+    result = _canonical_result(result)
     out_dtype = types.canonical_heat_type(result.dtype)
     result = x.comm.apply_sharding(result, x.split)
     wrapped = DNDarray(result, tuple(result.shape), out_dtype, x.split, x.device, x.comm, x.balanced)

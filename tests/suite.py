@@ -16,6 +16,10 @@ SPLITS = (None, 0)
 FLOAT_TYPES = (ht.float32, ht.float64)
 INT_TYPES = (ht.int32, ht.int64)
 ALL_TYPES = FLOAT_TYPES + INT_TYPES
+#: the reference's full sweep list (basic_test.py:141-170 iterates every
+#: heat dtype); small ints included here, bool swept separately where the
+#: op's domain admits it
+WIDE_TYPES = ALL_TYPES + (ht.int16, ht.int8, ht.uint8)
 
 
 def assert_array_equal(heat_array: ht.DNDarray, expected, rtol=1e-5, atol=1e-8):
@@ -37,6 +41,16 @@ def assert_array_equal(heat_array: ht.DNDarray, expected, rtol=1e-5, atol=1e-8):
         assert lmap[:, heat_array.split].sum() == heat_array.shape[heat_array.split]
 
 
+def all_splits(shape) -> tuple:
+    """Every valid split for ``shape``: None plus each axis — the sweep the
+    reference runs (basic_test.py:141-170 iterates range(ndim) + None)."""
+    try:
+        ndim = len(shape)
+    except TypeError:
+        ndim = 1
+    return (None,) + tuple(range(ndim))
+
+
 def assert_func_equal(
     shape,
     heat_func,
@@ -44,16 +58,23 @@ def assert_func_equal(
     heat_args=None,
     numpy_args=None,
     dtypes=FLOAT_TYPES,
-    splits=SPLITS,
+    splits=None,
     low=-100,
     high=100,
     rtol=1e-5,
     atol=1e-6,
 ):
     """Sweep dtype × split against a numpy oracle
-    (reference basic_test.py:141-170)."""
+    (reference basic_test.py:141-170).
+
+    ``splits=None`` (default) sweeps None plus *every* axis of ``shape`` —
+    including the column-sharded split=1 path for matrices.  Pass an
+    explicit tuple to restrict.
+    """
     heat_args = heat_args or {}
     numpy_args = numpy_args or {}
+    if splits is None:
+        splits = all_splits(shape)
     rng = np.random.default_rng(42)
     for dtype in dtypes:
         npdt = np.dtype(dtype._np_type)

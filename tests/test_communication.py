@@ -388,3 +388,44 @@ def test_alltoall_honors_recv_axis():
     spec = getattr(out.sharding, "spec", None)
     if n > 1 and spec is not None:
         assert tuple(spec) in ((None, comm.axis_name), (None, comm.axis_name, None))
+
+
+def test_shard_position_value_order():
+    """Mesh position p really owns global rows [p*c, (p+1)*c) — the
+    falsifiable core of the reference's gathered-value-order scenarios
+    (test_communication.py:2234-2408).  A shard_map kernel stamps each
+    block with its axis_index; the stamped global array must count up in
+    position order, which fails if mesh construction, chunk(), or the
+    shard_map in/out specs ever disagree on ordering."""
+    import jax
+    from jax.sharding import PartitionSpec
+
+    comm = ht.get_comm()
+    n = comm.size
+    spec = PartitionSpec(comm.axis_name)
+
+    def stamp(block):
+        idx = jax.lax.axis_index(comm.axis_name)
+        return jnp.full(block.shape, idx, jnp.int32)
+
+    for length in (2 * n, 2 * n + 1):  # divisible + ragged
+        x = jnp.zeros((comm.padded_size(length),), jnp.float32)
+        x = comm.apply_sharding(x, 0)
+        stamped = np.asarray(
+            jax.jit(
+                jax.shard_map(stamp, mesh=comm.mesh, in_specs=spec, out_specs=spec)
+            )(x)
+        )
+        c = comm.shard_width(length)
+        want = np.repeat(np.arange(n, dtype=np.int32), c)
+        np.testing.assert_array_equal(stamped, want)
+    # ragged chunk geometry tiles the true (unpadded) length in order
+    b = jnp.asarray(np.random.default_rng(5).normal(size=(2 * n + 1, 3)).astype(np.float32))
+    sb = comm.scatter(b, axis=0)
+    parts = []
+    for r in range(n):
+        _, lshape, slices = comm.chunk(b.shape, 0, rank=r)
+        blk = np.asarray(sb[slices])
+        assert blk.shape == lshape
+        parts.append(blk)
+    np.testing.assert_array_equal(np.concatenate(parts, axis=0), np.asarray(b))
