@@ -162,11 +162,29 @@ class _KCluster(ClusteringMixin, BaseEstimator):
         distances = self._metric(x, self._cluster_centers)
         return distances.argmin(axis=1)
 
-    def _update_centroids(self, x: DNDarray, matching_centroids: DNDarray):
-        raise NotImplementedError()
-
     def fit(self, x: DNDarray):
         raise NotImplementedError()
+
+    def _finalize_fit(self, x: DNDarray, centers, labels, n_iter) -> None:
+        """Store fused-loop results as DNDarrays (shared tail of every
+        fit(): device scalars stay on device, labels keep the input's row
+        sharding)."""
+        # device scalar; n_iter_ property syncs lazily on access
+        self._n_iter = n_iter
+        self._cluster_centers = DNDarray(
+            centers.astype(x.dtype.jax_type()),
+            (self.n_clusters, x.shape[1]),
+            x.dtype,
+            None,
+            x.device,
+            x.comm,
+            True,
+        )
+        labels_split = x.split if x.split == 0 else None
+        lab = x.comm.apply_sharding(labels, labels_split)
+        self._labels = DNDarray(
+            lab, tuple(lab.shape), types.int64, labels_split, x.device, x.comm, True
+        )
 
     def predict(self, x: DNDarray) -> DNDarray:
         """Nearest learned centroid for each sample

@@ -114,25 +114,7 @@ class KMeans(_KCluster):
         centers, labels, n_iter, inertia = KMeans._fit_loop(
             arr, centers, jnp.float32(self.tol), jnp.int32(self.max_iter)
         )
-        # device scalars; n_iter_/inertia_ properties sync lazily on access,
-        # so fit() itself never blocks on (or round-trips through) the host
-        self._n_iter = n_iter
-
-        self._cluster_centers = DNDarray(
-            centers.astype(x.dtype.jax_type()),
-            (self.n_clusters, x.shape[1]),
-            x.dtype,
-            None,
-            x.device,
-            x.comm,
-            True,
-        )
-        lab = x.comm.apply_sharding(labels, x.split if x.split == 0 else None)
-        from ..core import types
-
-        self._labels = DNDarray(
-            lab, tuple(lab.shape), types.int64, x.split if x.split == 0 else None,
-            x.device, x.comm, True,
-        )
+        self._finalize_fit(x, centers, labels, n_iter)
+        # device scalar; inertia_ property syncs lazily on access
         self._inertia = inertia
         return self

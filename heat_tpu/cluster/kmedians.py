@@ -7,8 +7,9 @@ for empty clusters (:67-80).
 
 TPU formulation: per-cluster medians are computed with a masked
 sort-free percentile over the global rows — cluster masks are applied with
-±inf sentinels so every cluster's median reduces in one fused pass, no
-ragged per-cluster gathers.
+NaN sentinels so every cluster's median reduces without ragged per-cluster
+gathers — and the ENTIRE fit is one jitted ``lax.while_loop`` (the KMeans
+pattern, kmeans.py:61-102): one dispatch, zero per-epoch host syncs.
 """
 
 from __future__ import annotations
@@ -65,40 +66,50 @@ class KMedians(_KCluster):
             random_state=random_state,
         )
 
-    def _update_centroids(self, x: DNDarray, matching_centroids: DNDarray):
-        arr = x.larray.astype(jnp.float32)
-        labels = matching_centroids.larray
-        med = _masked_median(arr, labels, self.n_clusters)
-        old = self._cluster_centers.larray.astype(jnp.float32)
-        # empty-cluster failsafe: keep the previous centroid
-        # (reference kmedians.py:67-80 restarts with a random datapoint)
-        med = jnp.where(jnp.isnan(med), old, med).astype(
-            self._cluster_centers.dtype.jax_type()
-        )
-        return DNDarray(
-            med, tuple(med.shape), self._cluster_centers.dtype, None, x.device, x.comm, True
-        )
+    @staticmethod
+    @jax.jit
+    def _fit_loop(arr, centers, tol, max_iter):
+        """The whole fit as one compiled ``lax.while_loop`` (the KMeans
+        pattern, kmeans.py:61-102): fused assign + masked-median update per
+        step, convergence decided on device.  Replaces the per-epoch
+        ``float(shift)`` host sync of the reference's loop
+        (kmedians.py:87-130) — on a tunneled TPU that round trip dwarfs the
+        step kernel.  |x|² is dropped from the assignment (constant across
+        candidates, see kmeans.py:70-76)."""
+        k = centers.shape[0]
+
+        def assign(c):
+            c2 = jnp.sum(c * c, axis=1)[None, :]
+            return jnp.argmin(c2 - 2.0 * jnp.matmul(arr, c.T), axis=1)
+
+        def update(labels, c):
+            med = _masked_median(arr, labels, k)
+            return jnp.where(jnp.isnan(med), c, med)
+
+        def cond(state):
+            it, _, shift = state
+            return jnp.logical_and(it < max_iter, shift > tol)
+
+        def body(state):
+            it, c, _ = state
+            nc = update(assign(c), c)
+            return it + 1, nc, jnp.sum((nc - c) ** 2)
+
+        init = (jnp.int32(0), centers, jnp.float32(jnp.inf))
+        n_iter, centers, _ = jax.lax.while_loop(cond, body, init)
+        return centers, assign(centers), n_iter
 
     def fit(self, x: DNDarray) -> "KMedians":
-        """(reference kmedians.py:87-130)"""
+        """(reference kmedians.py:87-130), as a single on-device loop."""
         sanitize_in(x)
         if x.ndim != 2:
             raise ValueError(f"input needs to be 2D, but was {x.ndim}D")
         self._initialize_cluster_centers(x)
+        arr = x.larray.astype(jnp.float32)
+        centers = self._cluster_centers.larray.astype(jnp.float32)
 
-        for epoch in range(self.max_iter):
-            labels = self._assign_to_cluster(x)
-            new_centers = self._update_centroids(x, labels)
-            shift = float(
-                jnp.sum(
-                    (new_centers.larray.astype(jnp.float32)
-                     - self._cluster_centers.larray.astype(jnp.float32)) ** 2
-                )
-            )
-            self._cluster_centers = new_centers
-            self._n_iter = epoch + 1
-            if shift <= self.tol:
-                break
-
-        self._labels = self._assign_to_cluster(x)
+        centers, labels, n_iter = KMedians._fit_loop(
+            arr, centers, jnp.float32(self.tol), jnp.int32(self.max_iter)
+        )
+        self._finalize_fit(x, centers, labels, n_iter)
         return self
