@@ -190,9 +190,23 @@ def flipud(a: DNDarray) -> DNDarray:
     return flip(a, 0)
 
 
+#: numpy-style pad modes jnp.pad lowers natively, plus the reference's
+#: torch.nn.functional.pad spellings (manipulations.py:1049-1394 passes
+#: mode straight through to F.pad: replicate == edge, circular == wrap)
+_PAD_MODE_ALIASES = {"replicate": "edge", "circular": "wrap"}
+_PAD_MODES = frozenset(
+    {"constant", "edge", "linear_ramp", "maximum", "mean", "median",
+     "minimum", "reflect", "symmetric", "wrap", "empty"}
+)
+
+
 def pad(array: DNDarray, pad_width, mode: str = "constant", constant_values=0) -> DNDarray:
-    """Pad an array (reference manipulations.py:1049-1394)."""
+    """Pad an array (reference manipulations.py:1049-1394 — mode is handed
+    to torch F.pad there; here to jnp.pad, accepting both numpy and torch
+    mode names)."""
     sanitize_in(array)
+    if not isinstance(mode, str):
+        raise TypeError(f"expected mode to be a string, but was {type(mode)}")
     # normalize pad_width to numpy form
     if isinstance(pad_width, (int, np.integer)):
         np_pad = pad_width
@@ -200,9 +214,11 @@ def pad(array: DNDarray, pad_width, mode: str = "constant", constant_values=0) -
         np_pad = tuple(
             tuple(p) if isinstance(p, (list, tuple)) else p for p in pad_width
         )
-    if mode != "constant":
-        raise NotImplementedError(f"pad mode {mode!r} not implemented (reference supports constant only)")
-    garr = jnp.pad(array.larray, np_pad, mode=mode, constant_values=constant_values)
+    mode = _PAD_MODE_ALIASES.get(mode, mode)
+    if mode not in _PAD_MODES:
+        raise NotImplementedError(f"pad mode {mode!r} not implemented")
+    kwargs = {"constant_values": constant_values} if mode == "constant" else {}
+    garr = jnp.pad(array.larray, np_pad, mode=mode, **kwargs)
     return _rewrap(array, garr, array.split, array.dtype)
 
 
@@ -408,26 +424,111 @@ def vstack(tup) -> DNDarray:
     return row_stack(list(tup))
 
 
+def _unique_mask_1d(flat):
+    """Sorted order, first-occurrence mask, and group ids of a flat array —
+    the static-shape half of unique (everything except the data-dependent
+    output length).  NaNs collapse to one representative (numpy's
+    ``equal_nan=True`` default)."""
+    order = jnp.argsort(flat, stable=True)
+    s = flat[order]
+    prev = jnp.roll(s, 1)
+    neq = s != prev
+    if jnp.issubdtype(s.dtype, jnp.floating):
+        neq = neq & ~(jnp.isnan(s) & jnp.isnan(prev))
+    mask = neq.at[0].set(True) if s.shape[0] else neq
+    groups = jnp.cumsum(mask) - 1
+    return order, s, mask, groups
+
+
+def _compact(values, mask, groups, n_unique: int):
+    """Scatter the masked first occurrences into a dense (n_unique, ...)
+    buffer.  ``n_unique`` is the ONE host-synced scalar unique() needs: the
+    output length is data-dependent, so the allocation size must reach the
+    host — but only the count crosses, never the data."""
+    sink = jnp.where(mask, groups, n_unique)
+    out_shape = (n_unique,) + values.shape[1:]
+    return jnp.zeros(out_shape, values.dtype).at[sink].set(values, mode="drop")
+
+
 def unique(a: DNDarray, sorted: bool = False, return_inverse: bool = False, axis=None):
     """Unique elements (reference manipulations.py:2685-2968 — per-rank
-    unique + Allgatherv + merge; here one global jnp/np.unique; runs on host
-    shapes because uniqueness is data-dependent)."""
+    torch.unique + Allgatherv + merge on the gathered union).
+
+    TPU formulation: one device-resident global sort (XLA partitions sorts
+    over sharded inputs) → first-occurrence mask → count → scatter-compact.
+    Only the unique COUNT syncs to the host (the output allocation is
+    data-dependent; JAX needs a static shape) — the data itself never
+    leaves the device, so scale is bounded by HBM, not host memory.
+    ``axis=k`` uniquifies rows via a lexicographic sort of the remaining
+    dims.  Results are always in sorted order (the reference's
+    ``sorted=False`` leaves order unspecified)."""
     sanitize_in(a)
-    arr = np.asarray(a.larray)
     if axis is not None:
         axis = sanitize_axis(a.shape, axis)
-    res = np.unique(arr, return_inverse=return_inverse, axis=axis)
-    if return_inverse:
-        uniques, inverse = res
-    else:
-        uniques, inverse = res, None
-    uniques = jnp.asarray(uniques)
-    split = 0 if a.split is not None and uniques.ndim > 0 else None
+        return _unique_axis(a, axis, return_inverse)
+
+    flat = jnp.ravel(a.larray)
+    order, s, mask, groups = _unique_mask_1d(flat)
+    n_unique = int(jnp.sum(mask))  # the single scalar host sync
+    uniques = _compact(s, mask, groups, n_unique)
+    split = 0 if a.split is not None else None
     result = _rewrap(a, uniques, split, a.dtype)
     if return_inverse:
-        inv = factories.array(inverse.reshape(arr.shape) if axis is None else inverse,
-                              dtype=types.int64, device=a.device, comm=a.comm)
-        return result, inv
+        inv = jnp.zeros(flat.shape, jnp.int64).at[order].set(groups)
+        inv_wrapped = factories.array(
+            inv.reshape(a.larray.shape), dtype=types.int64, device=a.device, comm=a.comm
+        )
+        return result, inv_wrapped
+    return result
+
+
+#: above this flattened-slice width, axis-unique falls back to the host:
+#: jnp.lexsort builds one variadic-sort operand per column, so compile time
+#: and memory scale with m — a (n, 10k) matrix would emit a 10k-operand sort
+_UNIQUE_AXIS_MAX_LEXSORT_KEYS = 64
+
+
+def _unique_axis(a: DNDarray, axis: int, return_inverse: bool):
+    """Unique slices along ``axis``: lexicographic device sort of the
+    flattened remaining dims, then the same mask/count/compact pipeline as
+    the flat case.  Very wide slices (> _UNIQUE_AXIS_MAX_LEXSORT_KEYS
+    columns) use host numpy instead — XLA's variadic sort takes one operand
+    per key, which does not scale in compile time."""
+    moved = jnp.moveaxis(a.larray, axis, 0)
+    n = moved.shape[0]
+    rows = moved.reshape(n, -1)
+    m = rows.shape[1]
+    if m > _UNIQUE_AXIS_MAX_LEXSORT_KEYS:
+        host = np.asarray(a.larray)
+        res = np.unique(host, return_inverse=return_inverse, axis=axis)
+        uniques, inverse = res if return_inverse else (res, None)
+        split = 0 if a.split is not None else None
+        result = _rewrap(a, jnp.asarray(uniques), split, a.dtype)
+        if return_inverse:
+            inv_wrapped = factories.array(
+                inverse, dtype=types.int64, device=a.device, comm=a.comm
+            )
+            return result, inv_wrapped
+        return result
+    # lexsort: last key is primary → feed columns in reverse order
+    order = jnp.lexsort(tuple(rows[:, j] for j in range(m - 1, -1, -1))) if m else jnp.arange(n)
+    s = rows[order]
+    prev = jnp.roll(s, 1, axis=0)
+    neq_el = s != prev
+    if jnp.issubdtype(s.dtype, jnp.floating):
+        neq_el = neq_el & ~(jnp.isnan(s) & jnp.isnan(prev))
+    neq = jnp.any(neq_el, axis=1) if m else jnp.zeros((n,), bool)
+    mask = neq.at[0].set(True) if n else neq
+    groups = jnp.cumsum(mask) - 1
+    n_unique = int(jnp.sum(mask))  # the single scalar host sync
+    uniq_rows = _compact(s, mask, groups, n_unique)
+    garr = jnp.moveaxis(uniq_rows.reshape((n_unique,) + moved.shape[1:]), 0, axis)
+    split = 0 if a.split is not None else None
+    result = _rewrap(a, garr, split, a.dtype)
+    if return_inverse:
+        inv = jnp.zeros((n,), jnp.int64).at[order].set(groups)
+        inv_wrapped = factories.array(inv, dtype=types.int64, device=a.device, comm=a.comm)
+        return result, inv_wrapped
     return result
 
 
