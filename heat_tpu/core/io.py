@@ -40,6 +40,13 @@ try:
 except ImportError:
     nc = None
 
+try:
+    # fallback NetCDF backend: scipy's pure-python NetCDF-3 reader/writer
+    # (classic format only — no groups, no 64-bit integer variables)
+    from scipy.io import netcdf_file as _scipy_nc
+except ImportError:
+    _scipy_nc = None
+
 __all__ = [
     "load",
     "load_csv",
@@ -64,8 +71,10 @@ def supports_hdf5() -> bool:
 
 
 def supports_netcdf() -> bool:
-    """True when netCDF4 is importable (reference io.py:34-41)."""
-    return nc is not None
+    """True when a NetCDF backend is importable: netCDF4 (full NetCDF-4),
+    else scipy's classic NetCDF-3 reader/writer (reference io.py:34-41
+    gates on netCDF4 alone)."""
+    return nc is not None or _scipy_nc is not None
 
 
 def _sharded_from_reader(shape, np_dtype, split, device, comm, read_slices):
@@ -151,16 +160,25 @@ def load_netcdf(
 ) -> DNDarray:
     """Load a NetCDF variable (reference io.py:235-311)."""
     if not supports_netcdf():
-        raise RuntimeError("netCDF4 is required for NetCDF support")
+        raise RuntimeError("a NetCDF backend (netCDF4 or scipy) is required")
     dtype = types.canonical_heat_type(dtype)
-    with nc.Dataset(path, "r") as handle:
-        var = handle.variables[variable]
-        gshape = tuple(var.shape)
     np_dtype = np.dtype(dtype._np_type)
 
-    def read_slices(index):
-        with nc.Dataset(path, "r") as f:
-            return np.asarray(f.variables[variable][index], dtype=np_dtype)
+    if nc is not None:
+        with nc.Dataset(path, "r") as handle:
+            gshape = tuple(handle.variables[variable].shape)
+
+        def read_slices(index):
+            with nc.Dataset(path, "r") as f:
+                return np.asarray(f.variables[variable][index], dtype=np_dtype)
+
+    else:
+        with _scipy_nc(path, "r", mmap=False) as handle:
+            gshape = tuple(handle.variables[variable].shape)
+
+        def read_slices(index):
+            with _scipy_nc(path, "r", mmap=False) as f:
+                return np.array(f.variables[variable][index], dtype=np_dtype)
 
     return _sharded_from_reader(gshape, dtype, split, device, comm, read_slices)
 
@@ -168,19 +186,55 @@ def load_netcdf(
 def save_netcdf(
     data: DNDarray, path: str, variable: str, mode: str = "w", dimension_names=None, **kwargs
 ) -> None:
-    """Save to NetCDF (reference io.py:312-621)."""
+    """Save to NetCDF (reference io.py:312-621 — rank-ordered slab writes;
+    here the controller writes each shard slab, bounding host memory by one
+    shard exactly like :func:`save_hdf5`)."""
     if not supports_netcdf():
-        raise RuntimeError("netCDF4 is required for NetCDF support")
+        raise RuntimeError("a NetCDF backend (netCDF4 or scipy) is required")
     if not isinstance(data, DNDarray):
         raise TypeError(f"data must be a DNDarray, not {type(data)}")
     if dimension_names is None:
         dimension_names = [f"dim_{i}" for i in range(data.ndim)]
-    with nc.Dataset(path, mode) as f:
-        for name, length in zip(dimension_names, data.shape):
-            if name not in f.dimensions:
-                f.createDimension(name, length)
-        var = f.createVariable(variable, np.dtype(data.dtype._np_type), tuple(dimension_names), **kwargs)
-        var[...] = np.asarray(data.larray)
+    np_dtype = np.dtype(data.dtype._np_type)
+
+    def write_slabs(var):
+        if data.split is None:
+            var[...] = np.asarray(data.larray)
+        else:
+            # slab-at-a-time writes bound host memory by one shard
+            for r in range(data.comm.size):
+                _, _, slices = data.comm.chunk(data.shape, data.split, rank=r)
+                if any(s.stop <= s.start for s in slices):
+                    continue
+                var[slices] = np.asarray(data.larray[slices])
+
+    if nc is not None:
+        with nc.Dataset(path, mode) as f:
+            for name, length in zip(dimension_names, data.shape):
+                if name not in f.dimensions:
+                    f.createDimension(name, length)
+            write_slabs(f.createVariable(variable, np_dtype, tuple(dimension_names), **kwargs))
+    else:
+        if kwargs:
+            raise TypeError(
+                f"NetCDF-3 (scipy backend) does not support createVariable "
+                f"options {sorted(kwargs)}; install netCDF4 for them"
+            )
+        # classic NetCDF-3 typecodes: int8/int16/int32, float32/float64
+        classic_ok = (np_dtype.kind == "i" and np_dtype.itemsize <= 4) or (
+            np_dtype.kind == "f" and np_dtype.itemsize in (4, 8)
+        )
+        if not classic_ok:
+            raise TypeError(
+                f"NetCDF-3 (scipy backend) cannot store dtype {np_dtype}; "
+                "cast to a signed int <= 32 bits or float32/float64, or "
+                "install netCDF4"
+            )
+        with _scipy_nc(path, "w" if mode == "w" else "a") as f:
+            for name, length in zip(dimension_names, data.shape):
+                if name not in f.dimensions:
+                    f.createDimension(name, length)
+            write_slabs(f.createVariable(variable, np_dtype, tuple(dimension_names)))
 
 
 def load_csv(

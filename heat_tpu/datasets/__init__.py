@@ -13,7 +13,7 @@ from typing import Optional, Tuple
 
 _DATA_DIR = os.path.join(os.path.dirname(__file__), "data")
 
-__all__ = ["data_path", "load_iris", "load_diabetes"]
+__all__ = ["data_path", "load_iris", "load_iris_split", "load_diabetes"]
 
 
 def data_path(name: str) -> str:
@@ -27,6 +27,20 @@ def load_iris(split: Optional[int] = None, device=None):
     from ..core import io
 
     return io.load_hdf5(data_path("iris.h5"), "data", split=split, device=device)
+
+
+def load_iris_split(split: Optional[int] = None, device=None):
+    """The bundled 75/75 iris train/test split as four DNDarrays
+    ``(X_train, X_test, y_train, y_test)`` — the same file family the
+    reference ships (heat/datasets/data/iris_X_train.csv etc.), here
+    derived deterministically from iris.csv (scripts/make_datasets.py)."""
+    from ..core import io, types
+
+    x_tr = io.load_csv(data_path("iris_X_train.csv"), sep=";", split=split, device=device)
+    x_te = io.load_csv(data_path("iris_X_test.csv"), sep=";", split=split, device=device)
+    y_tr = io.load_csv(data_path("iris_y_train.csv"), dtype=types.int32, split=split, device=device)
+    y_te = io.load_csv(data_path("iris_y_test.csv"), dtype=types.int32, split=split, device=device)
+    return x_tr, x_te, y_tr.flatten(), y_te.flatten()
 
 
 def load_diabetes(split: Optional[int] = None, device=None):

@@ -1,0 +1,54 @@
+"""Regenerate the bundled dataset files derived from iris.csv.
+
+The reference ships iris as csv/h5/nc plus a fixed 75/75 train/test split
+(`/root/reference/heat/datasets/data/`: iris.nc, iris_X_train.csv,
+iris_X_test.csv, iris_y_train.csv, iris_y_test.csv, iris_labels.csv).
+This script derives the same FAMILY of files from our own iris.csv (the
+canonical 150x4 public-domain measurements, class-sorted 50/50/50) rather
+than copying the reference's bytes: the split is a deterministic
+even/odd-row interleave, which keeps all three classes balanced across
+train and test.
+
+Run from the repo root:  python scripts/make_datasets.py
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+DATA = os.path.join(os.path.dirname(__file__), "..", "heat_tpu", "datasets", "data")
+
+
+def main() -> None:
+    iris = np.genfromtxt(os.path.join(DATA, "iris.csv"), delimiter=";", dtype=np.float32)
+    assert iris.shape == (150, 4), iris.shape
+    # canonical iris ordering: rows [0,50) class 0, [50,100) class 1, [100,150) class 2
+    labels = np.repeat(np.arange(3), 50)
+
+    np.savetxt(os.path.join(DATA, "iris_labels.csv"), labels, fmt="%d")
+
+    train = np.arange(150) % 2 == 0  # deterministic balanced interleave
+    fmt4 = ";".join(["%.3f"] * 4)
+    np.savetxt(os.path.join(DATA, "iris_X_train.csv"), iris[train], fmt=fmt4, delimiter=";")
+    np.savetxt(os.path.join(DATA, "iris_X_test.csv"), iris[~train], fmt=fmt4, delimiter=";")
+    np.savetxt(os.path.join(DATA, "iris_y_train.csv"), labels[train], fmt="%d")
+    np.savetxt(os.path.join(DATA, "iris_y_test.csv"), labels[~train], fmt="%d")
+
+    # NetCDF-3 classic via scipy (readable by the netCDF4 library and every
+    # nc tool; the netCDF4 package itself is not part of this toolchain)
+    from scipy.io import netcdf_file
+
+    path = os.path.join(DATA, "iris.nc")
+    with netcdf_file(path, "w") as f:
+        f.createDimension("rows", 150)
+        f.createDimension("cols", 4)
+        var = f.createVariable("data", np.float32, ("rows", "cols"))
+        var[:] = iris
+
+    print("wrote iris_labels/X_train/X_test/y_train/y_test.csv and iris.nc under", DATA)
+
+
+if __name__ == "__main__":
+    main()

@@ -70,6 +70,17 @@ def test_netcdf_gated(tmp_path):
             ht.load_netcdf("nope.nc", "var")
 
 
+def test_netcdf_split_roundtrip(tmp_path):
+    """Sharded save (slab-at-a-time) → sharded load round-trip."""
+    if not ht.io.supports_netcdf():
+        pytest.skip("no NetCDF backend")
+    p = str(tmp_path / "s.nc")
+    x = ht.arange(56, dtype=ht.float32).reshape((8, 7)).resplit(0)
+    ht.save_netcdf(x, p, "var")
+    y = ht.load_netcdf(p, "var", split=1)
+    np.testing.assert_array_equal(y.numpy(), x.numpy())
+
+
 def test_bundled_datasets():
     iris = ht.datasets.load_iris(split=0)
     assert iris.shape == (150, 4)
@@ -80,3 +91,17 @@ def test_bundled_datasets():
     # csv copy matches h5 copy
     iris_csv = ht.load_csv(ht.datasets.data_path("iris.csv"), sep=";")
     np.testing.assert_allclose(iris_csv.numpy(), iris.numpy(), atol=0.051)
+    # the .nc copy matches too (reference ships iris.nc alongside csv/h5)
+    if ht.io.supports_netcdf():
+        iris_nc = ht.load_netcdf(ht.datasets.data_path("iris.nc"), "data", split=0)
+        np.testing.assert_allclose(iris_nc.numpy(), iris.numpy(), atol=0.051)
+    # 75/75 train/test family covers all three classes on both sides
+    x_tr, x_te, y_tr, y_te = ht.datasets.load_iris_split()
+    assert set(np.unique(y_tr.numpy())) == {0, 1, 2}
+    assert set(np.unique(y_te.numpy())) == {0, 1, 2}
+    # train ∪ test is exactly the csv copy (the split files are generated
+    # from iris.csv at full precision, scripts/make_datasets.py)
+    np.testing.assert_array_equal(
+        np.sort(np.concatenate([x_tr.numpy(), x_te.numpy()]), axis=0),
+        np.sort(iris_csv.numpy(), axis=0),
+    )

@@ -172,6 +172,17 @@ def test_knn():
     assert ht.core.base.is_classifier(knn)
 
 
+def test_knn_train_test_split():
+    """KNN generalizes across the bundled iris train/test split (the
+    reference's iris_X_train/test CSV family flow)."""
+    x_tr, x_te, y_tr, y_te = ht.datasets.load_iris_split(split=0)
+    assert x_tr.shape == (75, 4) and x_te.shape == (75, 4)
+    assert y_tr.shape == (75,) and y_te.shape == (75,)
+    knn = ht.classification.KNN(x_tr, y_tr, 5)
+    acc = (knn.predict(x_te).numpy() == y_te.numpy()).mean()
+    assert acc > 0.9
+
+
 # ---------------------------------------------------------------- gaussianNB
 def test_gaussian_nb():
     iris = ht.datasets.load_iris(split=0)
