@@ -143,6 +143,20 @@ class XlaCommunication(Communication):
         """True when the mesh spans more than one device."""
         return self.size > 1
 
+    def local_position(self) -> int:
+        """Mesh position of the calling process's first addressable device.
+
+        Single-host this is 0 (every device is addressable); on multihost it
+        is the position of the first device owned by THIS process — the
+        honest analog of the reference's "calling rank" for per-shard
+        metadata like ``DNDarray.lshape``.
+        """
+        pid = jax.process_index()
+        for pos, d in enumerate(self._devices):
+            if getattr(d, "process_index", 0) == pid:
+                return pos
+        return 0
+
     def __repr__(self) -> str:
         plat = self._devices[0].platform if self._devices else "?"
         return f"XlaCommunication({self.size} {plat} device(s), axis='{self.axis_name}')"
@@ -309,7 +323,7 @@ class XlaCommunication(Communication):
             split = None
         sh = self.sharding(array.ndim, split)
         if split is None or array.shape[split] % self.size == 0:
-            return jax.device_put(array, sh)
+            return _reshard(array, sh)
         return _constrained_copy(array, sh)
 
     # ------------------------------------------------------------------ #
@@ -320,7 +334,7 @@ class XlaCommunication(Communication):
         (communication.py:646-711) expressed as a reshard-to-replicated; XLA
         emits a single all-gather over ICI."""
         del axis  # the global array already carries its own geometry
-        return jax.device_put(array, self.sharding(array.ndim, None))
+        return _reshard(array, self.sharding(array.ndim, None))
 
     def alltoall(self, array: jax.Array, send_axis: int, recv_axis: int) -> jax.Array:
         """Swap the sharded axis: the reference's axis-permuted ``Alltoallv``
@@ -476,7 +490,7 @@ class XlaCommunication(Communication):
             return array
         _, _, slices = self.chunk(tuple(array.shape), split, rank=root)
         block = array[slices]
-        return jax.device_put(block, self.sharding(block.ndim, None))
+        return _reshard(block, self.sharding(block.ndim, None))
 
     def scatter(self, array: jax.Array, axis: int = 0) -> jax.Array:
         """Distribute a (replicated) array so each mesh position owns one
@@ -579,6 +593,23 @@ def _constrained_copy(array: jax.Array, sh: NamedSharding) -> jax.Array:
         return jax.lax.with_sharding_constraint(x, sh)
 
     return jax.jit(_f)(array)
+
+
+def _reshard(array, sh: NamedSharding):
+    """Exact relayout to ``sh``: plain :func:`jax.device_put` single-host,
+    but a compiled reshard for multi-process global arrays — device_put
+    cannot relayout an array that spans non-addressable devices (jax
+    raises in ``_different_device_order_reshard`` for computed GSPMD
+    outputs), whereas a jitted sharding constraint lowers to the proper
+    cross-host collective.  Host values (numpy / single-device arrays) keep
+    the device_put path everywhere."""
+    if (
+        jax.process_count() > 1
+        and isinstance(array, jax.Array)
+        and len(getattr(array.sharding, "device_set", ())) > 1
+    ):
+        return _constrained_copy(array, sh)
+    return jax.device_put(array, sh)
 
 
 # ---------------------------------------------------------------------- #

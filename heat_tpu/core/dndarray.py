@@ -170,9 +170,13 @@ class DNDarray:
 
     @property
     def lshape(self) -> Tuple[int, ...]:
-        """Shape of the shard at mesh position 0 (reference dndarray.py:205:
-        the calling rank's chunk)."""
-        _, lshape, _ = self.__comm.chunk(self.__gshape, self.__split, rank=0)
+        """Shape of the calling process's first shard (reference
+        dndarray.py:205: the calling rank's chunk).  Single-host this is
+        mesh position 0; on multihost (init_multihost) it is the first
+        position owned by THIS process."""
+        _, lshape, _ = self.__comm.chunk(
+            self.__gshape, self.__split, rank=self.__comm.local_position()
+        )
         return lshape
 
     @property
@@ -197,7 +201,8 @@ class DNDarray:
 
     @property
     def lnumel(self) -> int:
-        """Elements in the position-0 shard (reference dndarray.py:231)."""
+        """Elements in the calling process's first shard (reference
+        dndarray.py:231)."""
         return int(np.prod(self.lshape)) if self.lshape else 1
 
     @property

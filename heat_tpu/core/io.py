@@ -128,26 +128,104 @@ def load_hdf5(
     return _sharded_from_reader(gshape, dtype, split, device, comm, read_slices)
 
 
+def _emit_slabs(data: DNDarray, write):
+    """Feed host slabs of ``data`` to ``write(slices, np_block)`` one shard
+    at a time (bounding host memory by one shard).  ``write`` may be None —
+    the process then still participates in slab fetches: on multihost
+    (``jax.process_count() > 1``) fetching a slab is a cross-process
+    allgather that EVERY process must join, while only process 0 writes
+    the file (the analog of the reference's rank-ordered MPI-IO writes,
+    reference io.py:129-234).
+
+    A ``write`` failure is RETURNED, not raised: the fetch sequence is a
+    collective program that must run to completion in lockstep on every
+    process — aborting it mid-way on one process would hang the others in
+    their next allgather.  Callers re-raise after the barrier."""
+    multihost = jax.process_count() > 1
+    err = None
+    if data.split is None:
+        # replicated arrays are addressable everywhere — direct fetch
+        if write is not None:
+            try:
+                write(tuple(slice(0, s) for s in data.shape), np.asarray(data.larray))
+            except Exception as e:  # noqa: BLE001 — deferred to the caller
+                err = e
+        return err
+    for r in range(data.comm.size):
+        _, _, slices = data.comm.chunk(data.shape, data.split, rank=r)
+        if any(s.stop <= s.start for s in slices):
+            continue
+        block = data.larray[slices]
+        if multihost:
+            from jax.experimental import multihost_utils
+
+            block = multihost_utils.process_allgather(block, tiled=True)
+        if write is not None and err is None:
+            try:
+                write(slices, np.asarray(block))
+            except Exception as e:  # noqa: BLE001 — deferred to the caller
+                err = e
+    return err
+
+
+def _io_barrier() -> None:
+    """Cross-process barrier after a save so no process reads a file the
+    writer has not finished (no-op single-host)."""
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices("heat_tpu_io_save")
+
+
+def _writer_save(data: DNDarray, prepare) -> None:
+    """Writer-side half of a cross-process save.  ``prepare`` returns
+    ``(write, close)`` for the target file; any error — open, dataset
+    creation, or a slab write — is DEFERRED until the slab fetches and the
+    barrier have run, because those are collectives the other processes
+    are already executing (an early raise on the writer would hang the
+    cluster in the next allgather)."""
+    err, write, close = None, None, None
+    try:
+        write, close = prepare()
+    except Exception as e:  # noqa: BLE001 — deferred past the collectives
+        err = e
+    werr = _emit_slabs(data, write)
+    if close is not None:
+        try:
+            close()
+        except Exception as e:  # noqa: BLE001
+            err = err or e
+    _io_barrier()
+    if err or werr:
+        raise err or werr
+
+
 def save_hdf5(data: DNDarray, path: str, dataset: str, mode: str = "w", **kwargs) -> None:
     """Save to HDF5 (reference io.py:129-234 — rank-0 metadata + ordered
-    per-rank slab writes; here the controller writes each shard slab)."""
+    per-rank slab writes; here process 0 writes each shard slab)."""
     if not supports_hdf5():
         raise RuntimeError("h5py is required for HDF5 support")
     if not isinstance(data, DNDarray):
         raise TypeError(f"data must be a DNDarray, not {type(data)}")
     if not isinstance(path, str):
         raise TypeError(f"path must be str, not {type(path)}")
-    with h5py.File(path, mode) as f:
-        dset = f.create_dataset(dataset, data.shape, dtype=np.dtype(data.dtype._np_type), **kwargs)
-        if data.split is None:
-            dset[...] = np.asarray(data.larray)
-        else:
-            # slab-at-a-time writes bound host memory by one shard
-            for r in range(data.comm.size):
-                _, _, slices = data.comm.chunk(data.shape, data.split, rank=r)
-                if any(s.stop <= s.start for s in slices):
-                    continue
-                dset[slices] = np.asarray(data.larray[slices])
+
+    def prepare():
+        f = h5py.File(path, mode)
+        try:
+            dset = f.create_dataset(
+                dataset, data.shape, dtype=np.dtype(data.dtype._np_type), **kwargs
+            )
+        except Exception:
+            f.close()
+            raise
+        return dset.__setitem__, f.close
+
+    if jax.process_index() == 0:
+        _writer_save(data, prepare)
+    else:
+        _emit_slabs(data, None)
+        _io_barrier()
 
 
 def load_netcdf(
@@ -197,24 +275,7 @@ def save_netcdf(
         dimension_names = [f"dim_{i}" for i in range(data.ndim)]
     np_dtype = np.dtype(data.dtype._np_type)
 
-    def write_slabs(var):
-        if data.split is None:
-            var[...] = np.asarray(data.larray)
-        else:
-            # slab-at-a-time writes bound host memory by one shard
-            for r in range(data.comm.size):
-                _, _, slices = data.comm.chunk(data.shape, data.split, rank=r)
-                if any(s.stop <= s.start for s in slices):
-                    continue
-                var[slices] = np.asarray(data.larray[slices])
-
-    if nc is not None:
-        with nc.Dataset(path, mode) as f:
-            for name, length in zip(dimension_names, data.shape):
-                if name not in f.dimensions:
-                    f.createDimension(name, length)
-            write_slabs(f.createVariable(variable, np_dtype, tuple(dimension_names), **kwargs))
-    else:
+    if nc is None:
         if kwargs:
             raise TypeError(
                 f"NetCDF-3 (scipy backend) does not support createVariable "
@@ -230,11 +291,31 @@ def save_netcdf(
                 "cast to a signed int <= 32 bits or float32/float64, or "
                 "install netCDF4"
             )
-        with _scipy_nc(path, "w" if mode == "w" else "a") as f:
+
+    def prepare():
+        f = (
+            nc.Dataset(path, mode)
+            if nc is not None
+            else _scipy_nc(path, "w" if mode == "w" else "a")
+        )
+        try:
             for name, length in zip(dimension_names, data.shape):
                 if name not in f.dimensions:
                     f.createDimension(name, length)
-            write_slabs(f.createVariable(variable, np_dtype, tuple(dimension_names)))
+            if nc is not None:
+                var = f.createVariable(variable, np_dtype, tuple(dimension_names), **kwargs)
+            else:
+                var = f.createVariable(variable, np_dtype, tuple(dimension_names))
+        except Exception:
+            f.close()
+            raise
+        return var.__setitem__, f.close
+
+    if jax.process_index() == 0:
+        _writer_save(data, prepare)
+    else:
+        _emit_slabs(data, None)
+        _io_barrier()
 
 
 def load_csv(
@@ -290,9 +371,22 @@ def save_csv(
     versions; provided for round-trip completeness)."""
     if data.ndim > 2:
         raise ValueError("save_csv supports 1-D and 2-D arrays")
-    arr = np.asarray(data.larray)
+    # the allgather is a collective every process joins BEFORE the
+    # writer-only (fallible) file write, so a write error cannot desync it
+    if jax.process_count() > 1 and data.split is not None:
+        from jax.experimental import multihost_utils
+
+        arr = np.asarray(multihost_utils.process_allgather(data.larray, tiled=True))
+    else:
+        arr = np.asarray(data.larray)
     fmt = f"%.{decimals}f" if decimals >= 0 else "%s"
-    np.savetxt(path, arr, delimiter=sep, header=header_lines or "", fmt=fmt, encoding=encoding)
+    try:
+        if jax.process_index() == 0:
+            np.savetxt(
+                path, arr, delimiter=sep, header=header_lines or "", fmt=fmt, encoding=encoding
+            )
+    finally:
+        _io_barrier()
 
 
 def load(path: str, *args, **kwargs) -> DNDarray:
