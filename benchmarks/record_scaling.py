@@ -1,0 +1,84 @@
+"""Record mesh-size scaling numbers for every benchmark suite.
+
+Runs each harness at --devices {1, 2, 4, 8} on the virtual CPU mesh and
+writes the parsed throughputs to ``benchmarks/scaling_cpu.json``.  These
+are DISTRIBUTION-MACHINERY numbers, not accelerator performance: the
+virtual devices share one host's cores, so the curves validate that the
+sharded code paths (GSPMD collectives, fused fits) hold up as the mesh
+grows — flat-or-better is a pass, linear speedup is not expected (the
+reference's scaling study, benchmarks/generate_jobscripts.py, runs on real
+node grids; the TPU analog of that is a real pod slice).
+
+Workload sizes are scaled down from the TPU headline configs so the whole
+sweep finishes in minutes on a laptop-class host.
+
+Run from the repo root:  python benchmarks/record_scaling.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import subprocess
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+SUITES = {
+    # suite -> (script, extra args, regex capturing the throughput, unit)
+    "kmeans": (
+        "benchmarks/kmeans/heat_tpu_bench.py",
+        ["--n", "100000", "--iterations", "20", "--trials", "2"],
+        r"→ ([\d.]+) iter/s",
+        "iter/s",
+    ),
+    "distance_matrix": (
+        "benchmarks/distance_matrix/heat_tpu_bench.py",
+        ["--n", "4000", "--trials", "2"],
+        r"→ ([\d.]+) GB/s",
+        "GB/s",
+    ),
+    "lasso": (
+        "benchmarks/lasso/heat_tpu_bench.py",
+        ["--n", "100000", "--iterations", "50", "--trials", "2"],
+        r"→ ([\d.]+) sweeps/s",
+        "sweeps/s",
+    ),
+    "statistical_moments": (
+        "benchmarks/statistical_moments/heat_tpu_bench.py",
+        ["--n", "2000000", "--trials", "2"],
+        r"→ ([\d.]+) GB/s",
+        "GB/s",
+    ),
+}
+
+MESHES = [1, 2, 4, 8]
+
+
+def main() -> None:
+    results = {}
+    for suite, (script, extra, pattern, unit) in SUITES.items():
+        results[suite] = {"unit": unit, "config": " ".join(extra), "by_devices": {}}
+        for n in MESHES:
+            cmd = [sys.executable, script, "--devices", str(n), *extra]
+            out = subprocess.run(
+                cmd, cwd=ROOT, capture_output=True, text=True, timeout=1200
+            )
+            m = re.search(pattern, out.stdout)
+            if out.returncode != 0 or not m:
+                raise RuntimeError(
+                    f"{suite} --devices {n} failed:\n{out.stdout}\n{out.stderr[-2000:]}"
+                )
+            value = float(m.group(1))
+            results[suite]["by_devices"][str(n)] = value
+            print(f"{suite:>20} devices={n}: {value} {unit}", flush=True)
+    path = os.path.join(ROOT, "benchmarks", "scaling_cpu.json")
+    with open(path, "w") as f:
+        json.dump(results, f, indent=2, sort_keys=True)
+        f.write("\n")
+    print("wrote", path)
+
+
+if __name__ == "__main__":
+    main()
