@@ -26,7 +26,18 @@ if _os.environ.get("HEAT_TPU_DISABLE_X64", "0") != "1":
     # backend-free so jax.distributed.initialize()/ht.init_multihost()
     # can run after `import heat_tpu` (jax requires distributed init
     # before any backend touch).
-    if _ilu.find_spec("axon") is not None:
+    def _axon_present() -> bool:
+        # the plugin may ship as a top-level module or via the standard
+        # jax_plugins entry-point namespace — probe both
+        for name in ("axon", "jax_plugins.axon"):
+            try:
+                if _ilu.find_spec(name) is not None:
+                    return True
+            except (ImportError, ModuleNotFoundError, ValueError):
+                continue
+        return False
+
+    if _axon_present():
         try:
             _jax.devices()
         except RuntimeError:

@@ -272,6 +272,13 @@ def median(x: DNDarray, axis=None, keepdim=None, out=None, keepdims=None):
     """Median = 50th percentile (reference statistics.py:845-877 —
     signature there is ``median(x, axis, keepdim)``, so ``keepdim`` keeps
     the third positional slot)."""
+    if isinstance(keepdim, DNDarray):
+        # a numpy-style positional caller passing an output buffer third
+        # would silently get keepdim truthiness — fail loudly instead
+        raise TypeError(
+            "median()'s third positional parameter is keepdim (reference "
+            "signature); pass the output buffer as out=..."
+        )
     keepdims = merge_keepdims(keepdims, keepdim)
     return percentile(x, 50.0, axis=axis, out=out, keepdims=keepdims)
 

@@ -308,10 +308,16 @@ def test_import_is_backend_free():
         "sys.path = [p for p in sys.path if 'axon' not in p]\n"
         "import heat_tpu\n"
         "import jax._src.xla_bridge as xb\n"
-        "assert not xb._backends, f'backends initialized at import: {list(xb._backends)}'\n"
-        "print('BACKEND_FREE_OK')\n"
+        "backends = getattr(xb, '_backends', None)\n"
+        "if backends is None:\n"  # jax internals moved — signal a skip, not a failure
+        "    print('BACKEND_ATTR_GONE')\n"
+        "else:\n"
+        "    assert not backends, f'backends initialized at import: {list(backends)}'\n"
+        "    print('BACKEND_FREE_OK')\n"
     )
     res = run_in_fresh_python(script, drop_env=("PYTHONPATH",))  # drop the axon site dir
+    if "BACKEND_ATTR_GONE" in res.stdout:
+        pytest.skip("jax._src.xla_bridge._backends no longer exists")
     assert "BACKEND_FREE_OK" in res.stdout, res.stdout + res.stderr
 
 
