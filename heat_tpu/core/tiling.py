@@ -73,7 +73,12 @@ class SplitTiles:
         return owners
 
     def tile_slices(self, pos: Tuple[int, ...]) -> Tuple[slice, ...]:
-        """Global-coordinate slices of the tile at grid position ``pos``."""
+        """Global-coordinate slices of the tile at grid position ``pos``
+        (partial keys select position 0 on the omitted trailing dims, like
+        ``__getitem__``)."""
+        if isinstance(pos, int):
+            pos = (pos,)
+        pos = tuple(pos) + (0,) * (len(self.__tile_ends) - len(pos))
         slices = []
         for dim, p in enumerate(pos):
             ends = self.__tile_ends[dim]
@@ -84,10 +89,34 @@ class SplitTiles:
     def __getitem__(self, key):
         """The tile's data (a jax array view) at grid position ``key``
         (reference tiling.py:160-302)."""
-        if isinstance(key, int):
-            key = (key,)
-        pos = list(key) + [0] * (len(self.__tile_ends) - len(key))
-        return self.__arr.larray[self.tile_slices(tuple(pos))]
+        return self.__arr.larray[self.tile_slices(key)]
+
+    def __setitem__(self, key, value):
+        """Overwrite the tile at grid position ``key`` (reference
+        tiling.py:271-302 — there a local torch slice assignment on the
+        owning rank; here one functional ``.at[].set`` on the global
+        array, which XLA keeps shard-local when the slice is)."""
+        self.__arr.larray = self.__arr.larray.at[self.tile_slices(key)].set(value)
+
+    @property
+    def lshape_map(self) -> np.ndarray:
+        """Shard-shape table of the tiled array (reference tiling.py:127)."""
+        return self.__arr.lshape_map
+
+    @property
+    def tile_dimensions(self) -> List[np.ndarray]:
+        """Width of every tile along every dimension
+        (reference tiling.py:156-159)."""
+        dims = []
+        for ends in self.__tile_ends:
+            starts = np.concatenate([[0], ends[:-1]])
+            dims.append(ends - starts)
+        return dims
+
+    def get_tile_size(self, pos: Tuple[int, ...]) -> Tuple[int, ...]:
+        """Shape of the tile at grid position ``pos``
+        (reference tiling.py:264-270)."""
+        return tuple(s.stop - s.start for s in self.tile_slices(pos))
 
 
 class SquareDiagTiles:
@@ -157,6 +186,148 @@ class SquareDiagTiles:
         rs, re, cs, ce = self.get_start_stop(key)
         return self.__arr.larray[rs:re, cs:ce]
 
+    def __setitem__(self, key, value) -> None:
+        """Overwrite tile ``(row, col)`` (reference tiling.py:1215-1258 —
+        an owning-rank torch slice write; here one functional ``.at[].set``
+        on the global array)."""
+        rs, re, cs, ce = self.get_start_stop(key)
+        self.__arr.larray = self.__arr.larray.at[rs:re, cs:ce].set(value)
+
     def local_get(self, key):
-        """Alias of ``__getitem__`` (reference tiling.py:933-955)."""
+        """Alias of ``__getitem__`` (reference tiling.py:933-955; local and
+        global coordinates coincide in the single-controller model)."""
         return self[key]
+
+    def local_set(self, key, value) -> None:
+        """Alias of ``__setitem__`` (reference tiling.py:957-1018)."""
+        self[key] = value
+
+    @property
+    def lshape_map(self) -> np.ndarray:
+        """Shard-shape table of the tiled array (reference tiling.py:701)."""
+        return self.__arr.lshape_map
+
+    @property
+    def tile_rows(self) -> int:
+        """Number of tile rows (reference tiling.py:791-799)."""
+        return len(self.__row_ends)
+
+    @property
+    def tile_columns(self) -> int:
+        """Number of tile columns (reference tiling.py:731-739)."""
+        return len(self.__col_ends)
+
+    def __per_position(self, ends: List[int], axis: int) -> List[int]:
+        """Tiles along ``axis`` held by each mesh position: the full grid
+        when ``axis`` is not the split axis (only the split axis is
+        distributed), else the tiles overlapping the position's shard."""
+        comm, shape, split = self.__arr.comm, self.__arr.shape, self.__arr.split
+        if split is None or split != axis:
+            return [len(ends)] * comm.size
+        counts = []
+        for r in range(comm.size):
+            off, lshape, _ = comm.chunk(shape, axis, rank=r)
+            lo, hi = off, off + lshape[axis]
+            starts = [0] + list(ends[:-1])
+            counts.append(
+                sum(1 for s, e in zip(starts, ends) if s < hi and e > lo)
+            )
+        return counts
+
+    @property
+    def tile_rows_per_process(self) -> List[int]:
+        """Tile rows overlapping each mesh position's shard
+        (reference tiling.py:801-809: tile rows *on* each rank; with the
+        canonical layout a tile may straddle two positions — it is then
+        counted for both)."""
+        return self.__per_position(self.__row_ends, 0)
+
+    @property
+    def tile_columns_per_process(self) -> List[int]:
+        """Tile columns overlapping each mesh position's shard
+        (reference tiling.py:741-749)."""
+        return self.__per_position(self.__col_ends, 1)
+
+    @property
+    def last_diagonal_process(self) -> int:
+        """Mesh position owning the end of the global diagonal
+        (reference tiling.py:711-719)."""
+        arr = self.__arr
+        split = arr.split if arr.split is not None else 0
+        k = min(arr.shape[0], arr.shape[1])
+        _, lshape, _ = arr.comm.chunk(arr.shape, split, rank=0)
+        width = max(lshape[split], 1)
+        return min((k - 1) // width, arr.comm.size - 1) if k else 0
+
+    @property
+    def tile_map(self) -> np.ndarray:
+        """(tile_rows, tile_columns, 3) table of [row_start, col_start,
+        owner position] per tile (reference tiling.py:751-789; ownership
+        follows the split axis of the canonical layout)."""
+        arr = self.__arr
+        rows, cols = self.row_indices, self.col_indices
+        out = np.zeros((len(rows), len(cols), 3), dtype=np.int64)
+        split = arr.split if arr.split is not None else 0
+        _, lshape, _ = arr.comm.chunk(arr.shape, split, rank=0)
+        width = max(lshape[split], 1)
+        for i, rstart in enumerate(rows):
+            for j, cstart in enumerate(cols):
+                start = rstart if split == 0 else cstart
+                owner = min(start // width, arr.comm.size - 1)
+                out[i, j] = (rstart, cstart, owner)
+        return out
+
+    def __owned_tiles(self, rank: int, axis: int) -> List[int]:
+        """Global tile indices along ``axis`` OWNED by ``rank`` (ownership
+        = the position holding a tile's start row/column, exactly the rule
+        ``tile_map`` uses — unlike the per-process overlap tables, it
+        assigns each tile to one position, so prefix offsets stay exact
+        even when a tile straddles shard boundaries)."""
+        arr = self.__arr
+        starts = self.row_indices if axis == 0 else self.col_indices
+        split = arr.split if arr.split is not None else 0
+        if split != axis:
+            return list(range(len(starts)))
+        _, lshape, _ = arr.comm.chunk(arr.shape, split, rank=0)
+        width = max(lshape[split], 1)
+        return [
+            i for i, s in enumerate(starts)
+            if min(s // width, arr.comm.size - 1) == rank
+        ]
+
+    def local_to_global(self, key: Tuple[int, int], rank: int) -> Tuple[int, int]:
+        """Map a process-local tile key to the global tile grid
+        (reference tiling.py:1020-1082): the local index counts the tiles
+        ``rank`` owns (``tile_map`` ownership) along the split axis."""
+        r, c = key
+        arr = self.__arr
+        if arr.split == 0 or arr.split is None:
+            owned = self.__owned_tiles(rank, 0)
+            if r >= len(owned):
+                raise IndexError(f"rank {rank} owns {len(owned)} tile rows, got index {r}")
+            return int(owned[r]), int(c)
+        owned = self.__owned_tiles(rank, 1)
+        if c >= len(owned):
+            raise IndexError(f"rank {rank} owns {len(owned)} tile columns, got index {c}")
+        return int(r), int(owned[c])
+
+    def match_tiles(self, tiles_to_match: "SquareDiagTiles") -> None:
+        """Align this grid's tile boundaries with another array's grid
+        (reference tiling.py:1084-1213, used there to keep Q's tiles
+        composable with R's during the tiled QR).  The boundary lists are
+        adopted from ``tiles_to_match`` clipped to this array's shape,
+        with the final tile absorbing any overhang — the reference's
+        redistribution step is unnecessary here because the canonical
+        GSPMD layout never moves."""
+        if not isinstance(tiles_to_match, SquareDiagTiles):
+            raise TypeError(
+                f"tiles_to_match must be SquareDiagTiles, got {type(tiles_to_match)}"
+            )
+        m, n = self.__arr.shape
+
+        def adopt(ends: List[int], limit: int) -> List[int]:
+            clipped = [int(e) for e in ends if e < limit]
+            return clipped + [limit]
+
+        self.__row_ends = adopt(tiles_to_match._SquareDiagTiles__row_ends, m)
+        self.__col_ends = adopt(tiles_to_match._SquareDiagTiles__col_ends, n)

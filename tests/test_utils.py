@@ -45,6 +45,19 @@ def test_split_tiles():
     assert first.shape == lshape
 
 
+def test_split_tiles_set_and_dims():
+    x = ht.arange(24, dtype=ht.float32, split=0).reshape((12, 2))
+    tiles = ht.core.tiling.SplitTiles(x)
+    dims = tiles.tile_dimensions
+    assert int(np.sum(dims[0])) == 12 and int(np.sum(dims[1])) == 2
+    assert tiles.get_tile_size((0, 0)) == tuple(np.asarray(tiles[0]).shape)
+    # partial keys pad with zeros exactly like __getitem__
+    assert tiles.get_tile_size((0,)) == tuple(np.asarray(tiles[0]).shape)
+    assert tiles.lshape_map.shape[0] == x.comm.size
+    tiles[(0, 0)] = 99.0
+    assert np.all(np.asarray(tiles[(0, 0)]) == 99.0)
+
+
 def test_square_diag_tiles():
     x = ht.arange(48, dtype=ht.float32, split=0).reshape((8, 6))
     tiles = ht.core.tiling.SquareDiagTiles(x, tiles_per_proc=1)
@@ -54,6 +67,51 @@ def test_square_diag_tiles():
     np.testing.assert_array_equal(t00, x.numpy()[rs:re, cs:ce])
     with pytest.raises(ValueError):
         ht.core.tiling.SquareDiagTiles(ht.ones(4))
+
+
+def test_square_diag_tiles_full_api():
+    """The reference SquareDiagTiles surface (tiling.py:680-1258): counts,
+    per-process tables, tile_map ownership, set/get, match_tiles."""
+    x = ht.arange(48, dtype=ht.float32, split=0).reshape((8, 6))
+    tiles = ht.core.tiling.SquareDiagTiles(x, tiles_per_proc=1)
+    assert tiles.tile_rows == len(tiles.row_indices)
+    assert tiles.tile_columns == len(tiles.col_indices)
+    rpp = tiles.tile_rows_per_process
+    cpp = tiles.tile_columns_per_process
+    assert len(rpp) == x.comm.size and len(cpp) == x.comm.size
+    assert all(c >= 1 for c in cpp)  # columns are unsplit -> all overlap
+    tm = tiles.tile_map
+    assert tm.shape == (tiles.tile_rows, tiles.tile_columns, 3)
+    np.testing.assert_array_equal(tm[:, 0, 0], tiles.row_indices)
+    np.testing.assert_array_equal(tm[0, :, 1], tiles.col_indices)
+    assert 0 <= tiles.last_diagonal_process < x.comm.size
+    # owner of the first tile is position 0
+    assert tm[0, 0, 2] == 0
+    # local/global key mapping: ownership-based (tile_map rule), exact even
+    # for tiles that straddle shard boundaries
+    assert tiles.local_to_global((0, 0), 0) == (0, 0)
+    for i in range(tiles.tile_rows):
+        owner = int(tiles.tile_map[i, 0, 2])
+        owned_before = sum(
+            1 for j in range(i) if int(tiles.tile_map[j, 0, 2]) == owner
+        )
+        gi, _ = tiles.local_to_global((owned_before, 0), owner)
+        assert gi == i
+        tiles.get_start_stop((gi, 0))  # must be in range
+    with pytest.raises(IndexError):
+        tiles.local_to_global((tiles.tile_rows, 0), 0)
+    # functional tile write
+    tiles.local_set((0, 0), 7.0)
+    assert np.all(np.asarray(tiles.local_get((0, 0))) == 7.0)
+    # match a second array's grid: boundaries become compatible
+    y = ht.arange(60, dtype=ht.float32, split=0).reshape((10, 6))
+    other = ht.core.tiling.SquareDiagTiles(y, tiles_per_proc=2)
+    tiles.match_tiles(other)
+    assert tiles.row_indices[0] == 0
+    rs, re, cs, ce = tiles.get_start_stop((tiles.tile_rows - 1, tiles.tile_columns - 1))
+    assert re == 8 and ce == 6  # final tiles absorb the overhang
+    with pytest.raises(TypeError):
+        tiles.match_tiles(42)
 
 
 def test_printing():
