@@ -291,17 +291,40 @@ def rot90(m: DNDarray, k: int = 1, axes=(0, 1)) -> DNDarray:
 def sort(a: DNDarray, axis: int = -1, descending: bool = False, out=None):
     """Sort along an axis, returning (values, original indices)
     (reference manipulations.py:1893-2160 — a distributed sample-sort with
-    pivot Gatherv/Bcast and Alltoallv of values+indices; XLA's sort handles
-    the cross-shard exchange here)."""
+    pivot Gatherv/Bcast and Alltoallv of values+indices).
+
+    When the sorted axis IS the split axis of a 1-D array on a multi-device
+    mesh, the explicit distributed rank sort runs
+    (:func:`heat_tpu.parallel.ring_rank_sort`: parallel local sorts + a
+    ppermute ring of rank counts + one scatter) — the re-design of the
+    reference's sample-sort.  Everywhere else the sorted axis is local to
+    each shard (or the mesh is trivial) and ``jnp`` argsort suffices."""
     sanitize_in(a)
     axis = sanitize_axis(a.shape, axis)
     if axis is None:
         axis = a.ndim - 1
     arr = a.larray
-    indices = jnp.argsort(-arr if descending else arr, axis=axis, stable=True)
-    values = jnp.take_along_axis(arr, indices, axis=axis)
-    vals = _rewrap(a, values, a.split, a.dtype)
-    idx = _rewrap(a, indices.astype(jnp.int32), a.split, types.int32)
+    from ..parallel import sort as _parallel_sort  # lazy: parallel imports core
+
+    if a.ndim == 1 and a.split == 0 and _parallel_sort.supports(arr.dtype, a.shape[0], a.comm):
+        values, indices = _parallel_sort.ring_rank_sort(
+            arr, a.shape[0], comm=a.comm, descending=descending
+        )
+        vals = _rewrap(a, values.astype(arr.dtype), a.split, a.dtype)
+        idx = _rewrap(a, indices, a.split, types.int32)
+    else:
+        if descending:
+            # order-inverting key with ties still by ascending index:
+            # -x for floats (NaN stays NaN → still last); bitwise/logical
+            # NOT for ints and bool (negation overflows INT_MIN and wraps
+            # unsigned — ~x inverts order exactly with no overflow)
+            key = -arr if jnp.issubdtype(arr.dtype, jnp.floating) else ~arr
+        else:
+            key = arr
+        indices = jnp.argsort(key, axis=axis, stable=True)
+        values = jnp.take_along_axis(arr, indices, axis=axis)
+        vals = _rewrap(a, values, a.split, a.dtype)
+        idx = _rewrap(a, indices.astype(jnp.int32), a.split, types.int32)
     if out is not None:
         out.larray = vals.larray
         return out, idx
@@ -424,19 +447,33 @@ def vstack(tup) -> DNDarray:
     return row_stack(list(tup))
 
 
-def _unique_mask_1d(flat):
+def _unique_mask_1d(flat, comm=None):
     """Sorted order, first-occurrence mask, and group ids of a flat array —
     the static-shape half of unique (everything except the data-dependent
     output length).  NaNs collapse to one representative (numpy's
-    ``equal_nan=True`` default)."""
-    order = jnp.argsort(flat, stable=True)
-    s = flat[order]
+    ``equal_nan=True`` default).  On a multi-device mesh with an orderable
+    dtype the sort itself is the distributed ring rank sort
+    (:func:`heat_tpu.parallel.ring_rank_sort`)."""
+    from ..parallel import sort as _parallel_sort  # lazy: parallel imports core
+
+    if comm is not None and _parallel_sort.supports(flat.dtype, flat.shape[0], comm):
+        s, order = _parallel_sort.ring_rank_sort(flat, flat.shape[0], comm=comm)
+    else:
+        order = jnp.argsort(flat, stable=True)
+        s = flat[order]
     prev = jnp.roll(s, 1)
     neq = s != prev
     if jnp.issubdtype(s.dtype, jnp.floating):
         neq = neq & ~(jnp.isnan(s) & jnp.isnan(prev))
     mask = neq.at[0].set(True) if s.shape[0] else neq
-    groups = jnp.cumsum(mask) - 1
+    if comm is not None and comm.size > 1 and s.shape[0]:
+        # cumsum along a sharded axis is a pathological GSPMD scan — use
+        # the explicit two-level prefix sum (local cumsum + shard offsets)
+        from ..parallel import prefix_sum
+
+        groups = prefix_sum(mask.astype(jnp.int32), comm=comm) - 1
+    else:
+        groups = jnp.cumsum(mask) - 1
     return order, s, mask, groups
 
 
@@ -468,7 +505,7 @@ def unique(a: DNDarray, sorted: bool = False, return_inverse: bool = False, axis
         return _unique_axis(a, axis, return_inverse)
 
     flat = jnp.ravel(a.larray)
-    order, s, mask, groups = _unique_mask_1d(flat)
+    order, s, mask, groups = _unique_mask_1d(flat, comm=a.comm if a.split is not None else None)
     n_unique = int(jnp.sum(mask))  # the single scalar host sync
     uniques = _compact(s, mask, groups, n_unique)
     split = 0 if a.split is not None else None

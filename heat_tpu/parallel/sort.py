@@ -1,0 +1,254 @@
+"""Distributed stable sort over the mesh — the TPU re-design of the
+reference's sample-sort.
+
+Reference: heat/core/manipulations.py:1893-2160 — a distributed
+sample-sort: per-rank local sort, pivot selection via Gatherv+Bcast,
+Alltoallv of value/index buckets, and a final local merge, with ragged
+receive counts throughout.
+
+TPU formulation (**rank sort over a ppermute ring**): instead of moving
+data into pivot-defined buckets (whose sizes are data-dependent — hostile
+to XLA's static shapes), each element's exact global rank is computed and
+the data is scattered once:
+
+1.  Values map onto one (32-bit dtypes) or two (64-bit dtypes) uint32
+    *order words* (an order-preserving unsigned encoding; NaN forced
+    above every number, canonical padding rows above everything).  The
+    total order is (words…, real-before-pad, shard, local position) —
+    the last three resolve word ties exactly, giving numpy's stable
+    semantics (equal values by ascending global index, because shard
+    index ranges are disjoint and ordered).
+2.  Each shard stable-sorts its words locally (parallel local sorts).
+3.  p-1 ``ppermute`` ring rounds: each shard counts, per element, how
+    many visiting elements precede it in the total order —
+    ``searchsorted`` on the primary word, a vectorized per-query bisect
+    on the secondary word's equal-range, and a pad-prefix lookup.
+    Own-run positions seed the count.  The sum IS the exact global rank —
+    ranks are a permutation, so no collision handling is ever needed.
+4.  Two drop-mode global scatters (values by rank, original indices by
+    rank); XLA plans the cross-shard exchange.  Padding rows rank past
+    the true length and drop out.
+
+Every shape in the program is static, and values travel verbatim (NaN
+payloads and signed zeros survive).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..core.communication import XlaCommunication, get_comm
+
+__all__ = ["ring_rank_sort", "supports", "ORDERABLE_32BIT", "ORDERABLE_64BIT"]
+
+#: dtypes representable in one 32-bit order word
+ORDERABLE_32BIT = frozenset(
+    {"float32", "bfloat16", "float16", "int32", "int16", "int8",
+     "uint32", "uint16", "uint8", "bool"}
+)
+#: dtypes needing the (hi, lo) two-word encoding (only with jax x64 on)
+ORDERABLE_64BIT = frozenset({"float64", "int64", "uint64"})
+
+_NAN_WORD = 0xFFFFFFFE  # above every number, below the padding word
+_PAD_WORD = 0xFFFFFFFF
+
+
+def supports(dtype, n: int, comm: XlaCommunication) -> bool:
+    """True when :func:`ring_rank_sort` applies: a multi-device mesh, an
+    order-word-encodable dtype, and int32-rankable length.  The ONE
+    eligibility predicate for callers (ht.sort / ht.unique) — keep their
+    dispatch and this module's preconditions from drifting apart."""
+    return (
+        comm.size > 1
+        and str(dtype) in ORDERABLE_32BIT | ORDERABLE_64BIT
+        and 0 < n < 2**31
+    )
+
+
+def _order_words(vals: jax.Array, descending: bool):
+    """Order-preserving map onto uint32 words ``(hi, lo)`` — ``lo`` is
+    None for 32-bit dtypes: value a sorts before b ⇔ words(a) < words(b)
+    lexicographically, with NaN greatest (numpy's sort-NaN-last rule,
+    kept for descending too — matching ``argsort(-x)``, where -NaN is
+    still NaN).
+
+    Floats use the classic sign-fold of the IEEE bit pattern; signed ints
+    flip the sign bit; unsigned/bool widen.  Word collisions with the NaN
+    or padding words are harmless for integer dtypes: the tie-break order
+    (real before pad, then shard, then position) stays a correct total
+    order — only floats need NaN remapped, and only NaNs land on
+    ``_NAN_WORD``."""
+    dt = vals.dtype
+    nan = None
+    if str(dt) in ORDERABLE_64BIT:
+        if jnp.issubdtype(dt, jnp.floating):
+            bits = vals.view(jnp.uint64)
+            bits = jnp.where(
+                bits >> jnp.uint64(63), ~bits, bits | jnp.uint64(1 << 63)
+            )
+            nan = jnp.isnan(vals)
+        elif jnp.issubdtype(dt, jnp.unsignedinteger):
+            bits = vals
+        else:
+            bits = vals.view(jnp.uint64) ^ jnp.uint64(1 << 63)
+        hi = (bits >> jnp.uint64(32)).astype(jnp.uint32)
+        lo = (bits & jnp.uint64(0xFFFFFFFF)).astype(jnp.uint32)
+        if descending:
+            hi, lo = ~hi, ~lo
+        if nan is not None:
+            hi = jnp.where(nan, jnp.uint32(_NAN_WORD), hi)
+            lo = jnp.where(nan, jnp.uint32(0), lo)
+        return hi, lo
+    if dt == jnp.bool_ or jnp.issubdtype(dt, jnp.unsignedinteger):
+        u = vals.astype(jnp.uint32)
+    elif jnp.issubdtype(dt, jnp.integer):
+        u = vals.astype(jnp.int32).view(jnp.uint32) ^ jnp.uint32(0x80000000)
+    else:
+        f = vals.astype(jnp.float32)
+        bits = f.view(jnp.uint32)
+        u = jnp.where(bits >> 31, ~bits, bits | jnp.uint32(0x80000000))
+        nan = jnp.isnan(f)
+    if descending:
+        u = ~u
+    if nan is not None:
+        u = jnp.where(nan, jnp.uint32(_NAN_WORD), u)
+    return u, None
+
+
+def _bisect(arr: jax.Array, lo_b: jax.Array, hi_b: jax.Array, q: jax.Array, right: bool):
+    """Vectorized per-query binary search of ``q[i]`` within the sorted
+    subrange ``arr[lo_b[i]:hi_b[i])`` (the two-word ring round needs a
+    DIFFERENT subrange per query — the primary word's equal-range — which
+    plain ``searchsorted`` cannot express)."""
+    steps = int(np.ceil(np.log2(max(int(arr.shape[0]), 2)))) + 1
+
+    def step(i, st):
+        lo, hi = st
+        mid = jnp.clip((lo + hi) // 2, 0, arr.shape[0] - 1)
+        v = arr[mid]
+        go_right = (v <= q) if right else (v < q)
+        active = lo < hi
+        return (
+            jnp.where(active & go_right, mid + 1, lo),
+            jnp.where(active & ~go_right, mid, hi),
+        )
+
+    lo, _ = jax.lax.fori_loop(0, steps, step, (lo_b, hi_b))
+    return lo
+
+
+def ring_rank_sort(
+    arr: jax.Array,
+    n: int,
+    comm: Optional[XlaCommunication] = None,
+    descending: bool = False,
+) -> Tuple[jax.Array, jax.Array]:
+    """Stable distributed sort of a 1-D array of true length ``n``
+    (``arr`` may be canonically padded past it).  Returns
+    ``(sorted_values, original_indices)``, each of length ``n`` and
+    sharded along axis 0.  Requires a dtype in :data:`ORDERABLE_32BIT` or
+    :data:`ORDERABLE_64BIT` and ``n < 2**31``.
+    """
+    comm = get_comm() if comm is None else comm
+    dt = arr.dtype
+    if str(dt) not in ORDERABLE_32BIT | ORDERABLE_64BIT:
+        raise TypeError(f"ring_rank_sort does not support dtype {dt}")
+    if n >= 2**31:
+        raise ValueError("axis too long for int32 ranks")
+    if arr.shape[0] % comm.size != 0:
+        arr = comm.pad_to_shards(arr, axis=0)
+    # one compiled program for the whole pipeline — an eager (per-phase)
+    # dispatch costs ~5x on the dev mesh (measured 4.9 s vs 1.0 s at 1M)
+    return _rrs(arr, n, comm, descending)
+
+
+@partial(jax.jit, static_argnames=("n", "comm", "descending"))
+def _rrs(arr, n: int, comm: XlaCommunication, descending: bool):
+    p = comm.size
+    dt = arr.dtype
+    w = arr.shape[0] // p
+    two_words = str(dt) in ORDERABLE_64BIT
+    mesh, name = comm.mesh, comm.axis_name
+    perm = [(i, (i + 1) % p) for i in range(p)]
+
+    def kernel(block):
+        s = jax.lax.axis_index(name)
+        j = jnp.arange(w, dtype=jnp.int32)
+        gidx = s.astype(jnp.int32) * jnp.int32(w) + j
+        is_pad = gidx >= jnp.int32(n)
+        hi, lo = _order_words(block, descending)
+        hi = jnp.where(is_pad, jnp.uint32(_PAD_WORD), hi)
+        if two_words:
+            lo = jnp.where(is_pad, jnp.uint32(_PAD_WORD), lo)
+            hi, lo, svals, sgidx, spad = jax.lax.sort(
+                (hi, lo, block, gidx, is_pad), num_keys=2, is_stable=True
+            )
+        else:
+            hi, svals, sgidx, spad = jax.lax.sort(
+                (hi, block, gidx, is_pad), num_keys=1, is_stable=True
+            )
+        # prefix counts of pad entries in the sorted run, for O(1) lookup
+        # of "#pads among the word-equal range [a, b)"
+        padp = jnp.concatenate(
+            [jnp.zeros((1,), jnp.int32), jnp.cumsum(spad.astype(jnp.int32))]
+        )
+        # own-run contribution: my position in my stable-sorted run (ties
+        # within a shard resolve by local position — exactly stable order)
+        ranks = jnp.arange(w, dtype=jnp.int32) + 0 * sgidx
+
+        def round_contrib(vis, ranks):
+            vis_hi, vis_lo, vis_padp, vis_shard = vis
+            a = jnp.searchsorted(vis_hi, hi, side="left").astype(jnp.int32)
+            b = jnp.searchsorted(vis_hi, hi, side="right").astype(jnp.int32)
+            if two_words:
+                # refine within the primary-word equal-range by the lo word
+                a2 = _bisect(vis_lo, a, b, lo, right=False).astype(jnp.int32)
+                b2 = _bisect(vis_lo, a, b, lo, right=True).astype(jnp.int32)
+                a, b = a2, b2
+            eq_pad = vis_padp[b] - vis_padp[a]
+            eq_real = (b - a) - eq_pad
+            earlier = vis_shard < s  # visiting shard precedes mine globally
+            # equal-word visitors that precede me in the total order
+            # (words…, real<pad, shard, position):
+            tie = jnp.where(
+                spad,
+                eq_real + jnp.where(earlier, eq_pad, 0),  # pads trail ALL reals
+                jnp.where(earlier, eq_real, 0),
+            )
+            return ranks + a + tie
+
+        def rotate(vis):
+            return tuple(jax.lax.ppermute(v, name, perm) for v in vis)
+
+        def body(r, carry):
+            vis, ranks = carry
+            ranks = round_contrib(vis, ranks)
+            return rotate(vis), ranks
+
+        own = (hi, lo if two_words else jnp.zeros((0,), jnp.uint32), padp, s)
+        (_, _, _, _), ranks = jax.lax.fori_loop(1, p, body, (rotate(own), ranks))
+        return svals, sgidx, ranks
+
+    svals, sgidx, ranks = jax.shard_map(
+        kernel,
+        mesh=mesh,
+        in_specs=comm.spec(1, 0),
+        out_specs=(comm.spec(1, 0), comm.spec(1, 0), comm.spec(1, 0)),
+    )(arr)
+
+    # two drop-mode scatters: XLA plans the cross-shard exchange; padding
+    # ranks land at [n, p*w) and fall away
+    out_v = jnp.zeros((n,), dt).at[ranks].set(svals, mode="drop")
+    out_i = jnp.zeros((n,), jnp.int32).at[ranks].set(sgidx, mode="drop")
+    # split=0 even when ragged — GSPMD handles uneven trailing shards; a
+    # replicated constraint here would all-gather the whole result
+    sh = comm.sharding(1, 0)
+    out_v = jax.lax.with_sharding_constraint(out_v, sh)
+    out_i = jax.lax.with_sharding_constraint(out_i, sh)
+    return out_v, out_i
