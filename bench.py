@@ -214,6 +214,37 @@ def medians_medoids_rates(X):
     return med_rate, medoid_rate
 
 
+def eager_ops_per_sec(X):
+    """Dispatch rate of the EAGER per-op API path: a chain of binary ops
+    through DNDarray arithmetic (each op = cached-jit lookup + dispatch +
+    wrapper bookkeeping).  The fused benchmarks above measure compiled
+    loops; this measures what a user's un-jitted op-by-op script pays
+    (VERDICT r1 flagged the eager path as never measured).  Slope over
+    chain lengths cancels the readback fence."""
+    import heat_tpu as ht
+
+    small = X[:1024]  # small shards: dispatch overhead dominates compute
+
+    def timed(n_ops):
+        t0 = time.perf_counter()
+        y = small
+        for i in range(n_ops // 2):
+            y = y + 1.0
+            y = y * 0.999
+        np.asarray(y.larray[0, 0])  # fence
+        return time.perf_counter() - t0
+
+    timed(20)  # warmup: compile the two kernels
+    lo, hi = 20, 220
+    diffs = []
+    for _ in range(5):
+        t_lo = timed(lo)
+        t_hi = timed(hi)
+        diffs.append(t_hi - t_lo)
+    diffs.sort()
+    return (hi - lo) / max(diffs[len(diffs) // 2], 1e-9)
+
+
 def qr_svd_ms():
     """Tall-skinny QR + SVD wall-clock (BASELINE config 5: resplit-heavy
     linalg on a tall-skinny split DNDarray).  Slope-timed like everything
@@ -280,6 +311,7 @@ def main():
     heat_rate, X = heat_kmeans_rate(data, centers)
     cdist_gbs, moments_gbs, global_sum_gbs = aux_metrics(data, X)
     med_rate, medoid_rate = medians_medoids_rates(X)
+    eager_rate = eager_ops_per_sec(X)
     lasso_sweeps = lasso_rate(data, X)
     qr_ms = qr_svd_ms()
     numpy_rate = numpy_kmeans_rate(data, centers)
@@ -299,6 +331,7 @@ def main():
                 "global_sum_gb_per_sec": round(global_sum_gbs, 2),
                 "kmedians_iter_per_sec": round(med_rate, 2),
                 "kmedoids_iter_per_sec": round(medoid_rate, 2),
+                "eager_ops_per_sec": round(eager_rate, 2),
                 "lasso_sweeps_per_sec": round(lasso_sweeps, 2),
                 "qr_svd_tall_skinny_ms": round(qr_ms, 2),
                 "config": f"n={N} f={F} k={K} iters={ITERS}",

@@ -266,13 +266,24 @@ def __cum_op(
     if dtype is not None:
         dtype = types.canonical_heat_type(dtype)
     cast = dtype.jax_type() if dtype is not None else None
-    fn = jitted(
-        ("cum", operation, axis, cast),
-        lambda: lambda a: (
-            lambda r: r.astype(cast) if cast is not None else r
-        )(operation(a, axis=axis)),
-    )
-    result = fn(x.larray)
+    scan_op = {jnp.cumsum: "sum", jnp.cumprod: "prod"}.get(operation)
+    if scan_op is not None and axis == x.split and x.comm.size > 1:
+        # cum-op ALONG the sharded axis: GSPMD's partitioned scan is
+        # pathological (sequential per element) — use the explicit
+        # two-level prefix scan (local cum-op + shard-offset all-gather)
+        from ..parallel import prefix_scan
+
+        result = prefix_scan(x.larray, scan_op, comm=x.comm, axis=axis)
+        if cast is not None:
+            result = result.astype(cast)
+    else:
+        fn = jitted(
+            ("cum", operation, axis, cast),
+            lambda: lambda a: (
+                lambda r: r.astype(cast) if cast is not None else r
+            )(operation(a, axis=axis)),
+        )
+        result = fn(x.larray)
     result = _canonical_result(result)
     out_dtype = types.canonical_heat_type(result.dtype)
     result = x.comm.apply_sharding(result, x.split)

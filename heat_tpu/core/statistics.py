@@ -305,7 +305,24 @@ def percentile(x: DNDarray, q, axis=None, out=None, interpolation: str = "linear
     arr = x.larray
     if types.heat_type_is_exact(x.dtype):
         arr = arr.astype(jnp.float64)
-    res = jnp.percentile(arr, qa, axis=axis, method=method, keepdims=keepdims)
+    from ..parallel import sort as _parallel_sort  # lazy: parallel imports core
+
+    if (
+        axis is None
+        and x.split is not None
+        and _parallel_sort.supports(x.larray.dtype, x.size, x.comm)
+    ):
+        # global percentile of a sharded array: jnp.percentile's internal
+        # sort is the pathological GSPMD global sort — rank-sort over the
+        # ring instead, then interpolate locally on the sorted output
+        svals, _ = _parallel_sort.ring_rank_sort(
+            jnp.ravel(x.larray), x.size, comm=x.comm
+        )
+        res = _interp_sorted(svals.astype(arr.dtype), qa, method)
+        if keepdims:
+            res = jnp.reshape(res, qa.shape + (1,) * x.ndim)
+    else:
+        res = jnp.percentile(arr, qa, axis=axis, method=method, keepdims=keepdims)
     if np.isscalar(q) or qa.ndim == 0:
         result = _wrap_reduced(x, res, axis, keepdims=keepdims)
     else:
@@ -319,6 +336,32 @@ def percentile(x: DNDarray, q, axis=None, out=None, interpolation: str = "linear
         out.larray = result.larray
         return out
     return result
+
+
+def _interp_sorted(svals, qa, method: str):
+    """numpy-method percentile lookup on an already-sorted 1-D array
+    (NaNs sorted last).  Propagates NaN like jnp.percentile: any NaN in
+    the data — visible as a NaN tail after the sort — poisons every
+    quantile."""
+    n = svals.shape[0]
+    pos = qa / 100.0 * (n - 1)
+    lo = jnp.clip(jnp.floor(pos).astype(jnp.int32), 0, n - 1)
+    hi = jnp.clip(jnp.ceil(pos).astype(jnp.int32), 0, n - 1)
+    vlo, vhi = svals[lo], svals[hi]
+    if method == "lower":
+        res = vlo
+    elif method == "higher":
+        res = vhi
+    elif method == "nearest":
+        res = jnp.where(pos - lo <= 0.5, vlo, vhi)
+    elif method == "midpoint":
+        res = (vlo + vhi) / 2.0
+    else:  # linear
+        frac = (pos - lo).astype(svals.dtype)
+        res = vlo * (1 - frac) + vhi * frac
+    if jnp.issubdtype(svals.dtype, jnp.floating):
+        res = jnp.where(jnp.isnan(svals[-1]), jnp.nan, res)
+    return res
 
 
 def _moment2(x, axis, ddof, kwargs, name, finalize):

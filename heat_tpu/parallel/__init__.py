@@ -24,7 +24,14 @@ with :func:`jax.lax.ppermute` / sharding-transformations doing the
 communication over ICI.
 """
 
-from .primitives import all_to_all_resplit, halo_exchange, prefix_sum, ring_map, ring_source
+from .primitives import (
+    all_to_all_resplit,
+    halo_exchange,
+    prefix_scan,
+    prefix_sum,
+    ring_map,
+    ring_source,
+)
 from .ring_attention import ring_attention, ring_self_attention
 from .sort import ring_rank_sort
 from .ulysses import ulysses_attention
@@ -32,6 +39,7 @@ from .ulysses import ulysses_attention
 __all__ = [
     "all_to_all_resplit",
     "halo_exchange",
+    "prefix_scan",
     "prefix_sum",
     "ring_map",
     "ring_source",

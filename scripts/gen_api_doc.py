@@ -22,10 +22,25 @@ import heat_tpu as ht
 
 
 def first_line(obj):
+    """First sentence of the first docstring paragraph (wrapped first
+    sentences span physical lines — splitting on the first newline used to
+    truncate them mid-phrase)."""
     d = inspect.getdoc(obj)
     if not d:
         return ""
-    line = d.split("\n")[0].strip()
+    para = d.split("\n\n")[0].replace("\n", " ").strip()
+    # first sentence = up to the first period followed by a space/end,
+    # but never inside parentheses (reference citations contain periods)
+    depth, end = 0, len(para)
+    for i, ch in enumerate(para):
+        if ch in "([":
+            depth += 1
+        elif ch in ")]":
+            depth = max(0, depth - 1)
+        elif ch == "." and depth == 0 and (i + 1 == len(para) or para[i + 1] == " "):
+            end = i + 1
+            break
+    line = para[:end].strip()
     return line if len(line) < 110 else line[:107] + "..."
 
 

@@ -367,3 +367,36 @@ def test_flipud_fliplr_3d():
     assert_array_equal(ht.flipud(X), np.flipud(a))
     assert_array_equal(ht.fliplr(X), np.fliplr(a))
     assert_array_equal(ht.flip(X, (0, 2)), np.flip(a, (0, 2)))
+
+
+@pytest.mark.parametrize("n", [16, 23, 1000])
+def test_cum_ops_along_split_axis(n):
+    """cumsum/cumprod along the SHARDED axis route through the explicit
+    two-level prefix scan (parallel.prefix_scan) — GSPMD's partitioned
+    cumsum is pathological."""
+    v = RNG.integers(1, 3, n).astype(np.int32)
+    assert_array_equal(ht.cumsum(ht.array(v, split=0), 0), np.cumsum(v))
+    f = RNG.uniform(0.9, 1.1, n).astype(np.float32)
+    assert_array_equal(ht.cumprod(ht.array(f, split=0), 0), np.cumprod(f), rtol=2e-4)
+    m = RNG.normal(size=(n, 3)).astype(np.float32)
+    assert_array_equal(ht.cumsum(ht.array(m, split=0), 0), np.cumsum(m, axis=0),
+                       rtol=1e-4, atol=1e-5)
+
+
+@pytest.mark.parametrize("q", [50.0, 12.5, [10.0, 50.0, 99.0]])
+@pytest.mark.parametrize("method", ["linear", "lower", "higher", "midpoint"])
+def test_percentile_distributed_path(q, method):
+    """Global percentile of a sharded array runs sorted-lookup on the ring
+    rank sort; values must match numpy for every method, with NaN
+    poisoning preserved."""
+    v = RNG.normal(size=10_007).astype(np.float32)
+    X = ht.array(v, split=0)
+    got = np.asarray(ht.percentile(X, q, interpolation=method).resplit(None).larray)
+    exp = np.percentile(v.astype(np.float64), q, method=method)
+    np.testing.assert_allclose(got, exp, rtol=1e-5, atol=1e-6)
+
+
+def test_percentile_distributed_nan_poisons():
+    v = RNG.normal(size=1000).astype(np.float32)
+    v[5] = np.nan
+    assert np.isnan(float(ht.percentile(ht.array(v, split=0), 50.0)))
