@@ -353,7 +353,10 @@ def _interp_sorted(svals, qa, method: str):
     elif method == "higher":
         res = vhi
     elif method == "nearest":
-        res = jnp.where(pos - lo <= 0.5, vlo, vhi)
+        # numpy rounds half to even — jnp.round matches; a plain 0.5
+        # threshold picks a different element at exact half positions
+        idx = jnp.clip(jnp.round(pos).astype(jnp.int32), 0, n - 1)
+        res = svals[idx]
     elif method == "midpoint":
         res = (vlo + vhi) / 2.0
     else:  # linear

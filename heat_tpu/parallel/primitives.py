@@ -172,10 +172,10 @@ def halo_exchange(
     return prev, nxt
 
 
-#: op name -> (local cumulative fn, identity, combine)
+#: op name -> (local cumulative fn, identity, axis reduction)
 _SCAN_OPS = {
-    "sum": (jnp.cumsum, 0, jnp.add),
-    "prod": (jnp.cumprod, 1, jnp.multiply),
+    "sum": (jnp.cumsum, 0, jnp.sum),
+    "prod": (jnp.cumprod, 1, jnp.prod),
 }
 
 
@@ -212,7 +212,7 @@ def prefix_scan(
 
 @partial(jax.jit, static_argnames=("op", "comm", "axis"))
 def _prefix_scan_jit(arr, op: str, comm: XlaCommunication, axis: int):
-    cum, ident, combine = _SCAN_OPS[op]
+    cum, ident, reduce_fn = _SCAN_OPS[op]
     size = comm.size
     if axis != 0:
         arr = jnp.moveaxis(arr, axis, 0)
@@ -231,10 +231,10 @@ def _prefix_scan_jit(arr, op: str, comm: XlaCommunication, axis: int):
         s = jax.lax.axis_index(name)
         mask = (jnp.arange(size) < s).reshape((size,) + (1,) * (block.ndim - 1))
         offset = jnp.where(mask, totals, jnp.asarray(ident, totals.dtype))
-        acc = offset[0]  # fold the p masked totals with the op's combine
-        for i in range(1, size):
-            acc = combine(acc, offset[i])
-        return combine(local, acc.astype(local.dtype))
+        acc = reduce_fn(offset, axis=0)  # one vectorized fold of the p totals
+        if op == "sum":
+            return local + acc.astype(local.dtype)
+        return local * acc.astype(local.dtype)
 
     spec = comm.spec(arr.ndim, 0)
     out = jax.shard_map(kernel, mesh=mesh, in_specs=spec, out_specs=spec)(arr)

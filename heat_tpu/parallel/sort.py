@@ -67,10 +67,10 @@ def supports(dtype, n: int, comm: XlaCommunication) -> bool:
     return (
         comm.size > 1
         and str(dtype) in ORDERABLE_32BIT | ORDERABLE_64BIT
-        # the int32 index/rank arithmetic runs over the PADDED length
-        # p*ceil(n/p), which must not wrap
+        # the int32 index/rank arithmetic runs over the PADDED length,
+        # which must not wrap
         and 0 < n
-        and comm.size * comm.shard_width(n) <= 2**31 - 1
+        and comm.padded_size(n) <= 2**31 - 1
     )
 
 
@@ -162,7 +162,7 @@ def ring_rank_sort(
     dt = arr.dtype
     if str(dt) not in ORDERABLE_32BIT | ORDERABLE_64BIT:
         raise TypeError(f"ring_rank_sort does not support dtype {dt}")
-    if comm.size * comm.shard_width(n) > 2**31 - 1:
+    if comm.padded_size(n) > 2**31 - 1:
         raise ValueError("padded axis length exceeds int32 rank arithmetic")
     if arr.shape[0] % comm.size != 0:
         arr = comm.pad_to_shards(arr, axis=0)

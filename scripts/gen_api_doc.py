@@ -29,8 +29,10 @@ def first_line(obj):
     if not d:
         return ""
     para = d.split("\n\n")[0].replace("\n", " ").strip()
-    # first sentence = up to the first period followed by a space/end,
+    # first sentence = up to the first period followed by a space/end —
     # but never inside parentheses (reference citations contain periods)
+    # and never after an abbreviation like "e.g." / "i.e." / "vs."
+    abbrevs = ("e.g", "i.e", "vs", "etc", "cf", "incl")
     depth, end = 0, len(para)
     for i, ch in enumerate(para):
         if ch in "([":
@@ -38,6 +40,9 @@ def first_line(obj):
         elif ch in ")]":
             depth = max(0, depth - 1)
         elif ch == "." and depth == 0 and (i + 1 == len(para) or para[i + 1] == " "):
+            word = para[:i].rsplit(" ", 1)[-1]
+            if word.lower().rstrip(".") in abbrevs or word.lower() in abbrevs:
+                continue
             end = i + 1
             break
     line = para[:end].strip()

@@ -384,7 +384,7 @@ def test_cum_ops_along_split_axis(n):
 
 
 @pytest.mark.parametrize("q", [50.0, 12.5, [10.0, 50.0, 99.0]])
-@pytest.mark.parametrize("method", ["linear", "lower", "higher", "midpoint"])
+@pytest.mark.parametrize("method", ["linear", "lower", "higher", "midpoint", "nearest"])
 def test_percentile_distributed_path(q, method):
     """Global percentile of a sharded array runs sorted-lookup on the ring
     rank sort; values must match numpy for every method, with NaN
