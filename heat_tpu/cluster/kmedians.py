@@ -5,16 +5,20 @@ centroid update replaced by a per-cluster **median** (masked rows →
 ``balance_`` → distributed median, :43-86) and a random-restart failsafe
 for empty clusters (:67-80).
 
-TPU formulation: per-cluster medians are computed with a masked
-sort-free percentile over the global rows — cluster masks are applied with
-NaN sentinels so every cluster's median reduces without ragged per-cluster
-gathers — and the ENTIRE fit is one jitted ``lax.while_loop`` (the KMeans
-pattern, kmeans.py:61-102): one dispatch, zero per-epoch host syncs.
+TPU formulation: the data matrix never changes across Lloyd iterations, so
+each feature column is value-sorted ONCE; every iteration then finds all
+k·f exact medians by rank-space bisection whose rank counts are MXU
+matmuls over the cluster one-hot (:func:`_cluster_medians`) — no
+per-iteration sort, no O(n·f) gather, no scatter — and the ENTIRE fit is
+one jitted ``lax.while_loop`` (the KMeans pattern, kmeans.py:61-102): one
+dispatch, zero per-epoch host syncs.
 """
 
 from __future__ import annotations
 
 from typing import Optional, Union
+
+import numpy as np
 
 import jax
 import jax.numpy as jnp
@@ -27,19 +31,97 @@ from ._kcluster import _KCluster
 __all__ = ["KMedians"]
 
 
-def _masked_median(arr, labels, k):
-    """Median of each cluster's rows, per feature: (k, f).
+def _presort_values(arr):
+    """One-time (per fit) value sort of every feature column: (n, f) with
+    each column ascending.  A single-operand non-stable ``lax.sort`` —
+    measured 250x faster on TPU than the stable variant the original
+    ``argsort`` emitted — and the ONLY sort in the whole KMedians fit: the
+    per-iteration median never sorts, gathers big, or scatters."""
+    return jax.lax.sort(arr, dimension=0, is_stable=False)
 
-    Masked formulation: per cluster, replace non-members by NaN and take a
-    nanmedian over one (n, f) temporary — k small passes rather than a
-    single (k, n, f) broadcast, which at benchmark scale (n=500k) would
-    materialize hundreds of MB (replaces reference kmedians.py:43-66's
-    per-cluster gather + ht.median)."""
-    rows = []
-    for c in range(k):
-        member = (labels == c)[:, None]
-        rows.append(jnp.nanmedian(jnp.where(member, arr, jnp.nan), axis=0))
-    return jnp.stack(rows)
+
+def _cluster_medians(arr, svals, onehot, counts, k):
+    """Exact per-cluster per-feature medians, (k, f), by RANK-SPACE
+    BISECTION with matmul rank counts — zero per-iteration sorts and zero
+    O(n·f) gathers (TPU gathers of (n, f) indices measured ~13 ms at the
+    benchmark config; this routine's only gathers are (k, f, 2) threshold
+    probes).
+
+    The t-th smallest member of cluster c in feature j is found by binary
+    search over the pre-sorted column ``svals[:, j]``: the probe position
+    p maps to a value threshold, and the count of members with
+    ``x <= thr`` comes from two MXU matmuls —
+
+    * ``thr_row = onehot @ thr_table``: each row picks its own cluster's
+      threshold (exact: a one-hot dot selects a single f32 term), then
+    * ``cnt = onehot.T @ (x <= thr_row)`` with int8 operands and int32
+      accumulation (exact for any n < 2^31).
+
+    The search over positions is exact under duplicate values: it
+    converges to the smallest position p* with count(<= svals[p*]) >= t,
+    whose value IS the t-th member value.  Both middle members (numpy's
+    even-count average) run as a second stacked search.  NaN members sort
+    last and are never counted by ``x <= thr``, so a cluster whose median
+    position lands in its NaN tail returns the column maximum/NaN — the
+    sort-last semantics of the reference's gathered-member median
+    (reference kmedians.py:43-66).  Replaces the r2 per-cluster
+    ``nanmedian`` (k full sorts per step, BENCH_r02: 2,300x a KMeans
+    step)."""
+    n, f = arr.shape
+    steps = int(np.ceil(np.log2(max(n, 2)))) + 1
+    # 1-indexed member ranks of the two middles (equal when count is odd)
+    t = jnp.maximum(
+        jnp.stack([(counts - 1) // 2 + 1, counts // 2 + 1], axis=-1), 1
+    )  # (k, 2)
+    onehot8 = onehot.astype(jnp.int8)
+    # finite clamp range per column for PROBE thresholds: a probe landing
+    # in a column's NaN/±inf tail would otherwise put a non-finite value
+    # into the one-hot matmul, where 0·NaN = NaN poisons EVERY row's
+    # threshold and corrupts every cluster's bracket in that feature.
+    # Clamping keeps the matmul finite and the predicate correct for all
+    # finite-valued clusters; clusters whose median genuinely sits in a
+    # non-finite tail still converge there (the final value gather is
+    # unclamped).  ±inf *data* can shift the boundary probe by one rank —
+    # rows with non-finite features already have undefined assignments
+    # (their distances are NaN), so only this bracket caveat remains.
+    finite = jnp.isfinite(svals)
+    fmax = jnp.max(jnp.where(finite, svals, -jnp.inf), axis=0)
+    fmin = jnp.min(jnp.where(finite, svals, jnp.inf), axis=0)
+    fmax = jnp.where(jnp.isfinite(fmax), fmax, 0.0)  # all-non-finite column
+    fmin = jnp.where(jnp.isfinite(fmin), fmin, 0.0)
+
+    def step(_, st):
+        lo, hi = st  # (k, f, 2) position brackets: answer in [lo, hi]
+        pos = lo + (hi - lo) // 2
+        # value thresholds at the probe positions: tiny (k*2, f) gather
+        thr = jnp.take_along_axis(
+            svals, jnp.transpose(pos, (2, 0, 1)).reshape(2 * k, f), axis=0
+        ).reshape(2, k, f)
+        thr = jnp.clip(jnp.where(jnp.isnan(thr), fmax, thr), fmin, fmax)
+        # each row's own-cluster threshold, one per search: (n, f) each.
+        # HIGHEST precision is load-bearing: the MXU's default bf16
+        # truncation would round thresholds off the probed values and
+        # silently corrupt the bisection (the CPU test mesh cannot see it)
+        thr_a = jnp.matmul(onehot, thr[0], precision=jax.lax.Precision.HIGHEST)
+        thr_b = jnp.matmul(onehot, thr[1], precision=jax.lax.Precision.HIGHEST)
+        ind = jnp.concatenate(
+            [(arr <= thr_a), (arr <= thr_b)], axis=1
+        ).astype(jnp.int8)  # (n, 2f)
+        cnt = jax.lax.dot_general(
+            onehot8, ind, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.int32,
+        )  # (k, 2f): members of c with x[:, j] <= thr[s, c, j]
+        cnt = jnp.stack([cnt[:, :f], cnt[:, f:]], axis=-1)  # (k, f, 2)
+        found = cnt >= t[:, None, :]
+        return jnp.where(found, lo, pos + 1), jnp.where(found, pos, hi)
+
+    lo0 = jnp.zeros((k, f, 2), jnp.int32)
+    hi0 = jnp.full((k, f, 2), n - 1, jnp.int32)
+    lo, _ = jax.lax.fori_loop(0, steps, step, (lo0, hi0))
+    val = jnp.take_along_axis(
+        svals, jnp.transpose(lo, (2, 0, 1)).reshape(2 * k, f), axis=0
+    ).reshape(2, k, f)
+    return (val[0] + val[1]) * 0.5
 
 
 class KMedians(_KCluster):
@@ -70,21 +152,27 @@ class KMedians(_KCluster):
     @jax.jit
     def _fit_loop(arr, centers, tol, max_iter):
         """The whole fit as one compiled ``lax.while_loop`` (the KMeans
-        pattern, kmeans.py:61-102): fused assign + masked-median update per
-        step, convergence decided on device.  Replaces the per-epoch
-        ``float(shift)`` host sync of the reference's loop
+        pattern, kmeans.py:61-102): fused assign + rank-selection median
+        update per step, convergence decided on device.  Replaces the
+        per-epoch ``float(shift)`` host sync of the reference's loop
         (kmedians.py:87-130) — on a tunneled TPU that round trip dwarfs the
         step kernel.  |x|² is dropped from the assignment (constant across
-        candidates, see kmeans.py:70-76)."""
+        candidates, see kmeans.py:70-76).  The feature columns are
+        pre-sorted ONCE before the loop; every iteration's medians are
+        sort-free (:func:`_cluster_medians`)."""
         k = centers.shape[0]
+        svals = _presort_values(arr)
 
         def assign(c):
             c2 = jnp.sum(c * c, axis=1)[None, :]
             return jnp.argmin(c2 - 2.0 * jnp.matmul(arr, c.T), axis=1)
 
         def update(labels, c):
-            med = _masked_median(arr, labels, k)
-            return jnp.where(jnp.isnan(med), c, med)
+            member = labels[:, None] == jnp.arange(k)
+            onehot = member.astype(jnp.float32)
+            counts = jnp.sum(member, axis=0, dtype=jnp.int32)
+            med = _cluster_medians(arr, svals, onehot, counts, k)
+            return jnp.where((counts > 0)[:, None], med, c)
 
         def cond(state):
             it, _, shift = state

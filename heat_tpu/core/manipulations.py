@@ -19,6 +19,7 @@ from typing import List, Optional, Sequence, Tuple, Union
 
 import numpy as np
 
+import jax
 import jax.numpy as jnp
 from jax import lax
 
@@ -293,12 +294,15 @@ def sort(a: DNDarray, axis: int = -1, descending: bool = False, out=None):
     (reference manipulations.py:1893-2160 — a distributed sample-sort with
     pivot Gatherv/Bcast and Alltoallv of values+indices).
 
-    When the sorted axis IS the split axis of a 1-D array on a multi-device
-    mesh, the explicit distributed rank sort runs
-    (:func:`heat_tpu.parallel.ring_rank_sort`: parallel local sorts + a
-    ppermute ring of rank counts + one scatter) — the re-design of the
-    reference's sample-sort.  Everywhere else the sorted axis is local to
-    each shard (or the mesh is trivial) and ``jnp`` argsort suffices."""
+    When the sorted axis IS the split axis on a multi-device mesh, the
+    explicit distributed sort runs
+    (:func:`heat_tpu.parallel.sort_axis0`: the ppermute ring rank sort
+    for 1-D/narrow arrays, a resplit + batched local argsort for n-D) —
+    the re-design of the reference's sample-sort, which likewise
+    dispatches exactly when ``axis == split``
+    (reference manipulations.py:1893-2160).  Everywhere else the sorted
+    axis is local to each shard (or the mesh is trivial) and ``jnp``
+    argsort suffices."""
     sanitize_in(a)
     axis = sanitize_axis(a.shape, axis)
     if axis is None:
@@ -306,21 +310,21 @@ def sort(a: DNDarray, axis: int = -1, descending: bool = False, out=None):
     arr = a.larray
     from ..parallel import sort as _parallel_sort  # lazy: parallel imports core
 
-    if a.ndim == 1 and a.split == 0 and _parallel_sort.supports(arr.dtype, a.shape[0], a.comm):
-        values, indices = _parallel_sort.ring_rank_sort(
-            arr, a.shape[0], comm=a.comm, descending=descending
+    moved_shape = (a.shape[axis],) + tuple(s for i, s in enumerate(a.shape) if i != axis)
+    if a.split == axis and _parallel_sort.supports_axis0(arr.dtype, moved_shape, a.comm):
+        moved = jnp.moveaxis(arr, axis, 0) if axis != 0 else arr
+        values, indices = _parallel_sort.sort_axis0(
+            moved, a.shape[axis], comm=a.comm, descending=descending
         )
+        if axis != 0:
+            values = jnp.moveaxis(values, 0, axis)
+            indices = jnp.moveaxis(indices, 0, axis)
         vals = _rewrap(a, values.astype(arr.dtype), a.split, a.dtype)
         idx = _rewrap(a, indices, a.split, types.int32)
     else:
-        if descending:
-            # order-inverting key with ties still by ascending index:
-            # -x for floats (NaN stays NaN → still last); bitwise/logical
-            # NOT for ints and bool (negation overflows INT_MIN and wraps
-            # unsigned — ~x inverts order exactly with no overflow)
-            key = -arr if jnp.issubdtype(arr.dtype, jnp.floating) else ~arr
-        else:
-            key = arr
+        # the shared order-inverting key (ties still by ascending index;
+        # see parallel.sort._descending_key for the overflow rationale)
+        key = _parallel_sort._descending_key(arr) if descending else arr
         indices = jnp.argsort(key, axis=axis, stable=True)
         values = jnp.take_along_axis(arr, indices, axis=axis)
         vals = _rewrap(a, values, a.split, a.dtype)
@@ -497,12 +501,16 @@ def unique(a: DNDarray, sorted: bool = False, return_inverse: bool = False, axis
     data-dependent; JAX needs a static shape) — the data itself never
     leaves the device, so scale is bounded by HBM, not host memory.
     ``axis=k`` uniquifies rows via a lexicographic sort of the remaining
-    dims.  Results are always in sorted order (the reference's
-    ``sorted=False`` leaves order unspecified)."""
+    dims.  Results come back in sorted order (the reference's
+    ``sorted=False`` leaves order unspecified) with ONE exception: wide
+    slices (> 64 flattened columns) sort by a 64-bit row hash —
+    deterministic but not lexicographic — unless ``sorted=True``, which
+    additionally orders the compacted uniques lexicographically
+    (:func:`_unique_axis_hashed`)."""
     sanitize_in(a)
     if axis is not None:
         axis = sanitize_axis(a.shape, axis)
-        return _unique_axis(a, axis, return_inverse)
+        return _unique_axis(a, axis, return_inverse, sorted)
 
     flat = jnp.ravel(a.larray)
     order, s, mask, groups = _unique_mask_1d(flat, comm=a.comm if a.split is not None else None)
@@ -519,34 +527,28 @@ def unique(a: DNDarray, sorted: bool = False, return_inverse: bool = False, axis
     return result
 
 
-#: above this flattened-slice width, axis-unique falls back to the host:
-#: jnp.lexsort builds one variadic-sort operand per column, so compile time
-#: and memory scale with m — a (n, 10k) matrix would emit a 10k-operand sort
+#: above this flattened-slice width, axis-unique switches from the exact
+#: lexicographic sort to a hashed sort key: jnp.lexsort builds one
+#: variadic-sort operand per column, so compile time and memory scale with
+#: m — a (n, 10k) matrix would emit a 10k-operand sort
 _UNIQUE_AXIS_MAX_LEXSORT_KEYS = 64
 
 
-def _unique_axis(a: DNDarray, axis: int, return_inverse: bool):
-    """Unique slices along ``axis``: lexicographic device sort of the
-    flattened remaining dims, then the same mask/count/compact pipeline as
-    the flat case.  Very wide slices (> _UNIQUE_AXIS_MAX_LEXSORT_KEYS
-    columns) use host numpy instead — XLA's variadic sort takes one operand
-    per key, which does not scale in compile time."""
+def _unique_axis(a: DNDarray, axis: int, return_inverse: bool, sort_result: bool = False):
+    """Unique slices along ``axis``: a device sort of the flattened
+    remaining dims, then the same mask/count/compact pipeline as the flat
+    case.  Narrow slices (≤ _UNIQUE_AXIS_MAX_LEXSORT_KEYS columns) sort
+    exactly — lexicographic, so the result is row-sorted; wider slices
+    sort by a 64-bit row hash with exact collision detection
+    (:func:`_unique_axis_hashed`) — still fully device-resident, with the
+    result in deterministic hash order (the reference's own
+    ``sorted=False`` contract, reference manipulations.py:2685-2968)."""
     moved = jnp.moveaxis(a.larray, axis, 0)
     n = moved.shape[0]
     rows = moved.reshape(n, -1)
     m = rows.shape[1]
     if m > _UNIQUE_AXIS_MAX_LEXSORT_KEYS:
-        host = np.asarray(a.larray)
-        res = np.unique(host, return_inverse=return_inverse, axis=axis)
-        uniques, inverse = res if return_inverse else (res, None)
-        split = 0 if a.split is not None else None
-        result = _rewrap(a, jnp.asarray(uniques), split, a.dtype)
-        if return_inverse:
-            inv_wrapped = factories.array(
-                inverse, dtype=types.int64, device=a.device, comm=a.comm
-            )
-            return result, inv_wrapped
-        return result
+        return _unique_axis_hashed(a, axis, return_inverse, moved, rows, sort_result)
     # lexsort: last key is primary → feed columns in reverse order
     order = jnp.lexsort(tuple(rows[:, j] for j in range(m - 1, -1, -1))) if m else jnp.arange(n)
     s = rows[order]
@@ -564,6 +566,149 @@ def _unique_axis(a: DNDarray, axis: int, return_inverse: bool):
     result = _rewrap(a, garr, split, a.dtype)
     if return_inverse:
         inv = jnp.zeros((n,), jnp.int64).at[order].set(groups)
+        inv_wrapped = factories.array(inv, dtype=types.int64, device=a.device, comm=a.comm)
+        return result, inv_wrapped
+    return result
+
+
+def _row_words(rows: jax.Array) -> jax.Array:
+    """Canonical uint32 word matrix of ``rows``: row equality under the
+    unique() rules (±0 collapsed, NaN equal to NaN) ⇔ word equality.
+    Floats canonicalize -0.0 and NaN payloads before the bit view; 64-bit
+    dtypes contribute two words per element, narrow dtypes widen."""
+    dt = rows.dtype
+    if dt == jnp.bool_:
+        return rows.astype(jnp.uint32)
+    if jnp.issubdtype(dt, jnp.floating):
+        rows = jnp.where(rows == 0, jnp.zeros((), dt), rows)  # -0.0 → +0.0
+        rows = jnp.where(jnp.isnan(rows), jnp.full((), jnp.nan, dt), rows)
+    width = jnp.dtype(dt).itemsize * 8
+    uint = {8: jnp.uint8, 16: jnp.uint16, 32: jnp.uint32, 64: jnp.uint64}[width]
+    bits = rows.view(uint)
+    if width == 64:
+        n, m = bits.shape
+        lo = (bits & jnp.uint64(0xFFFFFFFF)).astype(jnp.uint32)
+        hi = (bits >> jnp.uint64(32)).astype(jnp.uint32)
+        return jnp.stack([hi, lo], axis=-1).reshape(n, 2 * m)
+    return bits.astype(jnp.uint32)
+
+
+def _hash_rows(words: jax.Array, seed: int) -> Tuple[jax.Array, jax.Array]:
+    """Two independent 32-bit polynomial row hashes of a uint32 word
+    matrix (a 64-bit key overall).  Each word first passes through a
+    seeded murmur-style mixer — so linear structure in the input cannot
+    align with the polynomial — then folds with per-hash odd
+    multipliers."""
+    w = words.shape[1]
+    x = words ^ jnp.uint32((0x9E3779B9 * (seed + 1)) & 0xFFFFFFFF)
+    x = (x ^ (x >> 16)) * jnp.uint32(0x85EBCA6B)
+    x = (x ^ (x >> 13)) * jnp.uint32(0xC2B2AE35)
+    x = x ^ (x >> 16)
+
+    def powers(mult: int) -> np.ndarray:
+        out, acc = [], 1
+        for _ in range(w):
+            out.append(acc)
+            acc = (acc * mult) & 0xFFFFFFFF
+        return np.asarray(out[::-1], dtype=np.uint32)
+
+    h1 = jnp.sum(x * jnp.asarray(powers(2654435761)), axis=1, dtype=jnp.uint32)
+    h2 = jnp.sum(x * jnp.asarray(powers(0x01000193)), axis=1, dtype=jnp.uint32)
+    return h1, h2
+
+
+def _unique_axis_hashed(
+    a: DNDarray, axis: int, return_inverse: bool, moved, rows, sort_result: bool = False
+):
+    """Device-resident axis-unique for wide slices (replaces the r2 host
+    ``np.unique`` fallback, which silently capped scale at host memory):
+    compress each row to a 64-bit hash, sort by the hash — the distributed
+    ring rank sort when the mesh and x64 policy allow a uint64 key, a
+    2-operand lexsort otherwise; never one sort operand per column — then
+    run the usual exact-content mask/count/compact on the hash-sorted
+    rows.  A hash collision between unequal rows could interleave a
+    duplicate row's group, so collisions are DETECTED exactly (adjacent
+    hash-equal pairs with unequal content) and the pipeline retries with a
+    fresh seed: correctness never rests on the hash.
+
+    The result's row order is the hash order — deterministic and device-
+    resident, but not lexicographic (the exact sorted order would need
+    the per-column variadic sort this path exists to avoid); pass
+    ``sorted=True`` to additionally lexsort the COMPACTED uniques (a host
+    pass over n_unique rows, not the input).
+
+    Data movement note: only the 64-bit key rides the explicit ring sort;
+    the payload permutation ``rows[order]`` is GSPMD-planned, which on a
+    mesh may resolve as a gather — per-device memory must hold the row
+    matrix once.  The TPU-first fix is a distributed take/shuffle
+    primitive (a ragged alltoall by destination shard); until then this
+    path trades the r2 host-memory cap for a per-device HBM cap, which is
+    both larger and orders of magnitude faster to fill."""
+    from ..parallel import sort as _parallel_sort  # lazy: parallel imports core
+
+    n = moved.shape[0]
+    words = _row_words(rows)
+    comm = a.comm if a.split is not None else None
+    for seed in range(4):
+        h1, h2 = _hash_rows(words, seed)
+        if jax.config.jax_enable_x64:
+            key = h1.astype(jnp.uint64) << jnp.uint64(32) | h2.astype(jnp.uint64)
+            if comm is not None and _parallel_sort.supports(key.dtype, n, comm):
+                _, order = _parallel_sort.ring_rank_sort(key, n, comm=comm)
+                order = order.astype(jnp.int64)
+            else:
+                order = jnp.argsort(key, stable=True)
+        elif comm is not None and _parallel_sort.supports(jnp.dtype(jnp.uint32), n, comm):
+            # x64 disabled (HEAT_TPU_DISABLE_X64): no uint64 key exists,
+            # but two successive STABLE ring sorts — minor key first,
+            # then major — compose to the same (h1, h2) lexicographic
+            # order without ever handing GSPMD a sharded variadic sort
+            _, ord2 = _parallel_sort.ring_rank_sort(h2, n, comm=comm)
+            _, ord1 = _parallel_sort.ring_rank_sort(h1[ord2], n, comm=comm)
+            order = ord2[ord1]
+        else:
+            order = jnp.lexsort((h2, h1))
+        s = rows[order]
+        sh1, sh2 = h1[order], h2[order]
+        same_hash = (sh1 == jnp.roll(sh1, 1)) & (sh2 == jnp.roll(sh2, 1))
+        prev = jnp.roll(s, 1, axis=0)
+        neq_el = s != prev
+        if jnp.issubdtype(s.dtype, jnp.floating):
+            neq_el = neq_el & ~(jnp.isnan(s) & jnp.isnan(prev))
+        neq = jnp.any(neq_el, axis=1)
+        # exact collision check: unequal neighbours under one hash key
+        if n and bool(jnp.any(same_hash & neq & (jnp.arange(n) > 0))):
+            continue  # astronomically rare: re-seed and re-hash
+        mask = neq.at[0].set(True) if n else neq
+        break
+    else:  # 4 colliding seeds means adversarial input — fail loudly
+        raise RuntimeError(
+            "unique(axis=...): persistent 64-bit hash collisions; cannot "
+            "group rows device-resident"
+        )
+    if comm is not None and comm.size > 1 and n:
+        from ..parallel import prefix_sum
+
+        groups = prefix_sum(mask.astype(jnp.int32), comm=comm) - 1
+    else:
+        groups = jnp.cumsum(mask) - 1
+    n_unique = int(jnp.sum(mask))  # the single scalar host sync
+    uniq_rows = _compact(s, mask, groups, n_unique)
+    remap = None
+    if sort_result and n_unique:
+        # honor unique()'s sorted contract: lexsort just the COMPACTED
+        # uniques on the host (n_unique rows — the dedup already ran on
+        # device; this never touches the full input)
+        host = np.asarray(uniq_rows)
+        perm = np.lexsort(tuple(host[:, j] for j in range(host.shape[1] - 1, -1, -1)))
+        uniq_rows = uniq_rows[jnp.asarray(perm)]
+        remap = jnp.asarray(np.argsort(perm))  # old group id -> sorted position
+    garr = jnp.moveaxis(uniq_rows.reshape((n_unique,) + moved.shape[1:]), 0, axis)
+    split = 0 if a.split is not None else None
+    result = _rewrap(a, garr, split, a.dtype)
+    if return_inverse:
+        sorted_groups = remap[groups] if remap is not None else groups
+        inv = jnp.zeros((n,), jnp.int64).at[order].set(sorted_groups)
         inv_wrapped = factories.array(inv, dtype=types.int64, device=a.device, comm=a.comm)
         return result, inv_wrapped
     return result

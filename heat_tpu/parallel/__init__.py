@@ -33,7 +33,7 @@ from .primitives import (
     ring_source,
 )
 from .ring_attention import ring_attention, ring_self_attention
-from .sort import ring_rank_sort
+from .sort import ring_rank_sort, sort_axis0
 from .ulysses import ulysses_attention
 
 __all__ = [
@@ -45,6 +45,7 @@ __all__ = [
     "ring_source",
     "ring_attention",
     "ring_rank_sort",
+    "sort_axis0",
     "ring_self_attention",
     "ulysses_attention",
 ]

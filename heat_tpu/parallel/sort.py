@@ -6,10 +6,11 @@ sample-sort: per-rank local sort, pivot selection via Gatherv+Bcast,
 Alltoallv of value/index buckets, and a final local merge, with ragged
 receive counts throughout.
 
-TPU formulation (**rank sort over a ppermute ring**): instead of moving
-data into pivot-defined buckets (whose sizes are data-dependent — hostile
-to XLA's static shapes), each element's exact global rank is computed and
-the data is scattered once:
+Two TPU formulations, picked by :func:`sort_axis0` on the shape:
+
+**1-D (rank sort over a ppermute ring)** — when the sorted axis is the
+ONLY axis there is nothing to trade against, so each element's exact
+global rank is computed and the data is scattered once:
 
 1.  Values map onto one (32-bit dtypes) or two (64-bit dtypes) uint32
     *order words* (an order-preserving unsigned encoding; NaN forced
@@ -31,10 +32,25 @@ the data is scattered once:
 
 Every shape in the program is static, and values travel verbatim (NaN
 payloads and signed zeros survive).
+
+**n-D (resplit + local batched sorts)** — an n-D array sorted along its
+split axis is a batch of independent 1-D sorts, one per trailing index.
+The mesh-native move is NOT to run a distributed sort at all: one
+all-to-all re-splits the array onto a trailing axis, making the sort
+axis shard-local; every device then sorts its own columns with a plain
+batched ``argsort`` (any dtype, any length — no order-word encoding
+needed); a second all-to-all restores the original split.  Data crosses
+the ICI exactly twice, versus p-1 ring traversals — the same economics
+that make the reference funnel its n-D case through one per-column
+``Alltoallv`` (manipulations.py:2040-2160).  When there are fewer
+columns than devices the all-to-all would idle p-B positions, so narrow
+arrays (1 < B < p) instead loop the 1-D ring sort per column, keeping
+the whole mesh on every column.
 """
 
 from __future__ import annotations
 
+import math
 from functools import partial
 from typing import Optional, Tuple
 
@@ -45,7 +61,14 @@ import jax.numpy as jnp
 
 from ..core.communication import XlaCommunication, get_comm
 
-__all__ = ["ring_rank_sort", "supports", "ORDERABLE_32BIT", "ORDERABLE_64BIT"]
+__all__ = [
+    "ring_rank_sort",
+    "sort_axis0",
+    "supports",
+    "supports_axis0",
+    "ORDERABLE_32BIT",
+    "ORDERABLE_64BIT",
+]
 
 #: dtypes representable in one 32-bit order word
 ORDERABLE_32BIT = frozenset(
@@ -62,8 +85,8 @@ _PAD_WORD = 0xFFFFFFFF
 def supports(dtype, n: int, comm: XlaCommunication) -> bool:
     """True when :func:`ring_rank_sort` applies: a multi-device mesh, an
     order-word-encodable dtype, and int32-rankable length.  The ONE
-    eligibility predicate for callers (ht.sort / ht.unique) — keep their
-    dispatch and this module's preconditions from drifting apart."""
+    eligibility predicate for 1-D callers (ht.unique / sort_axis0) — keep
+    their dispatch and this module's preconditions from drifting apart."""
     return (
         comm.size > 1
         and str(dtype) in ORDERABLE_32BIT | ORDERABLE_64BIT
@@ -72,6 +95,22 @@ def supports(dtype, n: int, comm: XlaCommunication) -> bool:
         and 0 < n
         and comm.padded_size(n) <= 2**31 - 1
     )
+
+
+def supports_axis0(dtype, shape, comm: XlaCommunication) -> bool:
+    """True when :func:`sort_axis0` has an explicit distributed plan for
+    sorting along axis 0 of ``shape`` — the dispatch predicate for
+    ``ht.sort`` / axis-quantiles when the sorted axis is the split axis."""
+    if comm.size <= 1 or len(shape) == 0 or shape[0] <= 0:
+        return False
+    b = math.prod(shape[1:]) if len(shape) > 1 else 1
+    if b == 0:
+        return False
+    if len(shape) > 1 and b >= comm.size:
+        # resplit path: plain batched argsort, any sortable dtype — but
+        # indices travel as int32, so the sorted axis must not wrap
+        return shape[0] <= 2**31 - 1
+    return supports(dtype, shape[0], comm)
 
 
 def _order_words(vals: jax.Array, descending: bool):
@@ -133,7 +172,9 @@ def _bisect(arr: jax.Array, lo_b: jax.Array, hi_b: jax.Array, q: jax.Array, righ
 
     def step(i, st):
         lo, hi = st
-        mid = jnp.clip((lo + hi) // 2, 0, arr.shape[0] - 1)
+        # overflow-safe midpoint: lo + hi can exceed int32 at ~2^30-element
+        # shards (supports() admits padded lengths to 2^31-1)
+        mid = jnp.clip(lo + (hi - lo) // 2, 0, arr.shape[0] - 1)
         v = arr[mid]
         go_right = (v <= q) if right else (v < q)
         active = lo < hi
@@ -255,3 +296,100 @@ def _rrs(arr, n: int, comm: XlaCommunication, descending: bool):
     out_v = jax.lax.with_sharding_constraint(out_v, sh)
     out_i = jax.lax.with_sharding_constraint(out_i, sh)
     return out_v, out_i
+
+
+def _descending_key(arr: jax.Array) -> jax.Array:
+    """Order-inverting sort key with ties still resolved by ascending
+    index: -x for floats (NaN stays NaN → still last); bitwise/logical
+    NOT for ints and bool (negation overflows INT_MIN and wraps unsigned —
+    ~x inverts order exactly with no overflow)."""
+    return -arr if jnp.issubdtype(arr.dtype, jnp.floating) else ~arr
+
+
+@partial(jax.jit, static_argnames=("comm", "descending", "want_indices"))
+def _resplit_sort(arr, comm: XlaCommunication, descending: bool, want_indices: bool = True):
+    """Sort an axis-0-split (n, b) array along axis 0 by making the sort
+    axis LOCAL: reshard to column shards (one all-to-all), run a
+    per-device batched stable argsort inside ``shard_map`` (zero
+    collectives in the sort itself), reshard back to row shards (the
+    second all-to-all).
+
+    The shard_map is load-bearing, not style: handed the equivalent
+    ``with_sharding_constraint`` program, GSPMD chooses to REPLICATE the
+    sort — every device sorts the full matrix and slices its shard out
+    (verified in HLO: ``sort(f32[n,b])`` + ``dynamic-slice``) — the exact
+    pathology this routine exists to avoid."""
+    p = comm.size
+    b = arr.shape[1]
+    bp = comm.padded_size(b)
+    if bp != b:
+        # column-pad to divisibility for the shard_map; the padded
+        # columns sort garbage that is sliced off before returning
+        arr = jnp.pad(arr, ((0, 0), (0, bp - b)))
+
+    def kernel(block):  # (n, bp/p): full rows of my columns
+        if not want_indices:
+            # values-only (e.g. quantiles): a 1-operand sort, and the
+            # second output never rides the return all-to-all
+            key = _descending_key(block) if descending else block
+            s = jax.lax.sort(key, dimension=0, is_stable=False)
+            return (_descending_key(s) if descending else s,)
+        key = _descending_key(block) if descending else block
+        idx = jnp.argsort(key, axis=0, stable=True).astype(jnp.int32)
+        vals = jnp.take_along_axis(block, idx, axis=0)
+        return vals, idx
+
+    outs = jax.shard_map(
+        kernel,
+        mesh=comm.mesh,
+        in_specs=comm.spec(2, 1),
+        out_specs=(comm.spec(2, 1), comm.spec(2, 1)) if want_indices else (comm.spec(2, 1),),
+    )(arr)
+    sh = comm.sharding(2, 0)
+    outs = tuple(
+        jax.lax.with_sharding_constraint(o[:, :b] if bp != b else o, sh) for o in outs
+    )
+    return outs if want_indices else (outs[0], None)
+
+
+def sort_axis0(
+    arr: jax.Array,
+    n: int,
+    comm: Optional[XlaCommunication] = None,
+    descending: bool = False,
+    want_indices: bool = True,
+) -> Tuple[jax.Array, Optional[jax.Array]]:
+    """Distributed stable sort along axis 0 (the split axis) of an
+    arbitrary-rank array: the module-level dispatcher (see the module
+    docstring for the two formulations).  Returns
+    ``(sorted_values, original_indices)`` shaped like ``arr``, indices
+    indexing along axis 0 (numpy ``argsort`` semantics).
+    ``want_indices=False`` (e.g. quantiles) returns ``(values, None)``
+    and skips the index half of the sort and its return collective.
+    Callers gate on :func:`supports_axis0`."""
+    comm = get_comm() if comm is None else comm
+    if arr.ndim == 1:
+        return ring_rank_sort(arr, n, comm=comm, descending=descending)
+    b = math.prod(arr.shape[1:])
+    trailing = arr.shape[1:]
+    flat = arr.reshape(arr.shape[0], b)
+    if b >= comm.size:
+        vals, idx = _resplit_sort(flat, comm, descending, want_indices)
+    else:
+        # fewer columns than devices: an all-to-all would idle p-b mesh
+        # positions — run the 1-D ring sort per column, each on the full
+        # mesh (one compile: every column shares shape and dtype)
+        cols = [
+            ring_rank_sort(flat[:, c], n, comm=comm, descending=descending)
+            for c in range(b)
+        ]
+        vals = comm.apply_sharding(jnp.stack([v for v, _ in cols], axis=1), 0)
+        idx = (
+            comm.apply_sharding(jnp.stack([i for _, i in cols], axis=1), 0)
+            if want_indices
+            else None
+        )
+    return (
+        vals.reshape((n,) + trailing),
+        idx.reshape((n,) + trailing) if idx is not None else None,
+    )
