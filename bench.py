@@ -19,13 +19,63 @@ per-iteration time with dispatch latency and fence cost cancelled out.
 
 from __future__ import annotations
 
+import glob
 import json
+import os
+import sys
 import time
 
 import numpy as np
 
 N, F, K, ITERS = 500_000, 32, 8, 30
 SUB = 20_000  # cdist rows (distance_matrix config scale)
+
+#: headline metrics the regression guard watches; True = higher is better
+_HEADLINE = {
+    "kmeans_iter_per_sec": True,
+    "cdist_gb_per_sec": True,
+    "moments_gb_per_sec": True,
+    "global_sum_gb_per_sec": True,
+    "kmedians_iter_per_sec": True,
+    "kmedoids_iter_per_sec": True,
+    "eager_ops_per_sec": True,
+    "lasso_sweeps_per_sec": True,
+    "qr_svd_tall_skinny_ms": False,
+}
+
+
+def regression_check(result: dict) -> dict:
+    """Compare this run's headline metrics against the newest recorded
+    BENCH_r*.json; any >10% slide is flagged in the returned dict (and on
+    stderr, so a silent regression costs a visible diff — VERDICT r2 #3:
+    nothing gated the 17% qr_svd slide between rounds)."""
+    rounds = sorted(glob.glob(os.path.join(os.path.dirname(__file__) or ".", "BENCH_r*.json")))
+    if not rounds:
+        return {}
+    try:
+        with open(rounds[-1]) as fh:
+            prev = json.load(fh)
+    except (OSError, ValueError):
+        return {}
+    prev = prev.get("parsed", prev)  # driver records wrap metrics in "parsed"
+    if not isinstance(prev, dict):
+        return {}
+    flagged = {}
+    for key, higher_better in _HEADLINE.items():
+        if key == result.get("metric"):
+            now, before = result.get("value"), prev.get("value")
+        else:
+            now, before = result.get(key), prev.get(key)
+        if not isinstance(now, (int, float)) or not isinstance(before, (int, float)) or before <= 0:
+            continue
+        ratio = now / before if higher_better else before / now
+        if ratio < 0.9:  # >10% worse than the recorded round
+            flagged[key] = {"prev": before, "now": now, "ratio": round(ratio, 3)}
+            print(
+                f"REGRESSION {key}: {before} -> {now} ({ratio:.2f}x of {os.path.basename(rounds[-1])})",
+                file=sys.stderr,
+            )
+    return flagged
 
 
 def make_blobs():
@@ -315,9 +365,7 @@ def main():
     lasso_sweeps = lasso_rate(data, X)
     qr_ms = qr_svd_ms()
     numpy_rate = numpy_kmeans_rate(data, centers)
-    print(
-        json.dumps(
-            {
+    result = {
                 "metric": "kmeans_iter_per_sec",
                 "value": round(heat_rate, 2),
                 "unit": "iter/s",
@@ -335,9 +383,11 @@ def main():
                 "lasso_sweeps_per_sec": round(lasso_sweeps, 2),
                 "qr_svd_tall_skinny_ms": round(qr_ms, 2),
                 "config": f"n={N} f={F} k={K} iters={ITERS}",
-            }
-        )
-    )
+    }
+    flagged = regression_check(result)
+    if flagged:
+        result["regressions_vs_prev_round"] = flagged
+    print(json.dumps(result))
 
 
 if __name__ == "__main__":

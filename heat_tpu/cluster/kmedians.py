@@ -172,7 +172,10 @@ class KMedians(_KCluster):
             onehot = member.astype(jnp.float32)
             counts = jnp.sum(member, axis=0, dtype=jnp.int32)
             med = _cluster_medians(arr, svals, onehot, counts, k)
-            return jnp.where((counts > 0)[:, None], med, c)
+            # keep the previous coordinate for empty clusters AND for NaN
+            # medians (a NaN-feature member): a NaN center would poison
+            # shift, silently end the loop, and NaN every distance
+            return jnp.where((counts > 0)[:, None] & ~jnp.isnan(med), med, c)
 
         def cond(state):
             it, _, shift = state

@@ -6,21 +6,34 @@ baseline configs exercise it (BASELINE.md target 5: "linalg.qr + SVD on
 tall-skinny split DNDarray").
 
 Algorithm: always reduce via QR first (TSQR when row-split — see qr.py),
-then factor the small triangular R on the host.  This is the standard
-communication-avoiding SVD and it also sidesteps a hard constraint of the
-current TPU toolchain: lowering ``jnp.linalg.svd`` crashes the XLA TPU
-compiler (TransposeFolding CHECK failure → SIGABRT, observed on
-libtpu/v5e), so no SVD is ever compiled for the accelerator — only QR and
-matmul are, both of which the MXU handles natively.  Wide matrices factor
-transposed and swap U/V.
+then factor the small triangular R **on device** — the standard
+communication-avoiding SVD.  Only the tiny (n, n) R ever reaches the SVD
+kernel, so the MXU carries all the real work (QR + the Q·Ur matmul) and
+the decomposition adds zero host syncs: round 2 factored R on the host
+because ``jnp.linalg.svd`` SIGABRT'd the then-current XLA TPU compiler
+(TransposeFolding CHECK), which cost two tunnel round-trips per call —
+~125 ms of the ~116 ms r2 benchmark pair was that readback.  The current
+toolchain lowers SVD correctly (verified against numpy singular values
+and reconstruction at 1e-5); set ``HEAT_TPU_HOST_SVD=1`` to restore the
+host fallback on a toolchain where the crash resurfaces.  Wide matrices
+factor transposed and swap U/V.
 """
 
 from __future__ import annotations
 
 import collections
+import os
 
 import numpy as np
+import jax as _jax
 import jax.numpy as jnp
+
+
+@_jax.jit
+def _jitted_svd(a):
+    # one persistent jit: a fresh lambda per call would recompile the SVD
+    # every invocation (~1.2 s each on the TPU)
+    return jnp.linalg.svd(a, full_matrices=False)
 
 from .. import types
 from ..dndarray import DNDarray
@@ -32,12 +45,34 @@ __all__ = ["svd"]
 SVD = collections.namedtuple("SVD", "U, S, V")
 
 
-def _reduced_svd_factors(a: DNDarray, dtype):
-    """QR-reduce then host-SVD the small R: returns (Q, Ur, S, Vt) with
-    Q on-device and the rest as numpy arrays."""
-    q, r = _qr(a if a.dtype is dtype else a.astype(dtype))
-    ur, s, vt = np.linalg.svd(np.asarray(r.larray), full_matrices=False)
-    return q, ur, s, vt
+def _host_svd() -> bool:
+    """True when the escape hatch back to host-side SVD of R is on."""
+    return os.environ.get("HEAT_TPU_HOST_SVD", "0") == "1"
+
+
+def _small_svd(r: jnp.ndarray):
+    """SVD of the reduced (n, n) triangular factor: on device by default,
+    on the host behind ``HEAT_TPU_HOST_SVD=1`` (see module docstring).
+
+    The on-device lowering runs under ``jax.enable_x64(False)``: with x64
+    on (this package's default policy) the compute_uv SVD lowering still
+    SIGABRTs the XLA TPU compiler, while the identical f32 program with
+    x64 off compiles and matches numpy to 1e-4 — the operands are f32
+    either way, so the context changes internal index dtypes only."""
+    if _host_svd() or r.dtype == jnp.float64:
+        # float64 R factors on the host: the x64-off context below would
+        # silently downcast them, and the TPU has no f64 hardware — LAPACK
+        # on an (n, n) triangle is the right tool (one tiny transfer)
+        ur, s, vt = np.linalg.svd(np.asarray(r), full_matrices=False)
+        return jnp.asarray(ur, r.dtype), jnp.asarray(s, r.dtype), jnp.asarray(vt, r.dtype)
+    with _jax.enable_x64(False):
+        return _jitted_svd(r)
+
+
+def _small_singvals(r: jnp.ndarray):
+    if _host_svd():
+        return jnp.asarray(np.linalg.svd(np.asarray(r), compute_uv=False), r.dtype)
+    return jnp.linalg.svd(r, compute_uv=False)
 
 
 def svd(a: DNDarray, full_matrices: bool = False, compute_uv: bool = True):
@@ -65,16 +100,18 @@ def svd(a: DNDarray, full_matrices: bool = False, compute_uv: bool = True):
 
     if not compute_uv:
         _, r = _qr(a if a.dtype is dtype else a.astype(dtype))
-        s = np.linalg.svd(np.asarray(r.larray), compute_uv=False)
-        s_arr = jnp.asarray(s, dtype=dtype.jax_type())
+        s_arr = _small_singvals(r.larray).astype(dtype.jax_type())
         return DNDarray(s_arr, tuple(s_arr.shape), dtype, None, device, comm, True)
 
-    q, ur, s, vt = _reduced_svd_factors(a, dtype)
+    q, r = _qr(a if a.dtype is dtype else a.astype(dtype))
+    ur, s, vt = _small_svd(r.larray)
     from .basics import _precision
 
-    u = jnp.matmul(q.larray, jnp.asarray(ur, dtype=dtype.jax_type()), precision=_precision())
+    u = jnp.matmul(q.larray, ur.astype(dtype.jax_type()), precision=_precision())
     u = comm.apply_sharding(u, a.split if a.split == 0 else None)
     U = DNDarray(u, (m, n), dtype, a.split if a.split == 0 else None, device, comm, True)
-    S = DNDarray(jnp.asarray(s, dtype=dtype.jax_type()), (n,), dtype, None, device, comm, True)
-    V = DNDarray(jnp.asarray(vt.T, dtype=dtype.jax_type()), (n, n), dtype, None, device, comm, True)
+    s_arr = s.astype(dtype.jax_type())
+    S = DNDarray(s_arr, (n,), dtype, None, device, comm, True)
+    v = jnp.transpose(vt).astype(dtype.jax_type())
+    V = DNDarray(v, (n, n), dtype, None, device, comm, True)
     return SVD(U, S, V)

@@ -310,8 +310,7 @@ def sort(a: DNDarray, axis: int = -1, descending: bool = False, out=None):
     arr = a.larray
     from ..parallel import sort as _parallel_sort  # lazy: parallel imports core
 
-    moved_shape = (a.shape[axis],) + tuple(s for i, s in enumerate(a.shape) if i != axis)
-    if a.split == axis and _parallel_sort.supports_axis0(arr.dtype, moved_shape, a.comm):
+    if a.split == axis and _parallel_sort.supports_axis(arr.dtype, a.shape, axis, a.comm):
         moved = jnp.moveaxis(arr, axis, 0) if axis != 0 else arr
         values, indices = _parallel_sort.sort_axis0(
             moved, a.shape[axis], comm=a.comm, descending=descending

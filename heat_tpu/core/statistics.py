@@ -324,11 +324,7 @@ def percentile(x: DNDarray, q, axis=None, out=None, interpolation: str = "linear
     elif (
         isinstance(axis, int)
         and axis == x.split
-        and _parallel_sort.supports_axis0(
-            x.larray.dtype,
-            (x.shape[axis],) + tuple(s for i, s in enumerate(x.shape) if i != axis),
-            x.comm,
-        )
+        and _parallel_sort.supports_axis(x.larray.dtype, x.shape, axis, x.comm)
     ):
         # axis-quantile ALONG the split axis: the reference resolves this
         # with a distributed partition gather (statistics.py:1171-1422);

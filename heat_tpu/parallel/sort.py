@@ -107,10 +107,24 @@ def supports_axis0(dtype, shape, comm: XlaCommunication) -> bool:
     if b == 0:
         return False
     if len(shape) > 1 and b >= comm.size:
-        # resplit path: plain batched argsort, any sortable dtype — but
-        # indices travel as int32, so the sorted axis must not wrap
-        return shape[0] <= 2**31 - 1
+        # resplit path: plain batched argsort of any REAL dtype — complex
+        # breaks both the ~ descending key and the TPU sort lowering
+        # (UNIMPLEMENTED), and indices travel as int32, so the sorted
+        # axis must not wrap
+        return (
+            not jnp.issubdtype(jnp.dtype(dtype), jnp.complexfloating)
+            and shape[0] <= 2**31 - 1
+        )
     return supports(dtype, shape[0], comm)
+
+
+def supports_axis(dtype, shape, axis: int, comm: XlaCommunication) -> bool:
+    """Eligibility of :func:`sort_axis0` after moving ``axis`` to the
+    front — the ONE construction site for the moved shape, shared by
+    ``ht.sort`` and the axis-quantile dispatch (keeps the two callers'
+    preconditions from drifting apart)."""
+    moved = (shape[axis],) + tuple(s for i, s in enumerate(shape) if i != axis)
+    return supports_axis0(dtype, moved, comm)
 
 
 def _order_words(vals: jax.Array, descending: bool):

@@ -279,6 +279,22 @@ def test_kmedians_medians_nan_rows_do_not_poison_clean_clusters():
     assert np.isnan(med[k - 1, 1])
 
 
+def test_kmedians_fit_survives_nan_feature():
+    """A NaN feature value must not NaN the centers or end the loop: the
+    update keeps the previous coordinate for NaN medians."""
+    from heat_tpu.cluster.kmedians import KMedians
+
+    rng = np.random.default_rng(32)
+    data = rng.normal(size=(400, 3)).astype(np.float32)
+    data[5, 1] = np.nan
+    init = ht.array(data[:2].copy())
+    km = KMedians(n_clusters=2, init=init, max_iter=20, tol=1e-5).fit(
+        ht.array(data, split=0)
+    )
+    centers = np.asarray(km.cluster_centers_.larray)
+    assert np.isfinite(centers).all()
+
+
 def test_sort_axis0_supports_predicate():
     comm = ht.core.communication.get_comm()
     if comm.size == 1:
@@ -286,8 +302,13 @@ def test_sort_axis0_supports_predicate():
     f32, c64 = np.dtype("float32"), np.dtype("complex64")
     assert _psort.supports_axis0(f32, (100,), comm)
     assert _psort.supports_axis0(f32, (100, comm.size), comm)
-    # wide path takes any dtype; narrow path falls back to ring eligibility
-    assert _psort.supports_axis0(c64, (100, comm.size), comm)
+    # complex is excluded everywhere: the ~ descending key and the TPU
+    # sort lowering both reject it
+    assert not _psort.supports_axis0(c64, (100, comm.size), comm)
     assert not _psort.supports_axis0(c64, (100,), comm)
     assert not _psort.supports_axis0(f32, (0,), comm)
     assert not _psort.supports_axis0(f32, (100, 0), comm)
+    # the moved-shape helper shares the same predicate
+    assert _psort.supports_axis(f32, (4, 100, 3), 1, comm) == _psort.supports_axis0(
+        f32, (100, 4, 3), comm
+    )
