@@ -1,24 +1,10 @@
-"""Tests for kernels, profiler, tiling, printing, version, graft entry."""
+"""Tests for profiler, tiling, printing, version, graft entry."""
 
 import numpy as np
 import pytest
 
 import jax
 import heat_tpu as ht
-
-
-def test_pallas_assignment_kernel():
-    from heat_tpu.core import kernels
-
-    rng = np.random.default_rng(0)
-    x = rng.normal(size=(1000, 16)).astype(np.float32)
-    c = rng.normal(size=(8, 16)).astype(np.float32)
-    lab_pl = np.asarray(kernels.assign_labels_pallas(x, c, block_rows=128))
-    lab_ref = np.asarray(kernels.assign_labels(x, c))
-    np.testing.assert_array_equal(lab_pl, lab_ref)
-    # non-divisible row count exercises the padding path
-    lab_pl2 = np.asarray(kernels.assign_labels_pallas(x[:999], c, block_rows=128))
-    np.testing.assert_array_equal(lab_pl2, lab_ref[:999])
 
 
 def test_profiler_timer():
