@@ -42,7 +42,7 @@ def main():
 
     km = ht.cluster.KMeans(
         n_clusters=args.clusters, init="probability_based", max_iter=args.iterations,
-        tol=0.0, random_state=1,
+        tol=-1.0, random_state=1,
     )
     km.fit(data)  # warmup: compiles the fused step
 
@@ -51,7 +51,7 @@ def main():
         t0 = time.perf_counter()
         km = ht.cluster.KMeans(
             n_clusters=args.clusters, init="probability_based",
-            max_iter=args.iterations, tol=0.0, random_state=1,
+            max_iter=args.iterations, tol=-1.0, random_state=1,
         )
         km.fit(data)
         times.append(time.perf_counter() - t0)

@@ -35,13 +35,13 @@ def main():
         yd = xd @ w + 0.1 * rng.normal(size=args.n).astype(np.float32)
         x, y = ht.array(xd, split=0), ht.array(yd, split=0)
 
-    est = ht.regression.Lasso(lam=0.1, max_iter=args.iterations, tol=0.0)
+    est = ht.regression.Lasso(lam=0.1, max_iter=args.iterations, tol=-1.0)
     est.fit(x, y)  # warmup compile
 
     times = []
     for _ in range(args.trials):
         t0 = time.perf_counter()
-        ht.regression.Lasso(lam=0.1, max_iter=args.iterations, tol=0.0).fit(x, y)
+        ht.regression.Lasso(lam=0.1, max_iter=args.iterations, tol=-1.0).fit(x, y)
         times.append(time.perf_counter() - t0)
     best = min(times)
     print(f"lasso: n={x.shape[0]} f={x.shape[1]} sweeps={args.iterations} "

@@ -57,7 +57,21 @@ MESHES = [1, 2, 4, 8]
 
 
 def main() -> None:
-    results = {}
+    results = {
+        "_note": (
+            "Virtual CPU mesh: the n devices SHARE one host's cores, so "
+            "per-device compute serializes — shard_map/fused-fit suites "
+            "show ~1/n of their 1-device rate by construction, and "
+            "flat-or-better across mesh sizes is the pass criterion "
+            "(machinery, not speed; real ICI-linked chips parallelize the "
+            "local phases).  Estimator fits run with tol=-1.0 so exactly "
+            "max_iter sweeps execute: tol=0.0 does NOT disable the early "
+            "exit (the f32 shift reaches exactly 0.0 once a fit "
+            "stabilizes), which inflated r2's kmeans 1-device rate and "
+            "inverted the lasso curve (fits converged at different sweep "
+            "counts per mesh size while the rate divided by max_iter)."
+        )
+    }
     for suite, (script, extra, pattern, unit) in SUITES.items():
         results[suite] = {"unit": unit, "config": " ".join(extra), "by_devices": {}}
         for n in MESHES:

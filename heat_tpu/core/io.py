@@ -168,13 +168,32 @@ def _emit_slabs(data: DNDarray, write):
     return err
 
 
-def _io_barrier() -> None:
-    """Cross-process barrier after a save so no process reads a file the
-    writer has not finished (no-op single-host)."""
+def _finish_save(err: Optional[BaseException]) -> None:
+    """End a cross-process save: allgather a per-process
+    failure flag so a writer-side error raises on EVERY process.  Without
+    the flag only process 0 learns of a failed save — the other processes
+    return success and march into the next collective (e.g. a load of the
+    file that was never written) while the writer has died, hanging the
+    cluster.  The flag allgather is itself a full rendezvous (no process
+    passes it until every process has finished its slab collectives and
+    the writer has closed the file), so it IS the end-of-save barrier —
+    a separate sync_global_devices on top would just double the
+    cross-process latency.  Every process must reach this call exactly
+    once per save."""
+    any_err = err is not None
     if jax.process_count() > 1:
         from jax.experimental import multihost_utils
 
-        multihost_utils.sync_global_devices("heat_tpu_io_save")
+        flags = multihost_utils.process_allgather(
+            np.asarray([1 if err is not None else 0], np.int32)
+        )
+        any_err = bool(np.asarray(flags).sum())
+    if err is not None:
+        raise err
+    if any_err:
+        raise RuntimeError(
+            "save failed on the writer process (process 0); see its traceback"
+        )
 
 
 def _writer_save(data: DNDarray, prepare) -> None:
@@ -195,9 +214,7 @@ def _writer_save(data: DNDarray, prepare) -> None:
             close()
         except Exception as e:  # noqa: BLE001
             err = err or e
-    _io_barrier()
-    if err or werr:
-        raise err or werr
+    _finish_save(err or werr)
 
 
 def save_hdf5(data: DNDarray, path: str, dataset: str, mode: str = "w", **kwargs) -> None:
@@ -225,7 +242,7 @@ def save_hdf5(data: DNDarray, path: str, dataset: str, mode: str = "w", **kwargs
         _writer_save(data, prepare)
     else:
         _emit_slabs(data, None)
-        _io_barrier()
+        _finish_save(None)
 
 
 def load_netcdf(
@@ -315,7 +332,7 @@ def save_netcdf(
         _writer_save(data, prepare)
     else:
         _emit_slabs(data, None)
-        _io_barrier()
+        _finish_save(None)
 
 
 def load_csv(
@@ -380,13 +397,15 @@ def save_csv(
     else:
         arr = np.asarray(data.larray)
     fmt = f"%.{decimals}f" if decimals >= 0 else "%s"
-    try:
-        if jax.process_index() == 0:
+    err = None
+    if jax.process_index() == 0:
+        try:
             np.savetxt(
                 path, arr, delimiter=sep, header=header_lines or "", fmt=fmt, encoding=encoding
             )
-    finally:
-        _io_barrier()
+        except Exception as e:  # noqa: BLE001 — deferred past the collectives
+            err = e
+    _finish_save(err)
 
 
 def load(path: str, *args, **kwargs) -> DNDarray:

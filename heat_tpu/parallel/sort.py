@@ -304,8 +304,11 @@ def _rrs(arr, n: int, comm: XlaCommunication, descending: bool):
     # ranks land at [n, p*w) and fall away
     out_v = jnp.zeros((n,), dt).at[ranks].set(svals, mode="drop")
     out_i = jnp.zeros((n,), jnp.int32).at[ranks].set(sgidx, mode="drop")
-    # split=0 even when ragged — GSPMD handles uneven trailing shards; a
-    # replicated constraint here would all-gather the whole result
+    # divisible n commits sharded; ragged n resolves to replicated at the
+    # boundary (GSPMD refuses uneven boundary layouts — see
+    # _constrained_copy), costing one gather of the ranked rows.  The
+    # ring rounds above never gather either way (tests/test_hlo_ragged.py
+    # pins the lowering).
     sh = comm.sharding(1, 0)
     out_v = jax.lax.with_sharding_constraint(out_v, sh)
     out_i = jax.lax.with_sharding_constraint(out_i, sh)

@@ -435,3 +435,44 @@ def test_shard_position_value_order():
         assert blk.shape == lshape
         parts.append(blk)
     np.testing.assert_array_equal(np.concatenate(parts, axis=0), np.asarray(b))
+
+
+def test_alltoall_recv_axis_warning_definitive_only():
+    """The stale-recv_axis warning fires only when the committed layout
+    DEFINITIVELY contradicts it (canonical divisible layout on another
+    axis); ragged layouts — where GSPMD may commit something else — never
+    warn (VERDICT r2 #9: the warning must not fire spuriously)."""
+    import warnings as _w
+
+    comm = ht.get_comm()
+    n = comm.size
+    if n == 1:
+        pytest.skip("needs a mesh")
+    # definitive mismatch: divisible axis 0 layout, recv_axis=1 claimed
+    a = comm.apply_sharding(jnp.arange(2 * n * 3 * n, dtype=jnp.float32).reshape(2 * n, 3 * n), 0)
+    with pytest.warns(UserWarning, match="alltoall"):
+        comm.alltoall(a, send_axis=1, recv_axis=1)
+    # ragged axis: commits replicated (src=None) -> warning short-circuits
+    b = comm.apply_sharding(
+        jnp.arange((2 * n + 1) * n, dtype=jnp.float32).reshape(2 * n + 1, n), 0
+    )
+    with _w.catch_warnings(record=True) as rec:
+        _w.simplefilter("always")
+        out = comm.alltoall(b, send_axis=1, recv_axis=1)
+    assert not [w for w in rec if "alltoall" in str(w.message)], rec
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(b))
+    # foreign-mesh layout: src is set but NOT definitive (different mesh
+    # object) -> the exemption itself is exercised, no warning
+    import jax as _jax
+    from jax.sharding import Mesh as _Mesh, NamedSharding as _NS, PartitionSpec as _P
+
+    other = _Mesh(np.array(_jax.devices()[:n]), ("other",))
+    cdat = _jax.device_put(
+        jnp.arange(2 * n * n, dtype=jnp.float32).reshape(2 * n, n),
+        _NS(other, _P("other", None)),
+    )
+    with _w.catch_warnings(record=True) as rec:
+        _w.simplefilter("always")
+        out = comm.alltoall(cdat, send_axis=1, recv_axis=1)
+    assert not [w for w in rec if "alltoall" in str(w.message)], rec
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(cdat))

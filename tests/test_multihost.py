@@ -21,7 +21,7 @@ import sys
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
-WORKER = r"""
+PRELUDE = r"""
 import os, sys
 pid = int(sys.argv[1]); port = sys.argv[2]
 os.environ["JAX_PLATFORMS"] = "cpu"
@@ -32,6 +32,9 @@ jax.config.update("jax_cpu_collectives_implementation", "gloo")
 sys.path.insert(0, {repo!r})
 import heat_tpu as ht
 comm = ht.init_multihost(f"127.0.0.1:{{port}}", num_processes=2, process_id=pid)
+"""
+
+WORKER = PRELUDE + r"""
 import numpy as np
 assert comm.size == 8, comm.size
 assert jax.process_count() == 2
@@ -97,3 +100,55 @@ def test_two_process_cluster(tmp_path):
     for i, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"proc {i} failed:\n{out[-4000:]}"
         assert f"proc {i} OK" in out
+
+
+FAIL_WORKER = PRELUDE + r"""
+X = ht.arange(24, dtype=ht.float32, split=0)
+# the save target is an unwritable path: the WRITER (process 0) fails to
+# open it; the error flag must reach process 1 too (ADVICE r2: before the
+# fix only process 0 raised and the cluster diverged)
+failed = False
+try:
+    ht.save_hdf5(X, sys.argv[3], "var")
+except Exception:
+    failed = True
+assert failed, f"proc {{pid}} did not see the writer failure"
+# the cluster is still in lockstep: a collective completes afterwards
+assert float(X.sum()) == 276.0
+print(f"proc {{pid}} SAWFAIL", flush=True)
+"""
+
+
+def test_writer_failure_raises_on_every_process(tmp_path):
+    """A failed save must raise on ALL processes, not just the writer."""
+    worker = tmp_path / "failworker.py"
+    worker.write_text(FAIL_WORKER.format(repo=REPO))
+    bad = str(tmp_path / "no_such_dir" / "out.h5")  # parent doesn't exist
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    env = dict(os.environ)
+    env.pop("PYTHONPATH", None)
+    procs = [
+        subprocess.Popen(
+            [sys.executable, str(worker), str(i), str(port), bad],
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            text=True,
+            env=env,
+        )
+        for i in range(2)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=240)
+            outs.append(out)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    for i, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"proc {i} failed:\n{out[-4000:]}"
+        assert f"proc {i} SAWFAIL" in out
