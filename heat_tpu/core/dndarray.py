@@ -547,11 +547,24 @@ class DNDarray:
         """Convert DNDarray (and numpy-style list) keys to jax arrays, pass
         everything else through.  Lists are advanced-index arrays in
         numpy/reference semantics (dndarray.py:1476) but rejected raw by
-        jax, so they are wrapped here."""
+        jax, so they are wrapped here.
 
-        def one(k):
+        Plain integer keys are bounds-checked on the host: jnp's ``.at``
+        semantics silently CLIP out-of-range indices, so without the check
+        ``x[99] = 1`` on a 5-row array would no-op instead of raising the
+        numpy/reference ``IndexError``."""
+
+        def one(k, dim):
             if isinstance(k, DNDarray):
                 return k.larray
+            if isinstance(k, (int, np.integer)) and not isinstance(k, (bool, np.bool_)):
+                if dim is not None and dim < self.ndim:
+                    n = self.__gshape[dim]
+                    if not -n <= k < n:
+                        raise IndexError(
+                            f"index {k} is out of bounds for axis {dim} with size {n}"
+                        )
+                return k
             if isinstance(k, (list, np.ndarray)):
                 arr = np.asarray(k)
                 if arr.size == 0:  # numpy: a[[]] selects nothing, not float64
@@ -559,9 +572,38 @@ class DNDarray:
                 return jnp.asarray(arr)
             return k
 
+        def consumed(k):
+            # how many array dims key element k consumes
+            if k is None or isinstance(k, (bool, np.bool_)):
+                return 0  # newaxis / scalar-bool mask: adds an axis instead
+            if isinstance(k, (np.ndarray, jnp.ndarray)) and k.dtype == bool:
+                return k.ndim
+            if isinstance(k, DNDarray) and k.dtype is types.bool:
+                return k.ndim
+            return 1
+
         if isinstance(key, tuple):
-            return tuple(one(k) for k in key)
-        return one(key)
+            dims: List[Optional[int]] = []
+            # `Ellipsis in key` would run elementwise == on array keys
+            if any(k is Ellipsis for k in key):
+                e = next(i for i, k in enumerate(key) if k is Ellipsis)
+                dim = 0
+                for k in key[:e]:
+                    dims.append(dim if consumed(k) == 1 else None)
+                    dim += consumed(k)
+                dims.append(None)  # the ellipsis itself
+                tail = key[e + 1 :]
+                dim = self.ndim - sum(consumed(k) for k in tail)
+                for k in tail:
+                    dims.append(dim if consumed(k) == 1 else None)
+                    dim += consumed(k)
+            else:
+                dim = 0
+                for k in key:
+                    dims.append(dim if consumed(k) == 1 else None)
+                    dim += consumed(k)
+            return tuple(one(k, d) for k, d in zip(key, dims))
+        return one(key, 0)
 
     def __result_split(self, key, result_ndim: int) -> Optional[int]:
         """Split bookkeeping for indexing results.

@@ -1,0 +1,172 @@
+"""Per-position value-ordering scenarios for the collectives — the
+global-array analog of the reference's buffer-ordering battery
+(heat/core/tests/test_communication.py:2234-2408: Alltoall axis
+permutations, Scatterv/Gatherv counts and orderings).
+
+The reference asserts which values each RANK's buffer holds after a
+collective; here the falsifiable equivalent is which values each MESH
+POSITION's committed shard holds — checked through
+``jax.Array.addressable_shards`` so mesh construction, chunk geometry,
+and the sharding transformations are pinned together."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import heat_tpu as ht
+
+
+def _comm():
+    return ht.core.communication.get_comm()
+
+
+def _shard_by_position(array, comm):
+    """position -> numpy shard, via the device order of the mesh."""
+    devs = list(np.asarray(comm.mesh.devices).ravel())
+    out = {}
+    for s in array.addressable_shards:
+        out[devs.index(s.device)] = np.asarray(s.data)
+    return out
+
+
+def test_alltoall_row_to_col_positions():
+    """After alltoall(send_axis=1) of a row-stamped matrix, position p's
+    shard holds COLUMN block p — every row's stamp appears in order (the
+    reference's 'main axis send, minor axis receive' case)."""
+    comm = _comm()
+    p = comm.size
+    if p == 1:
+        pytest.skip("needs a mesh")
+    # row i stamped with its owner position i // (rows per shard)
+    rows = 2 * p
+    stamped = np.repeat(np.arange(rows) // 2, 3 * p).reshape(rows, 3 * p).astype(np.float32)
+    x = comm.apply_sharding(jnp.asarray(stamped), 0)
+    y = comm.alltoall(x, send_axis=1, recv_axis=0)
+    shards = _shard_by_position(y, comm)
+    w = 3  # columns per position
+    for pos, shard in shards.items():
+        np.testing.assert_array_equal(shard, stamped[:, pos * w : (pos + 1) * w])
+
+
+def test_alltoall_col_to_row_positions():
+    comm = _comm()
+    p = comm.size
+    if p == 1:
+        pytest.skip("needs a mesh")
+    cols = 2 * p
+    stamped = np.tile(np.arange(cols) // 2, (3 * p, 1)).astype(np.float32)
+    x = comm.apply_sharding(jnp.asarray(stamped), 1)
+    y = comm.alltoall(x, send_axis=0, recv_axis=1)
+    shards = _shard_by_position(y, comm)
+    h = 3  # rows per position
+    for pos, shard in shards.items():
+        np.testing.assert_array_equal(shard, stamped[pos * h : (pos + 1) * h, :])
+
+
+def test_gather_value_ordering():
+    """gather(root) concatenates shards in POSITION order — the Gatherv
+    ordering guarantee (reference test_communication.py: gathered chunks
+    arrive rank-ordered)."""
+    comm = _comm()
+    p = comm.size
+    if p == 1:
+        pytest.skip("needs a mesh")
+    data = np.arange(4 * p * 2, dtype=np.float32).reshape(4 * p, 2)
+    x = comm.apply_sharding(jnp.asarray(data), 0)
+    g = comm.gather(x, root=0)
+    # replicated result, position order == global row order
+    np.testing.assert_array_equal(np.asarray(g), data)
+    shards = _shard_by_position(g, comm)
+    for shard in shards.values():
+        np.testing.assert_array_equal(shard, data)
+
+
+def test_scatter_ownership_matches_chunk():
+    """scatter + chunk() agree on which global rows each position owns —
+    the Scatterv counts/displs contract under the canonical layout."""
+    comm = _comm()
+    p = comm.size
+    if p == 1:
+        pytest.skip("needs a mesh")
+    n = 4 * p
+    data = np.arange(n * 3, dtype=np.float32).reshape(n, 3)
+    x = comm.scatter(jnp.asarray(data), axis=0)
+    shards = _shard_by_position(x, comm)
+    for pos, shard in shards.items():
+        off, lshape, slices = comm.chunk((n, 3), 0, rank=pos)
+        np.testing.assert_array_equal(shard, data[slices])
+        assert shard.shape == lshape
+
+
+def test_ragged_valid_counts_against_numpy_splits():
+    """valid_counts matches numpy's own partition of a ragged axis under
+    ceil-division — the Allgatherv/Scatterv counts analog."""
+    comm = _comm()
+    p = comm.size
+    for n in (4 * p + 1, 4 * p + p - 1, 3, p):
+        counts = comm.valid_counts(n)
+        assert sum(counts) == n
+        c = comm.shard_width(n)
+        for r, cnt in enumerate(counts):
+            assert cnt == max(0, min(c, n - r * c))
+
+
+def test_bcast_nonzero_root_positions():
+    """bcast(root=last) replicates the LAST position's block — root
+    addressing is position-exact, not just root=0 (reference Bcast with
+    arbitrary root)."""
+    comm = _comm()
+    p = comm.size
+    if p == 1:
+        pytest.skip("needs a mesh")
+    data = np.arange(2 * p * 2, dtype=np.float32).reshape(2 * p, 2)
+    x = comm.apply_sharding(jnp.asarray(data), 0)
+    b = comm.bcast(x, root=p - 1)
+    want = data[(p - 1) * 2 : p * 2]
+    np.testing.assert_array_equal(np.asarray(b), want)
+    for shard in _shard_by_position(b, comm).values():
+        np.testing.assert_array_equal(shard, want)
+
+
+def test_ring_permute_position_contents():
+    """ring_permute(shift=k): position pos ends up holding the block that
+    position pos-k held — checked for every position and two shifts."""
+    comm = _comm()
+    p = comm.size
+    if p == 1:
+        pytest.skip("needs a mesh")
+    data = np.repeat(np.arange(p), 3).reshape(p, 3).astype(np.float32)  # block i stamped i
+    x = comm.apply_sharding(jnp.asarray(data), 0)
+    for shift in (1, p - 1):
+        y = comm.ring_permute(x, shift=shift)
+        shards = _shard_by_position(y, comm)
+        for pos, shard in shards.items():
+            assert int(shard[0, 0]) == (pos - shift) % p, (pos, shift, shard)
+
+
+def test_allreduce_op_matrix():
+    """allreduce over per-position blocks for every op, against numpy on
+    the same blocks (reference's op sweep)."""
+    comm = _comm()
+    p = comm.size
+    rng = np.random.default_rng(5)
+    blocks = rng.integers(1, 5, size=(p, 3)).astype(np.float32)
+    arr = comm.apply_sharding(jnp.asarray(blocks), 0)
+    for op, fn in (("sum", np.sum), ("max", np.max), ("min", np.min), ("prod", np.prod)):
+        got = np.asarray(comm.allreduce(arr, op))
+        np.testing.assert_allclose(got, fn(blocks, axis=0), rtol=1e-6)
+
+
+def test_exscan_prefix_ordering():
+    """exscan: position r receives the reduction of blocks 0..r-1 in
+    position order (the Exscan ordering contract)."""
+    comm = _comm()
+    p = comm.size
+    blocks = np.arange(1, p + 1, dtype=np.float32).reshape(p, 1)
+    arr = comm.apply_sharding(jnp.asarray(blocks), 0)
+    got = np.asarray(comm.exscan(arr, "sum"))
+    want = np.concatenate([[0.0], np.cumsum(blocks[:-1, 0])]).reshape(p, 1)
+    np.testing.assert_allclose(got, want)

