@@ -118,15 +118,25 @@ def _timed_fit(km_cls, init_nd, X, iters: int) -> float:
 
 def _slope_rate(timed, lo: int, hi: int, pairs: int = 5) -> float:
     """iter/s from the median of paired (hi - lo) differences of ``timed(n)``
-    (a fenced wall-time sample at iteration count n); first call warms up."""
+    (a fenced wall-time sample at iteration count n); first call warms up.
+
+    When host noise swamps the slope (median difference <= 0 — seen when
+    another process saturates the host), the estimate falls back to the
+    conservative whole-region rate hi / t_hi instead of reporting the
+    absurd clamped reciprocal (BENCH r3: a contended run once printed
+    1e9 iter/s)."""
     timed(lo)  # warmup: compile
-    diffs = []
+    diffs, last_hi = [], None
     for _ in range(pairs):
         t_lo = timed(lo)
         t_hi = timed(hi)
+        last_hi = t_hi
         diffs.append(t_hi - t_lo)
     diffs.sort()
-    return 1.0 / max(diffs[len(diffs) // 2] / (hi - lo), 1e-9)
+    med = diffs[len(diffs) // 2] / (hi - lo)
+    if med <= 1e-7:  # at/below timer resolution: noise won the slope
+        return hi / max(last_hi, 1e-9)
+    return 1.0 / med
 
 
 def _slope_fit_rate(km_cls, init_nd, X, lo: int, hi: int) -> float:
@@ -152,7 +162,9 @@ def heat_kmeans_rate(data: np.ndarray, init: np.ndarray):
         t_hi = _timed_fit(KMeans, init_nd, X, hi)
         diffs.append(t_hi - t_lo)
     diffs.sort()
-    per_iter = max(diffs[len(diffs) // 2] / (hi - lo), 1e-9)
+    per_iter = diffs[len(diffs) // 2] / (hi - lo)
+    if per_iter <= 1e-7:  # at/below timer resolution: noise won the slope
+        per_iter = t_hi / hi
     return 1.0 / per_iter, X
 
 

@@ -636,13 +636,13 @@ def _unique_axis_hashed(
     ``sorted=True`` to additionally lexsort the COMPACTED uniques (a host
     pass over n_unique rows, not the input).
 
-    Data movement note: only the 64-bit key rides the explicit ring sort;
-    the payload permutation ``rows[order]`` is GSPMD-planned, which on a
-    mesh may resolve as a gather — per-device memory must hold the row
-    matrix once.  The TPU-first fix is a distributed take/shuffle
-    primitive (a ragged alltoall by destination shard); until then this
-    path trades the r2 host-memory cap for a per-device HBM cap, which is
-    both larger and orders of magnitude faster to fill."""
+    Data movement: the 64-bit key rides the explicit ring sort, and on a
+    mesh the payload permutation rides :func:`heat_tpu.parallel.ring_take`
+    (blocks rotate; every device answers the queries landing in the
+    visiting block) — bounded at O(rows/p) per-device memory, where the
+    GSPMD gather it replaces replicated the whole row matrix on every
+    device.  The inverse map returns through the dual
+    :func:`heat_tpu.parallel.ring_put`."""
     from ..parallel import sort as _parallel_sort  # lazy: parallel imports core
 
     n = moved.shape[0]
@@ -667,8 +667,17 @@ def _unique_axis_hashed(
             order = ord2[ord1]
         else:
             order = jnp.lexsort((h2, h1))
-        s = rows[order]
-        sh1, sh2 = h1[order], h2[order]
+        if comm is not None and comm.size > 1:
+            from ..parallel import take as _take
+
+            s = _take.ring_take(rows, order.astype(jnp.int32), comm=comm)
+            # the hashes are pure functions of the rows: rehashing the
+            # permuted rows costs one elementwise pass and saves two more
+            # full ring pipelines
+            sh1, sh2 = _hash_rows(_row_words(s), seed)
+        else:
+            s = rows[order]
+            sh1, sh2 = h1[order], h2[order]
         same_hash = (sh1 == jnp.roll(sh1, 1)) & (sh2 == jnp.roll(sh2, 1))
         prev = jnp.roll(s, 1, axis=0)
         neq_el = s != prev
@@ -707,7 +716,14 @@ def _unique_axis_hashed(
     result = _rewrap(a, garr, split, a.dtype)
     if return_inverse:
         sorted_groups = remap[groups] if remap is not None else groups
-        inv = jnp.zeros((n,), jnp.int64).at[order].set(sorted_groups)
+        if comm is not None and comm.size > 1:
+            from ..parallel import take as _take
+
+            inv = _take.ring_put(
+                n, order.astype(jnp.int32), sorted_groups.astype(jnp.int32), comm=comm
+            ).astype(jnp.int64)
+        else:
+            inv = jnp.zeros((n,), jnp.int64).at[order].set(sorted_groups)
         inv_wrapped = factories.array(inv, dtype=types.int64, device=a.device, comm=a.comm)
         return result, inv_wrapped
     return result

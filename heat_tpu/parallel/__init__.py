@@ -34,6 +34,7 @@ from .primitives import (
 )
 from .ring_attention import ring_attention, ring_self_attention
 from .sort import ring_rank_sort, sort_axis0
+from .take import ring_put, ring_take
 from .ulysses import ulysses_attention
 
 __all__ = [
@@ -44,7 +45,9 @@ __all__ = [
     "ring_map",
     "ring_source",
     "ring_attention",
+    "ring_put",
     "ring_rank_sort",
+    "ring_take",
     "sort_axis0",
     "ring_self_attention",
     "ulysses_attention",
