@@ -149,23 +149,15 @@ def heat_kmeans_rate(data: np.ndarray, init: np.ndarray):
 
     X = ht.array(data, split=0)
     init_nd = ht.array(init)
-    _timed_fit(KMeans, init_nd, X, ITERS)  # warmup: compile the fused loop
     # slope window must dwarf tunnel jitter (tens of ms): at ~60 us/iter a
     # 30->150 window spans only ~8 ms of real work, so the measurement
     # drowns; 200->1800 spans ~100 ms and the slope stabilizes.  lo/hi
-    # samples interleave so slow drift (thermal, shared-chip contention)
-    # hits both ends of the slope equally.
-    lo, hi = 200, 1800
-    diffs = []
-    for _ in range(7):  # odd count: index len//2 is the exact median
-        t_lo = _timed_fit(KMeans, init_nd, X, lo)
-        t_hi = _timed_fit(KMeans, init_nd, X, hi)
-        diffs.append(t_hi - t_lo)
-    diffs.sort()
-    per_iter = diffs[len(diffs) // 2] / (hi - lo)
-    if per_iter <= 1e-7:  # at/below timer resolution: noise won the slope
-        per_iter = t_hi / hi
-    return 1.0 / per_iter, X
+    # samples interleave (inside _slope_rate) so slow drift hits both
+    # ends of the slope equally; 7 pairs give an exact median.
+    rate = _slope_rate(
+        lambda iters: _timed_fit(KMeans, init_nd, X, iters), 200, 1800, pairs=7
+    )
+    return rate, X
 
 
 def aux_metrics(data: np.ndarray, X):

@@ -661,10 +661,15 @@ def _unique_axis_hashed(
             # x64 disabled (HEAT_TPU_DISABLE_X64): no uint64 key exists,
             # but two successive STABLE ring sorts — minor key first,
             # then major — compose to the same (h1, h2) lexicographic
-            # order without ever handing GSPMD a sharded variadic sort
+            # order without ever handing GSPMD a sharded variadic sort;
+            # the index compositions ride ring_take for the same
+            # bounded-memory reason as the row payload below
+            from ..parallel import take as _take0
+
             _, ord2 = _parallel_sort.ring_rank_sort(h2, n, comm=comm)
-            _, ord1 = _parallel_sort.ring_rank_sort(h1[ord2], n, comm=comm)
-            order = ord2[ord1]
+            h1p = _take0.ring_take(h1, ord2, comm=comm)
+            _, ord1 = _parallel_sort.ring_rank_sort(h1p, n, comm=comm)
+            order = _take0.ring_take(ord2, ord1, comm=comm)
         else:
             order = jnp.lexsort((h2, h1))
         if comm is not None and comm.size > 1:

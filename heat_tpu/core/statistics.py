@@ -53,16 +53,22 @@ def _argmin_op(a, axis=None, keepdims=False):
     return jnp.argmin(a, axis=axis, keepdims=keepdims)
 
 
-def argmax(x, axis=None, out=None, **kwargs):
+def argmax(x, axis=None, out=None, keepdims=None, keepdim=None, **kwargs):
     """Index of the global maximum (reference statistics.py:41-112; the
     MPI_ARGMAX packed-buffer reduction :1124-1168 is XLA's variadic
     reduce)."""
-    return _operations.__reduce_op(_argmax_op, x, axis, out, dtype=types.int64)
+    keepdims = merge_keepdims(keepdims, keepdim)
+    return _operations.__reduce_op(
+        _argmax_op, x, axis, out, keepdims=keepdims, dtype=types.int64
+    )
 
 
-def argmin(x, axis=None, out=None, **kwargs):
+def argmin(x, axis=None, out=None, keepdims=None, keepdim=None, **kwargs):
     """Index of the global minimum (reference statistics.py:113-185)."""
-    return _operations.__reduce_op(_argmin_op, x, axis, out, dtype=types.int64)
+    keepdims = merge_keepdims(keepdims, keepdim)
+    return _operations.__reduce_op(
+        _argmin_op, x, axis, out, keepdims=keepdims, dtype=types.int64
+    )
 
 
 def average(x: DNDarray, axis=None, weights: Optional[DNDarray] = None, returned: bool = False):
@@ -244,10 +250,32 @@ def skew(x: DNDarray, axis=None, unbiased: bool = True):
     return _wrap_reduced(x, g1, axis)
 
 
+def _nan_propagating(redfn):
+    """NaN-propagating min/max reduction: XLA's cross-shard all-reduce
+    min/max follows IEEE minNum/maxNum (NaN silently loses to any
+    number), so a SHARDED array with a NaN reduced like numpy's min/max
+    would drop it — jnp.min on a single device propagates, the
+    partitioned collective does not.  One extra fused isnan any-reduce
+    restores numpy/reference semantics."""
+
+    def f(a, axis=None, keepdims=False):
+        r = redfn(a, axis=axis, keepdims=keepdims)
+        if jnp.issubdtype(a.dtype, jnp.floating):
+            bad = jnp.any(jnp.isnan(a), axis=axis, keepdims=keepdims)
+            r = jnp.where(bad, jnp.nan, r)
+        return r
+
+    return f
+
+
+_nanprop_min = _nan_propagating(jnp.min)
+_nanprop_max = _nan_propagating(jnp.max)
+
+
 def max(x, axis=None, out=None, keepdims=None, keepdim=None):
     """Maximum along axes (reference statistics.py:616-727)."""
     keepdims = merge_keepdims(keepdims, keepdim)
-    return _operations.__reduce_op(jnp.max, x, axis, out, keepdims=keepdims)
+    return _operations.__reduce_op(_nanprop_max, x, axis, out, keepdims=keepdims)
 
 
 def maximum(x1, x2, out=None):
@@ -286,7 +314,7 @@ def median(x: DNDarray, axis=None, keepdim=None, out=None, keepdims=None):
 def min(x, axis=None, out=None, keepdims=None, keepdim=None):
     """Minimum along axes (reference statistics.py:1058-1123)."""
     keepdims = merge_keepdims(keepdims, keepdim)
-    return _operations.__reduce_op(jnp.min, x, axis, out, keepdims=keepdims)
+    return _operations.__reduce_op(_nanprop_min, x, axis, out, keepdims=keepdims)
 
 
 def minimum(x1, x2, out=None):
@@ -340,6 +368,13 @@ def percentile(x: DNDarray, q, axis=None, out=None, interpolation: str = "linear
         # exactly jnp.percentile's layout; keepdims re-inserts the axis
         if keepdims:
             res = jnp.expand_dims(res, axis=qa.ndim + axis)
+    elif qa.ndim > 1:
+        # jnp.percentile only takes rank-<=1 q; numpy allows any shape —
+        # flatten, compute, and fold the q axes back in front
+        flat = jnp.percentile(
+            arr, qa.reshape(-1), axis=axis, method=method, keepdims=keepdims
+        )
+        res = flat.reshape(qa.shape + flat.shape[1:])
     else:
         res = jnp.percentile(arr, qa, axis=axis, method=method, keepdims=keepdims)
     if np.isscalar(q) or qa.ndim == 0:

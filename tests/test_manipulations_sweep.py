@@ -1,0 +1,204 @@
+"""Manipulations oracle sweep — the scenario grid of the reference's
+3,084-line test_manipulations.py (offset sweeps, pad-mode matrix,
+repeat forms, reshape split rules, stack/split error paths), against
+numpy on every split."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import heat_tpu as ht
+
+SPLITS = [None, 0, 1]
+
+
+@pytest.fixture
+def data():
+    return np.arange(48, dtype=np.float32).reshape(8, 6)
+
+
+@pytest.mark.parametrize("split", SPLITS)
+@pytest.mark.parametrize("offset", [-3, -1, 0, 1, 4])
+def test_diagonal_offsets(data, split, offset):
+    x = ht.array(data, split=split)
+    np.testing.assert_array_equal(
+        np.asarray(ht.diagonal(x, offset=offset).larray), np.diagonal(data, offset=offset)
+    )
+
+
+@pytest.mark.parametrize("offset", [-2, 0, 3])
+def test_diag_construct_and_extract(offset):
+    v = np.arange(5, dtype=np.float32)
+    x = ht.array(v, split=0)
+    np.testing.assert_array_equal(np.asarray(ht.diag(x, offset).larray), np.diag(v, offset))
+    m = np.arange(36, dtype=np.float32).reshape(6, 6)
+    np.testing.assert_array_equal(
+        np.asarray(ht.diag(ht.array(m, split=0), offset).larray), np.diag(m, offset)
+    )
+
+
+@pytest.mark.parametrize("split", SPLITS)
+@pytest.mark.parametrize(
+    "mode", ["constant", "edge", "reflect", "symmetric", "wrap", "maximum", "minimum", "mean"]
+)
+def test_pad_mode_matrix(data, split, mode):
+    x = ht.array(data, split=split)
+    width = ((1, 2), (2, 1))
+    kwargs = {"constant_values": 7} if mode == "constant" else {}
+    got = ht.pad(x, width, mode=mode, **kwargs)
+    want = np.pad(data, width, mode=mode, **kwargs)
+    np.testing.assert_allclose(np.asarray(got.larray), want, rtol=1e-6)
+
+
+def test_pad_torch_mode_aliases(data):
+    x = ht.array(data, split=0)
+    np.testing.assert_array_equal(
+        np.asarray(ht.pad(x, ((1, 1), (0, 0)), mode="replicate").larray),
+        np.pad(data, ((1, 1), (0, 0)), mode="edge"),
+    )
+    np.testing.assert_array_equal(
+        np.asarray(ht.pad(x, ((0, 0), (2, 2)), mode="circular").larray),
+        np.pad(data, ((0, 0), (2, 2)), mode="wrap"),
+    )
+    with pytest.raises(NotImplementedError):
+        ht.pad(x, 1, mode="no_such_mode")
+    with pytest.raises(TypeError):
+        ht.pad(x, 1, mode=3)
+
+
+@pytest.mark.parametrize("split", SPLITS)
+def test_repeat_forms(data, split):
+    x = ht.array(data, split=split)
+    np.testing.assert_array_equal(
+        np.asarray(ht.repeat(x, 3).larray), np.repeat(data, 3)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(ht.repeat(x, 2, axis=0).larray), np.repeat(data, 2, axis=0)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(ht.repeat(x, 2, axis=1).larray), np.repeat(data, 2, axis=1)
+    )
+    reps = np.array([1, 2, 1, 3, 1, 2, 1, 2])
+    np.testing.assert_array_equal(
+        np.asarray(ht.repeat(x, reps, axis=0).larray), np.repeat(data, reps, axis=0)
+    )
+
+
+@pytest.mark.parametrize("split", SPLITS)
+@pytest.mark.parametrize(
+    "new_shape", [(48,), (6, 8), (2, 4, 6), (4, -1), (-1,), (48, 1)]
+)
+def test_reshape_matrix(data, split, new_shape):
+    x = ht.array(data, split=split)
+    got = ht.reshape(x, new_shape)
+    want = data.reshape(new_shape)
+    np.testing.assert_array_equal(np.asarray(got.larray), want)
+    assert got.gshape == want.shape
+    with pytest.raises((ValueError, TypeError)):
+        ht.reshape(x, (5, 5))
+
+
+@pytest.mark.parametrize("split", SPLITS)
+def test_flip_axes_matrix(data, split):
+    x = ht.array(data, split=split)
+    for ax in (None, 0, 1, (0, 1)):
+        np.testing.assert_array_equal(
+            np.asarray(ht.flip(x, ax).larray), np.flip(data, ax)
+        )
+    np.testing.assert_array_equal(np.asarray(ht.fliplr(x).larray), np.fliplr(data))
+    np.testing.assert_array_equal(np.asarray(ht.flipud(x).larray), np.flipud(data))
+    with pytest.raises(IndexError):
+        ht.fliplr(ht.arange(3))
+
+
+@pytest.mark.parametrize("split", [None, 0])
+def test_rot90_k_sweep(data, split):
+    x = ht.array(data, split=split)
+    for k in (-2, -1, 0, 1, 2, 3, 4):
+        np.testing.assert_array_equal(
+            np.asarray(ht.rot90(x, k=k).larray), np.rot90(data, k=k)
+        )
+    with pytest.raises(ValueError):
+        ht.rot90(x, axes=(0, 0))
+
+
+@pytest.mark.parametrize("split", SPLITS)
+def test_squeeze_expand_matrix(split):
+    data = np.arange(12, dtype=np.float32).reshape(3, 1, 4, 1)
+    x = ht.array(data, split=0 if split == 1 else split)
+    np.testing.assert_array_equal(np.asarray(ht.squeeze(x).larray), np.squeeze(data))
+    np.testing.assert_array_equal(
+        np.asarray(ht.squeeze(x, 1).larray), np.squeeze(data, 1)
+    )
+    with pytest.raises(ValueError):
+        ht.squeeze(x, 0)  # size-3 axis cannot squeeze
+    y = ht.arange(6, dtype=ht.float32, split=0)
+    for ax in (0, 1, -1):
+        got = ht.expand_dims(y, ax)
+        want = np.expand_dims(np.arange(6, dtype=np.float32), ax)
+        assert got.gshape == want.shape
+    with pytest.raises(ValueError):
+        ht.expand_dims(y, 5)
+
+
+def test_concatenate_promotion_and_errors():
+    a = ht.array(np.ones((3, 2), np.float32), split=0)
+    b = ht.array(np.ones((2, 2), np.int32), split=0)
+    out = ht.concatenate([a, b], axis=0)
+    assert out.dtype is ht.float32 and out.gshape == (5, 2)
+    with pytest.raises(ValueError):
+        ht.concatenate([a, ht.array(np.ones((3, 3), np.float32))], axis=0)
+    with pytest.raises(ValueError):
+        ht.concatenate([a, ht.arange(3)], axis=0)
+    with pytest.raises(TypeError):
+        ht.concatenate(a, axis=0)
+
+
+@pytest.mark.parametrize("split", [None, 0])
+@pytest.mark.parametrize("largest", [True, False])
+@pytest.mark.parametrize("dim", [0, 1, -1])
+def test_topk_matrix(split, largest, dim):
+    rng = np.random.default_rng(60)
+    data = rng.permutation(48).reshape(8, 6).astype(np.float32)
+    x = ht.array(data, split=split)
+    v, i = ht.topk(x, 3, dim=dim, largest=largest)
+    order = -np.sort(-data, axis=dim) if largest else np.sort(data, axis=dim)
+    take = [slice(None)] * 2
+    take[dim if dim >= 0 else 2 + dim] = slice(0, 3)
+    np.testing.assert_array_equal(np.asarray(v.larray), order[tuple(take)])
+    np.testing.assert_array_equal(
+        np.take_along_axis(data, np.asarray(i.larray), axis=dim), np.asarray(v.larray)
+    )
+
+
+def test_split_error_paths(data):
+    x = ht.array(data, split=0)
+    with pytest.raises(ValueError):
+        ht.split(x, 5, axis=0)  # 8 rows not divisible by 5
+    parts = ht.split(x, [2, 5], axis=0)
+    assert [p.gshape[0] for p in parts] == [2, 3, 3]
+    np.testing.assert_array_equal(np.asarray(parts[1].larray), data[2:5])
+    d3 = ht.array(np.arange(24, dtype=np.float32).reshape(2, 3, 4), split=0)
+    dparts = ht.dsplit(d3, 2)
+    assert dparts[0].gshape == (2, 3, 2)
+
+
+@pytest.mark.parametrize("split", [None, 0])
+def test_unique_return_inverse_sorted_flat(split):
+    rng = np.random.default_rng(61)
+    v = rng.integers(0, 9, size=70).astype(np.int32)
+    x = ht.array(v, split=split)
+    u, inv = ht.unique(x, sorted=True, return_inverse=True)
+    np.testing.assert_array_equal(np.asarray(u.larray), np.unique(v))
+    np.testing.assert_array_equal(np.asarray(u.larray)[np.asarray(inv.larray)], v)
+
+
+def test_flatten_and_shape_helpers(data):
+    x = ht.array(data, split=1)
+    f = ht.flatten(x)
+    assert f.split == 0 and f.gshape == (48,)
+    np.testing.assert_array_equal(np.asarray(f.larray), data.ravel())
+    assert ht.shape(x) == (8, 6)
+    with pytest.raises(TypeError):
+        ht.shape(data)

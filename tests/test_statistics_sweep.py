@@ -1,0 +1,196 @@
+"""Statistics oracle sweep — the scenario dimensions the reference's
+1,334-line test_statistics.py grinds through (axes, keepdims, ddof,
+weights, bins/ranges, NaN propagation, dtype rules), parametrized
+against numpy on every split."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import heat_tpu as ht
+
+
+@pytest.fixture
+def data():
+    rng = np.random.default_rng(50)
+    return rng.normal(size=(12, 7)).astype(np.float32)
+
+
+SPLITS = [None, 0, 1]
+
+
+@pytest.mark.parametrize("split", SPLITS)
+@pytest.mark.parametrize("axis", [None, 0, 1])
+@pytest.mark.parametrize("keepdims", [False, True])
+def test_argmax_argmin_matrix(data, split, axis, keepdims):
+    if axis is None and keepdims:
+        pytest.skip("numpy rejects keepdims for flat argmax/argmin")
+    x = ht.array(data, split=split)
+    got = ht.argmax(x, axis=axis, keepdims=keepdims)
+    want = np.argmax(data, axis=axis, keepdims=keepdims)
+    np.testing.assert_array_equal(np.asarray(got.larray), want)
+    got = ht.argmin(x, axis=axis, keepdims=keepdims)
+    np.testing.assert_array_equal(
+        np.asarray(got.larray), np.argmin(data, axis=axis, keepdims=keepdims)
+    )
+
+
+@pytest.mark.parametrize("split", SPLITS)
+@pytest.mark.parametrize("ddof", [0, 1])
+@pytest.mark.parametrize("axis", [None, 0, 1])
+def test_std_var_ddof_matrix(data, split, ddof, axis):
+    x = ht.array(data, split=split)
+    np.testing.assert_allclose(
+        np.asarray(ht.var(x, axis=axis, ddof=ddof).larray),
+        np.var(data, axis=axis, ddof=ddof),
+        rtol=1e-5,
+    )
+    np.testing.assert_allclose(
+        np.asarray(ht.std(x, axis=axis, ddof=ddof).larray),
+        np.std(data, axis=axis, ddof=ddof),
+        rtol=1e-5,
+    )
+    # ddof beyond 1 is rejected for reference parity (heat restricts it)
+    with pytest.raises(ValueError):
+        ht.var(x, ddof=2)
+
+
+@pytest.mark.parametrize("split", [None, 0])
+def test_average_weights(data, split):
+    x = ht.array(data, split=split)
+    np.testing.assert_allclose(
+        float(ht.average(x).larray), np.average(data), rtol=1e-6
+    )
+    w = np.arange(1.0, 8.0, dtype=np.float32)
+    got = ht.average(x, axis=1, weights=ht.array(w))
+    np.testing.assert_allclose(
+        np.asarray(got.larray), np.average(data, axis=1, weights=w), rtol=1e-5
+    )
+    got, s = ht.average(x, axis=1, weights=ht.array(w), returned=True)
+    np.testing.assert_allclose(np.asarray(s.larray), np.full(12, w.sum()), rtol=1e-6)
+
+
+@pytest.mark.parametrize("split", [None, 0])
+def test_cov_variants(split):
+    rng = np.random.default_rng(51)
+    m = rng.normal(size=(4, 30)).astype(np.float32)
+    x = ht.array(m, split=split)
+    np.testing.assert_allclose(np.asarray(ht.cov(x).larray), np.cov(m), rtol=1e-4)
+    np.testing.assert_allclose(
+        np.asarray(ht.cov(x, bias=True).larray), np.cov(m, bias=True), rtol=1e-4
+    )
+    np.testing.assert_allclose(
+        np.asarray(ht.cov(x, rowvar=False).larray), np.cov(m, rowvar=False), rtol=1e-4
+    )
+    y = ht.array(m[:2], split=split)
+    np.testing.assert_allclose(
+        np.asarray(ht.cov(ht.array(m[2:], split=split), y).larray),
+        np.cov(m[2:], m[:2]),
+        rtol=1e-4,
+    )
+
+
+@pytest.mark.parametrize("split", [None, 0])
+def test_histogram_bins_ranges(split):
+    rng = np.random.default_rng(52)
+    v = rng.normal(size=500).astype(np.float32)
+    x = ht.array(v, split=split)
+    for bins, rng_ in ((10, None), (25, (-2.0, 2.0)), (1, (-1.0, 1.0))):
+        got_h, got_e = ht.histogram(x, bins=bins, range=rng_)
+        want_h, want_e = np.histogram(v, bins=bins, range=rng_)
+        np.testing.assert_array_equal(np.asarray(got_h.larray), want_h)
+        np.testing.assert_allclose(np.asarray(got_e.larray), want_e, rtol=1e-6)
+    hd, ed = ht.histogram(x, bins=10, density=True)
+    wd, we = np.histogram(v, bins=10, density=True)
+    np.testing.assert_allclose(np.asarray(hd.larray), wd, rtol=1e-5)
+
+
+@pytest.mark.parametrize("split", [None, 0])
+def test_histc_torch_semantics(split):
+    v = np.array([0.5, 1.5, 2.5, 2.9, 0.1, 1.1], np.float32)
+    x = ht.array(v, split=split)
+    got = ht.histc(x, bins=3, min=0.0, max=3.0)
+    np.testing.assert_array_equal(np.asarray(got.larray), [2.0, 2.0, 2.0])
+
+
+@pytest.mark.parametrize("split", [None, 0])
+def test_bincount_weights_minlength(split):
+    v = np.array([0, 1, 1, 3, 2, 1, 7], np.int32)
+    x = ht.array(v, split=split)
+    np.testing.assert_array_equal(np.asarray(ht.bincount(x).larray), np.bincount(v))
+    np.testing.assert_array_equal(
+        np.asarray(ht.bincount(x, minlength=12).larray), np.bincount(v, minlength=12)
+    )
+    w = np.linspace(0.1, 0.7, 7).astype(np.float32)
+    np.testing.assert_allclose(
+        np.asarray(ht.bincount(x, weights=ht.array(w, split=split)).larray),
+        np.bincount(v, weights=w),
+        rtol=1e-6,
+    )
+
+
+def test_skew_kurtosis_formulas():
+    """Biased skew/kurtosis against the explicit moment formulas (the
+    reference validates against scipy; formulas avoid the dependency)."""
+    rng = np.random.default_rng(53)
+    v = rng.normal(size=1000).astype(np.float32) ** 3
+    x = ht.array(v, split=0)
+    m = v.mean()
+    m2 = ((v - m) ** 2).mean()
+    m3 = ((v - m) ** 3).mean()
+    m4 = ((v - m) ** 4).mean()
+    np.testing.assert_allclose(
+        float(ht.skew(x, unbiased=False).larray), m3 / m2**1.5, rtol=1e-3
+    )
+    np.testing.assert_allclose(
+        float(ht.kurtosis(x, unbiased=False).larray), m4 / m2**2 - 3.0, rtol=1e-3
+    )
+    # Fischer=False reports plain kurtosis (no -3)
+    np.testing.assert_allclose(
+        float(ht.kurtosis(x, unbiased=False, Fischer=False).larray),
+        m4 / m2**2,
+        rtol=1e-3,
+    )
+
+
+@pytest.mark.parametrize("split", SPLITS)
+def test_minmax_nan_propagation(split):
+    v = np.array([[1.0, np.nan, 3.0], [4.0, 5.0, 6.0]], np.float32)
+    x = ht.array(v, split=split if split != 1 else 1)
+    assert np.isnan(float(ht.min(x).larray)) == np.isnan(np.min(v))
+    assert np.isnan(float(ht.max(x).larray)) == np.isnan(np.max(v))
+    got = ht.maximum(x, ht.zeros_like(x))
+    np.testing.assert_array_equal(
+        np.isnan(np.asarray(got.larray)), np.isnan(np.maximum(v, 0.0))
+    )
+
+
+@pytest.mark.parametrize("split", [None, 0])
+def test_percentile_q_shapes(split):
+    rng = np.random.default_rng(54)
+    v = rng.normal(size=200).astype(np.float32)
+    x = ht.array(v, split=split)
+    # scalar, list, nested array q
+    for q in (50.0, [10.0, 50.0, 90.0], np.array([[25.0], [75.0]])):
+        got = ht.percentile(x, q)
+        want = np.percentile(v, q)
+        np.testing.assert_allclose(np.asarray(got.larray), want, rtol=1e-5, atol=1e-5)
+        assert np.asarray(got.larray).shape == np.shape(want)
+
+
+def test_mean_exact_dtype_promotion():
+    """Exact dtypes promote to float for mean (numpy semantics)."""
+    x = ht.arange(10, dtype=ht.int32, split=0)
+    got = ht.mean(x)
+    assert got.dtype in (ht.float32, ht.float64)
+    assert float(got.larray) == 4.5
+
+
+def test_out_buffers_min_max():
+    data = np.arange(12, dtype=np.float32).reshape(3, 4)
+    x = ht.array(data, split=0)
+    out = ht.zeros(4, dtype=ht.float32)
+    r = ht.min(x, axis=0, out=out)
+    assert r is out
+    np.testing.assert_array_equal(np.asarray(out.larray), data.min(axis=0))
