@@ -664,16 +664,16 @@ def _unique_axis_hashed(
             # order without ever handing GSPMD a sharded variadic sort;
             # the index compositions ride ring_take for the same
             # bounded-memory reason as the row payload below
-            from ..parallel import take as _take0
+            from ..parallel import take as _take
 
             _, ord2 = _parallel_sort.ring_rank_sort(h2, n, comm=comm)
-            h1p = _take0.ring_take(h1, ord2, comm=comm)
+            h1p = _take.ring_take(h1, ord2, comm=comm)
             _, ord1 = _parallel_sort.ring_rank_sort(h1p, n, comm=comm)
-            order = _take0.ring_take(ord2, ord1, comm=comm)
+            order = _take.ring_take(ord2, ord1, comm=comm)
         else:
             order = jnp.lexsort((h2, h1))
         if comm is not None and comm.size > 1:
-            from ..parallel import take as _take
+            from ..parallel import take as _take  # noqa: F811 — lazy per branch
 
             s = _take.ring_take(rows, order.astype(jnp.int32), comm=comm)
             # the hashes are pure functions of the rows: rehashing the

@@ -1,0 +1,178 @@
+"""Factory + elementwise-math oracle sweeps — the scenario grids of the
+reference's test_factories (875 lines) and the trig/exponential/rounding
+suites, parametrized against numpy over dtypes and splits."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import heat_tpu as ht
+
+SPLITS = [None, 0]
+DTYPES = [ht.float32, ht.float64, ht.int32, ht.int64, ht.uint8, ht.bool]
+
+
+@pytest.mark.parametrize("split", SPLITS)
+@pytest.mark.parametrize("dtype", DTYPES)
+def test_factory_dtype_matrix(split, dtype):
+    np_dt = np.dtype(dtype._np_type)
+    for fac, want in (
+        (ht.zeros, np.zeros((6, 4), np_dt)),
+        (ht.ones, np.ones((6, 4), np_dt)),
+    ):
+        got = fac((6, 4), dtype=dtype, split=split)
+        assert got.dtype is dtype and got.split == split
+        np.testing.assert_array_equal(np.asarray(got.larray), want)
+    got = ht.full((6, 4), 3, dtype=dtype, split=split)
+    np.testing.assert_array_equal(np.asarray(got.larray), np.full((6, 4), 3, np_dt))
+
+
+@pytest.mark.parametrize("args", [(7,), (2, 9), (1, 10, 2), (10, 1, -3), (0, 5)])
+def test_arange_forms(args):
+    got = ht.arange(*args, split=0)
+    np.testing.assert_array_equal(np.asarray(got.larray), np.arange(*args))
+
+
+def test_arange_dtype_inference():
+    assert ht.arange(5).dtype is ht.int32  # TPU-first int default
+    assert ht.arange(5.0).dtype in (ht.float32, ht.float64)
+    assert ht.arange(5, dtype=ht.float64).dtype is ht.float64
+
+
+@pytest.mark.parametrize("num", [1, 2, 17, 50])
+@pytest.mark.parametrize("endpoint", [True, False])
+def test_linspace_matrix(num, endpoint):
+    got = ht.linspace(-2.5, 4.0, num, endpoint=endpoint, split=0)
+    want = np.linspace(-2.5, 4.0, num, endpoint=endpoint)
+    np.testing.assert_allclose(np.asarray(got.larray), want, rtol=1e-6)
+    got, step = ht.linspace(0.0, 1.0, num, endpoint=endpoint, retstep=True)
+    _, wstep = np.linspace(0.0, 1.0, num, endpoint=endpoint, retstep=True)
+    if num > 1:
+        assert abs(float(step) - float(wstep)) < 1e-6
+
+
+def test_logspace_and_eye():
+    np.testing.assert_allclose(
+        np.asarray(ht.logspace(0, 3, 7).larray), np.logspace(0, 3, 7), rtol=2e-5
+    )
+    np.testing.assert_array_equal(np.asarray(ht.eye(5).larray), np.eye(5))
+    np.testing.assert_array_equal(
+        np.asarray(ht.eye((3, 6), split=0).larray), np.eye(3, 6)
+    )
+
+
+@pytest.mark.parametrize("split", SPLITS)
+def test_like_family_inherits(split):
+    base = ht.full((5, 3), 2.5, dtype=ht.float32, split=split)
+    for fac, want in (
+        (ht.zeros_like, np.zeros((5, 3), np.float32)),
+        (ht.ones_like, np.ones((5, 3), np.float32)),
+        (ht.empty_like, None),
+    ):
+        got = fac(base)
+        assert got.dtype is base.dtype and got.split == base.split
+        assert got.gshape == base.gshape
+        if want is not None:
+            np.testing.assert_array_equal(np.asarray(got.larray), want)
+    got = ht.full_like(base, 9.0)
+    np.testing.assert_array_equal(np.asarray(got.larray), np.full((5, 3), 9.0, np.float32))
+
+
+def test_array_copy_and_nested_inputs():
+    src = np.arange(6, dtype=np.float32)
+    x = ht.array(src)
+    src[0] = 99.0  # the DNDarray must not alias host memory
+    assert float(x[0].larray) == 0.0
+    y = ht.array([[1, 2], [3, 4]])
+    assert y.dtype is ht.int32 and y.gshape == (2, 2)
+    z = ht.array([[1.5, 2.0]], split=1)
+    assert z.split == 1
+    w = ht.array(x)  # DNDarray passthrough keeps dtype
+    assert w.dtype is x.dtype
+    with pytest.raises((ValueError, TypeError)):
+        ht.array([[1, 2], [3]])  # ragged nesting
+
+
+def test_is_split_single_process_identity():
+    """is_split declares pre-chunked PER-PROCESS data (reference factories
+    is_split contract).  Single-controller single-process, the calling
+    process holds everything, so the global shape equals the local one;
+    the true multi-process concatenation is exercised by
+    tests/test_multihost.py."""
+    local = np.full((2, 3), 1.0, np.float32)
+    x = ht.array(local, is_split=0)
+    assert x.gshape == (2, 3)
+    assert x.split == 0
+    assert float(x.sum().larray) == 6.0
+
+
+UNARY_CASES = [
+    ("sin", np.sin, (-3.0, 3.0)),
+    ("cos", np.cos, (-3.0, 3.0)),
+    ("tan", np.tan, (-1.0, 1.0)),
+    ("arcsin", np.arcsin, (-0.99, 0.99)),
+    ("arccos", np.arccos, (-0.99, 0.99)),
+    ("arctan", np.arctan, (-5.0, 5.0)),
+    ("sinh", np.sinh, (-2.0, 2.0)),
+    ("cosh", np.cosh, (-2.0, 2.0)),
+    ("tanh", np.tanh, (-3.0, 3.0)),
+    ("exp", np.exp, (-3.0, 3.0)),
+    ("expm1", np.expm1, (-1.0, 1.0)),
+    ("exp2", np.exp2, (-3.0, 3.0)),
+    ("log", np.log, (0.1, 9.0)),
+    ("log2", np.log2, (0.1, 9.0)),
+    ("log10", np.log10, (0.1, 9.0)),
+    ("log1p", np.log1p, (-0.9, 9.0)),
+    ("sqrt", np.sqrt, (0.0, 9.0)),
+    ("floor", np.floor, (-3.5, 3.5)),
+    ("ceil", np.ceil, (-3.5, 3.5)),
+    ("trunc", np.trunc, (-3.5, 3.5)),
+    ("round", np.round, (-3.5, 3.5)),
+]
+
+
+@pytest.mark.parametrize("name,npfn,rng_", UNARY_CASES, ids=[c[0] for c in UNARY_CASES])
+@pytest.mark.parametrize("split", SPLITS)
+def test_unary_math_matrix(name, npfn, rng_, split):
+    v = np.linspace(rng_[0], rng_[1], 37, dtype=np.float32)
+    x = ht.array(v, split=split)
+    got = getattr(ht, name)(x)
+    np.testing.assert_allclose(np.asarray(got.larray), npfn(v), rtol=2e-5, atol=2e-6)
+    assert got.split == split
+
+
+def test_round_half_even_and_out():
+    v = np.array([0.5, 1.5, 2.5, -0.5, -1.5], np.float32)
+    x = ht.array(v, split=0)
+    np.testing.assert_array_equal(np.asarray(ht.round(x).larray), np.round(v))
+    out = ht.zeros(5, dtype=ht.float32, split=0)
+    r = ht.round(x, out=out)
+    assert r is out
+    np.testing.assert_array_equal(np.asarray(out.larray), np.round(v))
+
+
+def test_unary_int_promotion():
+    """Trig of exact dtypes promotes to float (numpy semantics)."""
+    x = ht.arange(5, dtype=ht.int32, split=0)
+    got = ht.sin(x)
+    assert got.dtype in (ht.float32, ht.float64)
+    np.testing.assert_allclose(
+        np.asarray(got.larray), np.sin(np.arange(5)), rtol=1e-6
+    )
+
+
+def test_arctan2_degrees_radians():
+    a = np.array([1.0, -1.0, 0.5], np.float32)
+    b = np.array([0.5, 2.0, -0.5], np.float32)
+    x, y = ht.array(a, split=0), ht.array(b, split=0)
+    np.testing.assert_allclose(
+        np.asarray(ht.arctan2(x, y).larray), np.arctan2(a, b), rtol=1e-6
+    )
+    d = np.array([0.0, 90.0, 180.0], np.float32)
+    np.testing.assert_allclose(
+        np.asarray(ht.radians(ht.array(d, split=0)).larray), np.radians(d), rtol=1e-6
+    )
+    np.testing.assert_allclose(
+        np.asarray(ht.degrees(ht.array(np.radians(d), split=0)).larray), d, rtol=1e-5, atol=1e-4
+    )

@@ -1,0 +1,105 @@
+"""Random-module contract sweep — the reference's test_random.py (420
+lines) scenarios: seeded reproducibility, state get/set, distribution
+ranges and moments, randperm/permutation validity, dtype/split rules."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import heat_tpu as ht
+
+
+def test_seed_reproducibility_across_calls():
+    ht.random.seed(1234)
+    a = ht.random.rand(5, 4, split=0).numpy()
+    b = ht.random.rand(5, 4, split=0).numpy()
+    assert not np.array_equal(a, b)  # stream advances
+    ht.random.seed(1234)
+    a2 = ht.random.rand(5, 4, split=0).numpy()
+    b2 = ht.random.rand(5, 4, split=0).numpy()
+    np.testing.assert_array_equal(a, a2)
+    np.testing.assert_array_equal(b, b2)
+
+
+def test_state_roundtrip():
+    ht.random.seed(7)
+    _ = ht.random.rand(3, 3)
+    st = ht.random.get_state()
+    x = ht.random.randn(4, split=0).numpy()
+    ht.random.set_state(st)
+    y = ht.random.randn(4, split=0).numpy()
+    np.testing.assert_array_equal(x, y)
+    assert st[0] == "Threefry" or isinstance(st[0], str)
+
+
+@pytest.mark.parametrize("split", [None, 0])
+def test_rand_range_and_moments(split):
+    ht.random.seed(0)
+    x = ht.random.rand(2000, split=split).numpy()
+    assert ((x >= 0) & (x < 1)).all()
+    assert abs(x.mean() - 0.5) < 0.05
+    g = ht.random.randn(5000, split=split).numpy()
+    assert abs(g.mean()) < 0.1 and abs(g.std() - 1.0) < 0.1
+
+
+def test_uniform_bounds():
+    ht.random.seed(3)
+    x = ht.random.uniform(-4.0, -1.0, size=(500,), split=0).numpy()
+    assert ((x >= -4.0) & (x < -1.0)).all()
+
+
+@pytest.mark.parametrize("dtype", [ht.int32, ht.int64])
+def test_randint_range_dtype(dtype):
+    ht.random.seed(9)
+    x = ht.random.randint(3, 17, size=(400,), dtype=dtype, split=0)
+    assert x.dtype is dtype
+    v = x.numpy()
+    assert ((v >= 3) & (v < 17)).all()
+    assert len(np.unique(v)) > 5  # actually random
+    lo_only = ht.random.randint(4, size=(100,)).numpy()
+    assert ((lo_only >= 0) & (lo_only < 4)).all()
+
+
+@pytest.mark.parametrize("split", [None, 0])
+def test_randperm_is_permutation(split):
+    ht.random.seed(11)
+    for n in (1, 7, 64, 101):
+        p = ht.random.randperm(n, split=split).numpy()
+        np.testing.assert_array_equal(np.sort(p), np.arange(n))
+    assert ht.random.randperm(5).dtype is ht.int64
+
+
+def test_permutation_forms():
+    ht.random.seed(13)
+    # int argument behaves like randperm
+    p = ht.random.permutation(6).numpy()
+    np.testing.assert_array_equal(np.sort(p), np.arange(6))
+    # array argument permutes rows, preserving the multiset
+    data = np.arange(24, dtype=np.float32).reshape(8, 3)
+    out = ht.random.permutation(ht.array(data, split=0)).numpy()
+    np.testing.assert_array_equal(
+        np.sort(out.reshape(-1)), np.sort(data.reshape(-1))
+    )
+    rows = {tuple(r) for r in out}
+    assert rows == {tuple(r) for r in data}  # whole rows moved
+
+
+def test_shape_and_split_bookkeeping():
+    x = ht.random.rand(6, 4, split=1)
+    assert x.gshape == (6, 4) and x.split == 1
+    y = ht.random.randn(12, split=0)
+    assert y.split == 0
+    s = ht.random.rand()
+    assert s.gshape in ((), (1,))
+
+
+def test_documented_stream_divergence():
+    """The counter-based threefry stream is documented to differ from the
+    reference's torch streams — but it must be platform-stable: the same
+    seed gives the same values regardless of split."""
+    ht.random.seed(42)
+    a = ht.random.rand(16, split=0).numpy()
+    ht.random.seed(42)
+    b = ht.random.rand(16, split=None).numpy()
+    np.testing.assert_array_equal(a, b)
