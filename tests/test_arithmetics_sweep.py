@@ -1,0 +1,229 @@
+"""Arithmetics / relational / logical oracle sweeps — the reference's
+test_arithmetics (707 lines) and relational/logical suites: binary-op
+broadcasting matrix, mixed-split rules, type promotion, integer/bitwise
+semantics, cumulative ops, diff forms — against numpy on every split."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import heat_tpu as ht
+
+SPLITS = [None, 0, 1]
+
+
+@pytest.fixture
+def ab():
+    rng = np.random.default_rng(70)
+    return (
+        rng.normal(size=(6, 8)).astype(np.float32),
+        rng.normal(size=(6, 8)).astype(np.float32) + 1.5,
+    )
+
+
+BINOPS = [
+    ("add", np.add),
+    ("sub", np.subtract),
+    ("mul", np.multiply),
+    ("div", np.divide),
+    ("pow", None),  # numpy pow of negatives**fractional nans; handled below
+    ("fmod", np.fmod),
+    ("minimum", np.minimum),
+    ("maximum", np.maximum),
+]
+
+
+@pytest.mark.parametrize("name,npfn", BINOPS, ids=[b[0] for b in BINOPS])
+@pytest.mark.parametrize("split", SPLITS)
+def test_binary_op_matrix(ab, name, npfn, split):
+    a, b = ab
+    if name == "pow":
+        a, npfn = np.abs(a) + 0.1, np.power
+    x, y = ht.array(a, split=split), ht.array(b, split=split)
+    got = getattr(ht, name)(x, y)
+    np.testing.assert_allclose(np.asarray(got.larray), npfn(a, b), rtol=1e-5)
+    assert got.split == split
+
+
+@pytest.mark.parametrize("split", SPLITS)
+def test_broadcasting_shapes(split):
+    a = np.arange(24, dtype=np.float32).reshape(6, 4)
+    x = ht.array(a, split=split)
+    # scalar, row, column, and (1,1) broadcasts
+    for other in (2.5, np.arange(4, dtype=np.float32), a[:, :1], np.float32(3)):
+        o = other if np.isscalar(other) or isinstance(other, np.float32) else ht.array(other)
+        got = x + o
+        want = a + (other if not isinstance(o, ht.DNDarray) else np.asarray(other))
+        np.testing.assert_allclose(np.asarray(got.larray), want, rtol=1e-6)
+
+
+def test_mixed_split_binary():
+    """split=0 (+) replicated and split=0 (+) split=0 work; the result
+    carries the operands' split."""
+    a = np.arange(12, dtype=np.float32).reshape(4, 3)
+    s0 = ht.array(a, split=0)
+    rep = ht.array(a)
+    np.testing.assert_array_equal(np.asarray((s0 + rep).larray), a + a)
+    np.testing.assert_array_equal(np.asarray((rep + s0).larray), a + a)
+    s1 = ht.array(a, split=1)
+    out = s0 * s1  # layouts differ: values still exact
+    np.testing.assert_array_equal(np.asarray(out.larray), a * a)
+
+
+def test_promotion_matrix():
+    cases = [
+        (ht.int32, ht.float32, ht.float32),
+        (ht.uint8, ht.int32, ht.int32),
+        (ht.bool, ht.int32, ht.int32),
+        (ht.float32, ht.float64, ht.float64),
+        (ht.int32, ht.int64, ht.int64),
+    ]
+    for da, db, want in cases:
+        x = ht.ones(4, dtype=da, split=0)
+        y = ht.ones(4, dtype=db, split=0)
+        assert (x + y).dtype is want, (da, db, (x + y).dtype)
+
+
+def test_integer_semantics():
+    a = np.array([7, -7, 9, -9], np.int32)
+    b = np.array([3, 3, -4, -4], np.int32)
+    x, y = ht.array(a, split=0), ht.array(b, split=0)
+    np.testing.assert_array_equal(np.asarray(ht.floordiv(x, y).larray), a // b)
+    np.testing.assert_array_equal(np.asarray(ht.mod(x, y).larray), np.mod(a, b))
+    np.testing.assert_array_equal(np.asarray(ht.fmod(x, y).larray), np.fmod(a, b))
+
+
+def test_bitwise_and_shifts():
+    a = np.array([0b1100, 0b1010, 255, 1], np.int32)
+    b = np.array([0b1010, 0b0110, 15, 3], np.int32)
+    x, y = ht.array(a, split=0), ht.array(b, split=0)
+    for name, npfn in (
+        ("bitwise_and", np.bitwise_and),
+        ("bitwise_or", np.bitwise_or),
+        ("bitwise_xor", np.bitwise_xor),
+        ("left_shift", np.left_shift),
+        ("right_shift", np.right_shift),
+    ):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(ht, name)(x, y).larray), npfn(a, b)
+        )
+    np.testing.assert_array_equal(np.asarray(ht.invert(x).larray), np.invert(a))
+    with pytest.raises(TypeError):
+        ht.bitwise_and(ht.array(a.astype(np.float32)), y)
+
+
+@pytest.mark.parametrize("split", [None, 0, 1])
+@pytest.mark.parametrize("axis", [0, 1])
+def test_cumsum_cumprod_matrix(split, axis):
+    rng = np.random.default_rng(71)
+    a = rng.uniform(0.5, 1.5, size=(9, 5)).astype(np.float32)
+    x = ht.array(a, split=split)
+    np.testing.assert_allclose(
+        np.asarray(ht.cumsum(x, axis).larray), np.cumsum(a, axis), rtol=1e-5
+    )
+    np.testing.assert_allclose(
+        np.asarray(ht.cumprod(x, axis).larray), np.cumprod(a, axis), rtol=1e-4
+    )
+
+
+@pytest.mark.parametrize("split", [None, 0])
+@pytest.mark.parametrize("n", [1, 2, 3])
+def test_diff_orders(split, n):
+    rng = np.random.default_rng(72)
+    a = rng.normal(size=(12,)).astype(np.float32)
+    x = ht.array(a, split=split)
+    np.testing.assert_allclose(
+        np.asarray(ht.diff(x, n=n).larray), np.diff(a, n=n), rtol=2e-4, atol=2e-5
+    )
+    m = rng.normal(size=(6, 7)).astype(np.float32)
+    np.testing.assert_allclose(
+        np.asarray(ht.diff(ht.array(m, split=split), n=n, axis=1).larray),
+        np.diff(m, n=n, axis=1),
+        rtol=2e-4,
+        atol=2e-5,
+    )
+
+
+@pytest.mark.parametrize("split", SPLITS)
+def test_relational_matrix(ab, split):
+    a, b = ab
+    x, y = ht.array(a, split=split), ht.array(b, split=split)
+    for name, npfn in (
+        ("eq", np.equal), ("ne", np.not_equal), ("lt", np.less),
+        ("le", np.less_equal), ("gt", np.greater), ("ge", np.greater_equal),
+    ):
+        got = getattr(ht, name)(x, y)
+        np.testing.assert_array_equal(np.asarray(got.larray), npfn(a, b))
+        assert got.dtype is ht.bool
+
+
+def test_equal_whole_array_semantics(ab):
+    a, _ = ab
+    x = ht.array(a, split=0)
+    assert ht.equal(x, ht.array(a.copy(), split=0))
+    assert not ht.equal(x, x + 1.0)
+
+
+@pytest.mark.parametrize("split", [None, 0])
+def test_all_any_allclose(split):
+    a = np.array([[True, True], [True, False]])
+    x = ht.array(a, split=split)
+    assert bool(ht.all(x).larray) == a.all()
+    assert bool(ht.any(x).larray) == a.any()
+    np.testing.assert_array_equal(np.asarray(ht.all(x, axis=0).larray), a.all(axis=0))
+    f = ht.array(np.array([1.0, 1.0 + 1e-9], np.float32), split=split)
+    g = ht.array(np.array([1.0, 1.0], np.float32), split=split)
+    assert ht.allclose(f, g)
+    assert not ht.allclose(f, g + 1.0)
+    np.testing.assert_array_equal(
+        np.asarray(ht.isclose(f, g + 1e-7, atol=1e-5).larray), [True, True]
+    )
+
+
+def test_logical_ops_bool_coercion():
+    a = np.array([True, True, False, False])
+    b = np.array([True, False, True, False])
+    x, y = ht.array(a, split=0), ht.array(b, split=0)
+    np.testing.assert_array_equal(np.asarray(ht.logical_and(x, y).larray), a & b)
+    np.testing.assert_array_equal(np.asarray(ht.logical_or(x, y).larray), a | b)
+    np.testing.assert_array_equal(np.asarray(ht.logical_xor(x, y).larray), a ^ b)
+    np.testing.assert_array_equal(np.asarray(ht.logical_not(x).larray), ~a)
+
+
+def test_nan_special_predicates():
+    v = np.array([np.nan, np.inf, -np.inf, 0.0, 1.0], np.float32)
+    x = ht.array(v, split=0)
+    np.testing.assert_array_equal(np.asarray(ht.isnan(x).larray), np.isnan(v))
+    np.testing.assert_array_equal(np.asarray(ht.isinf(x).larray), np.isinf(v))
+    np.testing.assert_array_equal(np.asarray(ht.isfinite(x).larray), np.isfinite(v))
+    np.testing.assert_array_equal(np.asarray(ht.isposinf(x).larray), np.isposinf(v))
+    np.testing.assert_array_equal(np.asarray(ht.isneginf(x).larray), np.isneginf(v))
+
+
+@pytest.mark.parametrize("splits", [(0, 0), (0, 1), (1, 0), (1, 1), (None, 0)])
+def test_matmul_split_combination_values(splits):
+    """All matmul split combinations produce numpy-exact values (the
+    reference's 4-way split00/01/10/11 SUMMA battery, linalg tests)."""
+    rng = np.random.default_rng(73)
+    a = rng.normal(size=(16, 24)).astype(np.float32)
+    b = rng.normal(size=(24, 8)).astype(np.float32)
+    x = ht.array(a, split=splits[0])
+    y = ht.array(b, split=splits[1])
+    got = np.asarray((x @ y).larray)
+    np.testing.assert_allclose(got, a @ b, rtol=1e-4, atol=1e-4)
+
+
+@pytest.mark.parametrize("split", [0, 1])
+@pytest.mark.parametrize("shape", [(64, 8), (37, 5), (16, 16)])
+def test_qr_property_sweep(split, shape):
+    """Q orthonormal, R upper-triangular, QR == A — property-based across
+    shapes and splits (reference test_qr loops st/sp/sz grids)."""
+    rng = np.random.default_rng(74)
+    a = rng.normal(size=shape).astype(np.float32)
+    x = ht.array(a, split=split)
+    q, r = ht.linalg.qr(x)
+    qn, rn = np.asarray(q.resplit(None).larray), np.asarray(r.resplit(None).larray)
+    np.testing.assert_allclose(qn @ rn, a, atol=5e-4)
+    np.testing.assert_allclose(qn.T @ qn, np.eye(qn.shape[1]), atol=5e-4)
+    np.testing.assert_allclose(rn, np.triu(rn), atol=1e-6)
