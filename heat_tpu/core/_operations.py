@@ -86,9 +86,21 @@ def __binary_op(
     elif isinstance(t1, DNDarray):
         anchor = t1
         if isinstance(t2, DNDarray):
-            # split alignment (reference :85-97 raises for mixed splits;
-            # we reshard t2 to t1's split — one XLA collective)
-            if t2.split != t1.split and t1.ndim == t2.ndim:
+            if t1.split is None and t2.split is not None:
+                # replicated (op) split: the result carries the non-None
+                # split (reference :85-97 — a replicated operand adopts
+                # the other's layout).  Anchoring on the replicated side
+                # would also GATHER the split operand — strictly worse.
+                anchor = t2
+            elif (
+                t2.split is not None
+                and t2.split != t1.split
+                and t1.ndim == t2.ndim
+            ):
+                # both split, differently: reshard t2 to t1's layout (the
+                # reference raises here; one XLA collective instead).  A
+                # replicated t2 is excluded: GSPMD consumes it in place,
+                # and resharding it would be a pointless eager dispatch.
                 t2 = t2.resplit(t1.split)
     else:
         raise TypeError(f"expected a DNDarray or scalar, got {type(t1)}")
