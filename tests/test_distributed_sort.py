@@ -236,11 +236,11 @@ def test_kmedians_bisection_medians_exact():
             arr = jnp.asarray(rng.normal(size=(n, f)).astype(np.float32))
         labels = jnp.asarray(rng.integers(0, k, size=n).astype(np.int32))
         labels = jnp.where(labels == k - 1, 0, labels)  # force empty cluster
-        svals = _presort_values(arr)
+        svals, fmin, fmax = _presort_values(arr)
         member = labels[:, None] == jnp.arange(k)
         onehot = member.astype(jnp.float32)
         counts = jnp.sum(member, axis=0, dtype=jnp.int32)
-        med = np.asarray(_cluster_medians(arr, svals, onehot, counts, k))
+        med = np.asarray(_cluster_medians(arr, svals, fmin, fmax, onehot, counts, k))
         lab = np.asarray(labels)
         for c in range(k):
             m = lab == c
@@ -265,11 +265,11 @@ def test_kmedians_medians_nan_rows_do_not_poison_clean_clusters():
     labels[:32] = k - 1
     arr = jnp.asarray(x)
     lab = jnp.asarray(labels)
-    svals = _presort_values(arr)
+    svals, fmin, fmax = _presort_values(arr)
     member = lab[:, None] == jnp.arange(k)
     onehot = member.astype(jnp.float32)
     counts = jnp.sum(member, axis=0, dtype=jnp.int32)
-    med = np.asarray(_cluster_medians(arr, svals, onehot, counts, k))
+    med = np.asarray(_cluster_medians(arr, svals, fmin, fmax, onehot, counts, k))
     for c in range(k - 1):  # the clean clusters stay exact
         m = labels == c
         np.testing.assert_allclose(
