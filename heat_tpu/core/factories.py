@@ -107,7 +107,14 @@ def array(
     elif isinstance(obj, (jnp.ndarray, jax.Array)):
         garr = obj
     else:
-        garr = jnp.asarray(np.asarray(obj))
+        # copy HOST-side before the one transfer: np.asarray aliases any
+        # buffer-protocol input (ndarray, memoryview, array.array), and on
+        # the CPU backend jnp.asarray can then zero-copy that alias — a
+        # caller mutating their source would mutate the DNDarray
+        # (observed as an alignment-dependent flake).  A fresh host copy
+        # is owned by nobody else, so the later jnp aliasing is harmless,
+        # and accelerator backends pay no second device-side copy.
+        garr = jnp.asarray(np.array(obj, copy=True if copy else None))
 
     # dtype resolution: heat defaults promote python float data to float32
     # (reference factories.py:240-260)

@@ -84,6 +84,12 @@ def test_array_copy_and_nested_inputs():
     x = ht.array(src)
     src[0] = 99.0  # the DNDarray must not alias host memory
     assert float(x[0].larray) == 0.0
+    # buffer-protocol inputs alias through np.asarray the same way
+    # (regression: the CPU backend can zero-copy aligned host buffers)
+    buf = bytearray(np.arange(4, dtype=np.float32).tobytes())
+    y = ht.array(memoryview(buf).cast("f"))
+    buf[0:4] = np.float32(77.0).tobytes()
+    assert float(y[0].larray) == 0.0
     y = ht.array([[1, 2], [3, 4]])
     assert y.dtype is ht.int32 and y.gshape == (2, 2)
     z = ht.array([[1.5, 2.0]], split=1)
