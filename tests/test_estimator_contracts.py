@@ -1,0 +1,149 @@
+"""Estimator API contracts + algorithm properties beyond fit-quality:
+get/set_params round-trips, refit reuse, predict consistency, medoid
+membership, Lasso shrinkage monotonicity, solver edge parameters — the
+reference's test_base/estimator scenario layer."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import heat_tpu as ht
+
+
+def _blobs(n=600, f=4, k=3, seed=80):
+    rng = np.random.default_rng(seed)
+    centers = rng.normal(scale=8, size=(k, f)).astype(np.float32)
+    data = np.concatenate(
+        [c + rng.normal(size=(n // k, f)).astype(np.float32) for c in centers]
+    )
+    rng.shuffle(data)
+    return data
+
+
+@pytest.mark.parametrize(
+    "cls,kwargs",
+    [
+        (ht.cluster.KMeans, {"n_clusters": 4, "max_iter": 7}),
+        (ht.cluster.KMedians, {"n_clusters": 3, "tol": 1e-3}),
+        (ht.cluster.KMedoids, {"n_clusters": 3}),
+        (ht.regression.Lasso, {"lam": 0.3, "max_iter": 11}),
+        (ht.classification.KNN, None),
+        (ht.naive_bayes.GaussianNB, {}),
+    ],
+)
+def test_get_set_params_roundtrip(cls, kwargs):
+    if cls is ht.classification.KNN:
+        x = ht.array(np.zeros((4, 2), np.float32))
+        y = ht.array(np.array([0, 1, 0, 1]))
+        est = cls(x, y, 2)
+    else:
+        est = cls(**kwargs)
+    params = est.get_params()
+    assert isinstance(params, dict) and params
+    est2 = cls(x, y, 2) if cls is ht.classification.KNN else cls()
+    est2.set_params(**params)
+    for key, val in params.items():
+        got = est2.get_params()[key]
+        if isinstance(val, (int, float, str, type(None))):
+            assert got == val, key
+
+
+def test_estimator_predicates():
+    km = ht.cluster.KMeans(n_clusters=2)
+    la = ht.regression.Lasso()
+    nb = ht.naive_bayes.GaussianNB()
+    from heat_tpu.core.base import is_classifier, is_clusterer, is_estimator, is_regressor
+
+    assert is_estimator(km) and is_clusterer(km)
+    assert is_regressor(la) and not is_clusterer(la)
+    assert is_classifier(nb)
+
+
+def test_kmeans_refit_and_predict_consistency():
+    data = _blobs()
+    X = ht.array(data, split=0)
+    km = ht.cluster.KMeans(n_clusters=3, random_state=0)
+    labels1 = km.fit_predict(X)
+    # predict on the training data matches the fit labels
+    labels2 = km.predict(X)
+    np.testing.assert_array_equal(np.asarray(labels1.larray), np.asarray(labels2.larray))
+    # a refit on different data reuses the estimator cleanly
+    data2 = _blobs(seed=81)
+    km.fit(ht.array(data2, split=0))
+    assert km.cluster_centers_.shape == (3, data2.shape[1])
+    # predict assigns each point to its nearest centroid
+    cc = np.asarray(km.cluster_centers_.larray)
+    lab = np.asarray(km.predict(ht.array(data2[:50], split=0)).larray)
+    d2 = ((data2[:50, None, :] - cc[None, :, :]) ** 2).sum(-1)
+    np.testing.assert_array_equal(lab.ravel(), d2.argmin(1))
+
+
+def test_kmedoids_centers_are_datapoints():
+    data = _blobs(n=300, k=3)
+    X = ht.array(data, split=0)
+    km = ht.cluster.KMedoids(n_clusters=3, random_state=1).fit(X)
+    med = np.asarray(km.cluster_centers_.larray)
+    rows = {tuple(np.round(r, 5)) for r in data}
+    for m in med:
+        assert tuple(np.round(m, 5)) in rows  # each medoid IS a datapoint
+
+
+def test_lasso_shrinkage_monotone():
+    """Stronger regularization shrinks the coefficient norm (the basic
+    Lasso property the reference's fit test implies)."""
+    rng = np.random.default_rng(82)
+    Xd = rng.normal(size=(500, 6)).astype(np.float32)
+    w = np.array([3.0, -2.0, 0.0, 0.0, 1.0, 0.0], np.float32)
+    yd = Xd @ w + 0.05 * rng.normal(size=500).astype(np.float32)
+    X, y = ht.array(Xd, split=0), ht.array(yd, split=0)
+    norms = []
+    for lam in (0.01, 0.5, 5.0):
+        est = ht.regression.Lasso(lam=lam, max_iter=100)
+        est.fit(X, y)
+        norms.append(float(np.abs(np.asarray(est.coef_.numpy())).sum()))
+    assert norms[0] > norms[1] > norms[2]
+    # the small-lam fit recovers the support
+    est = ht.regression.Lasso(lam=0.01, max_iter=200)
+    est.fit(X, y)
+    coef = np.asarray(est.coef_.numpy()).ravel()
+    assert abs(coef[0] - 3.0) < 0.3 and abs(coef[1] + 2.0) < 0.3
+
+
+def test_cg_matches_direct_solve_and_maxit():
+    rng = np.random.default_rng(83)
+    a = rng.normal(size=(24, 24)).astype(np.float32)
+    spd = a @ a.T + 24 * np.eye(24, dtype=np.float32)
+    b = rng.normal(size=24).astype(np.float32)
+    A = ht.array(spd, split=0)
+    B = ht.array(b, split=0)
+    x0 = ht.zeros(24, dtype=ht.float32, split=0)
+    x = ht.linalg.cg(A, B, x0)
+    np.testing.assert_allclose(
+        np.asarray(x.larray), np.linalg.solve(spd, b), rtol=1e-2, atol=1e-2
+    )
+
+
+def test_lanczos_orthonormal_basis():
+    rng = np.random.default_rng(84)
+    a = rng.normal(size=(30, 30)).astype(np.float32)
+    spd = a @ a.T + 30 * np.eye(30, dtype=np.float32)
+    A = ht.array(spd, split=0)
+    V, T = ht.linalg.lanczos(A, 8)
+    Vn = np.asarray(V.resplit(None).larray)
+    np.testing.assert_allclose(Vn.T @ Vn, np.eye(Vn.shape[1]), atol=2e-2)
+    Tn = np.asarray(T.resplit(None).larray)
+    # T is tridiagonal
+    assert abs(np.triu(Tn, 2)).max() < 2e-2 and abs(np.tril(Tn, -2)).max() < 2e-2
+
+
+def test_gaussian_nb_proba_normalized():
+    data = _blobs(n=300, k=2)
+    yd = (data[:, 0] > data[:, 0].mean()).astype(np.int32)
+    X = ht.array(data, split=0)
+    y = ht.array(yd, split=0)
+    nb = ht.naive_bayes.GaussianNB().fit(X, y)
+    proba = np.asarray(nb.predict_proba(X).larray)
+    np.testing.assert_allclose(proba.sum(axis=1), 1.0, rtol=1e-4)
+    pred = np.asarray(nb.predict(X).larray).ravel()
+    np.testing.assert_array_equal(pred, proba.argmax(axis=1))
