@@ -554,9 +554,23 @@ class DNDarray:
         ``x[99] = 1`` on a 5-row array would no-op instead of raising the
         numpy/reference ``IndexError``."""
 
-        def one(k, dim):
+        def pre(k):
+            # one-time normalization (lists/DNDarrays convert exactly once,
+            # before both the dim counting and the per-dim pass)
             if isinstance(k, DNDarray):
                 return k.larray
+            if isinstance(k, list):
+                return np.asarray(k)
+            return k
+
+        def one(k, dim):
+            if isinstance(k, np.ndarray) and k.ndim == 0 and np.issubdtype(k.dtype, np.integer):
+                # numpy semantics: a host 0-d integer array key behaves like
+                # the scalar int — route it through the same bounds check
+                # (jnp's .at clips silently otherwise).  Device (jnp) 0-d
+                # keys pass through: converting them would force a blocking
+                # device→host sync per index.
+                k = int(k)
             if isinstance(k, (int, np.integer)) and not isinstance(k, (bool, np.bool_)):
                 if dim is not None and dim < self.ndim:
                     n = self.__gshape[dim]
@@ -565,24 +579,23 @@ class DNDarray:
                             f"index {k} is out of bounds for axis {dim} with size {n}"
                         )
                 return k
-            if isinstance(k, (list, np.ndarray)):
-                arr = np.asarray(k)
-                if arr.size == 0:  # numpy: a[[]] selects nothing, not float64
-                    arr = arr.astype(np.int32)
-                return jnp.asarray(arr)
+            if isinstance(k, np.ndarray):
+                if k.size == 0:  # numpy: a[[]] selects nothing, not float64
+                    k = k.astype(np.int32)
+                return jnp.asarray(k)
             return k
 
         def consumed(k):
-            # how many array dims key element k consumes
+            # how many array dims key element k consumes (keys are
+            # pre-normalized: no lists or DNDarrays reach here)
             if k is None or isinstance(k, (bool, np.bool_)):
                 return 0  # newaxis / scalar-bool mask: adds an axis instead
             if isinstance(k, (np.ndarray, jnp.ndarray)) and k.dtype == bool:
                 return k.ndim
-            if isinstance(k, DNDarray) and k.dtype is types.bool:
-                return k.ndim
             return 1
 
         if isinstance(key, tuple):
+            key = tuple(pre(k) for k in key)
             dims: List[Optional[int]] = []
             # `Ellipsis in key` would run elementwise == on array keys
             if any(k is Ellipsis for k in key):
@@ -603,7 +616,7 @@ class DNDarray:
                     dims.append(dim if consumed(k) == 1 else None)
                     dim += consumed(k)
             return tuple(one(k, d) for k, d in zip(key, dims))
-        return one(key, 0)
+        return one(pre(key), 0)
 
     def __result_split(self, key, result_ndim: int) -> Optional[int]:
         """Split bookkeeping for indexing results.

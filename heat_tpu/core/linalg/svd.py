@@ -35,6 +35,11 @@ def _jitted_svd(a):
     # every invocation (~1.2 s each on the TPU)
     return jnp.linalg.svd(a, full_matrices=False)
 
+
+@_jax.jit
+def _jitted_singvals(a):
+    return jnp.linalg.svd(a, compute_uv=False)
+
 from .. import types
 from ..dndarray import DNDarray
 from ..sanitation import sanitize_in
@@ -70,9 +75,13 @@ def _small_svd(r: jnp.ndarray):
 
 
 def _small_singvals(r: jnp.ndarray):
-    if _host_svd():
+    """Singular values of the reduced factor, same device/host policy and
+    x64 guard as :func:`_small_svd` (an f64 lowering under the package's
+    x64-on default is the documented crash combination on TPU)."""
+    if _host_svd() or r.dtype == jnp.float64:
         return jnp.asarray(np.linalg.svd(np.asarray(r), compute_uv=False), r.dtype)
-    return jnp.linalg.svd(r, compute_uv=False)
+    with _jax.enable_x64(False):
+        return _jitted_singvals(r)
 
 
 def svd(a: DNDarray, full_matrices: bool = False, compute_uv: bool = True):

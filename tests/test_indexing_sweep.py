@@ -214,3 +214,30 @@ def test_scalar_bool_key_consumes_no_dim():
     np.testing.assert_array_equal(np.asarray(got.larray), data[True, 4])
     with pytest.raises(IndexError):
         x[True, 9]  # 9 really is out of bounds for axis 0 (size 5)
+
+
+def test_zero_d_integer_array_key_bounds_checked():
+    """A 0-d integer ndarray key behaves like the scalar int: value-exact
+    on getitem and bounds-checked on setitem (jnp's .at clips silently —
+    advisor r3 finding)."""
+    data, x = _mk((5, 3), 0)
+    np.testing.assert_array_equal(np.asarray(x[np.array(2)].larray), data[2])
+    np.testing.assert_array_equal(np.asarray(x[np.int64(-1)].larray), data[-1])
+    with pytest.raises(IndexError):
+        x[np.array(99)] = 1.0
+    with pytest.raises(IndexError):
+        _ = x[np.array(-6)]
+
+
+def test_nested_bool_list_key_dim_mapping():
+    """A nested boolean LIST key is a multi-dim mask and must consume
+    ndim dims in the key→axis mapping — a following integer key then
+    bounds-checks against the right axis (advisor r3 finding)."""
+    data = np.arange(24, dtype=np.float32).reshape(4, 3, 2)
+    x = ht.array(data, split=0)
+    mask = (data.sum(axis=2) > 10).tolist()  # (4, 3) boolean nested list
+    got = x[mask, 1]
+    want = data[np.asarray(mask), 1]
+    np.testing.assert_array_equal(np.asarray(got.larray), want)
+    with pytest.raises(IndexError):
+        _ = x[mask, 5]  # axis 2 has size 2: must reject, not clip
