@@ -49,7 +49,16 @@ def _presort_values(arr):
     return svals, fmin, fmax
 
 
-def _cluster_medians(arr, svals, fmin, fmax, onehot, counts, k):
+#: warm-start half-window (positions): after the first Lloyd iteration the
+#: median positions barely move, so the bisection restarts from
+#: ``[prev - W, prev + W]`` instead of ``[0, n)`` — validated EXACTLY (two
+#: edge count-probes re-establish the bisection invariant; any slot whose
+#: answer escaped the window falls back to the full range), so warm
+#: starting is a pure speed knob, never an approximation.
+_WARM_WINDOW = 64
+
+
+def _cluster_medians(arr, svals, fmin, fmax, onehot, counts, k, prev_pos=None):
     """Exact per-cluster per-feature medians, (k, f), by RANK-SPACE
     BISECTION with matmul rank counts — zero per-iteration sorts and zero
     O(n·f) gathers (TPU gathers of (n, f) indices measured ~13 ms at the
@@ -77,7 +86,6 @@ def _cluster_medians(arr, svals, fmin, fmax, onehot, counts, k):
     ``nanmedian`` (k full sorts per step, BENCH_r02: 2,300x a KMeans
     step)."""
     n, f = arr.shape
-    steps = int(np.ceil(np.log2(max(n, 2)))) + 1
     # 1-indexed member ranks of the two middles (equal when count is odd)
     t = jnp.maximum(
         jnp.stack([(counts - 1) // 2 + 1, counts // 2 + 1], axis=-1), 1
@@ -95,9 +103,12 @@ def _cluster_medians(arr, svals, fmin, fmax, onehot, counts, k):
     # non-finite features already have undefined assignments (their
     # distances are NaN), so only this bracket caveat remains.
 
-    def step(_, st):
-        lo, hi = st  # (k, f, 2) position brackets: answer in [lo, hi]
-        pos = lo + (hi - lo) // 2
+    def count_at(pos):
+        """Per-slot member count ``|{x in c : x[:, j] <= svals[pos, j]}|``
+        for a (k, f, 2) position probe — the bisection's primitive, also
+        used standalone to validate warm-start brackets.  Costs one
+        bisection step (two threshold matmuls + one int8 count matmul)."""
+        pos = jnp.clip(pos, 0, n - 1)
         # value thresholds at the probe positions: tiny (k*2, f) gather
         thr = jnp.take_along_axis(
             svals, jnp.transpose(pos, (2, 0, 1)).reshape(2 * k, f), axis=0
@@ -116,17 +127,44 @@ def _cluster_medians(arr, svals, fmin, fmax, onehot, counts, k):
             onehot8, ind, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.int32,
         )  # (k, 2f): members of c with x[:, j] <= thr[s, c, j]
-        cnt = jnp.stack([cnt[:, :f], cnt[:, f:]], axis=-1)  # (k, f, 2)
-        found = cnt >= t[:, None, :]
+        return jnp.stack([cnt[:, :f], cnt[:, f:]], axis=-1)  # (k, f, 2)
+
+    tkf = t[:, None, :]  # (k, 1, 2) target ranks, broadcast over features
+
+    def step(st):
+        lo, hi = st  # (k, f, 2) position brackets: answer in [lo, hi]
+        pos = lo + (hi - lo) // 2
+        found = count_at(pos) >= tkf
         return jnp.where(found, lo, pos + 1), jnp.where(found, pos, hi)
 
-    lo0 = jnp.zeros((k, f, 2), jnp.int32)
-    hi0 = jnp.full((k, f, 2), n - 1, jnp.int32)
-    lo, _ = jax.lax.fori_loop(0, steps, step, (lo0, hi0))
+    if prev_pos is None:
+        lo0 = jnp.zeros((k, f, 2), jnp.int32)
+        hi0 = jnp.full((k, f, 2), n - 1, jnp.int32)
+    else:
+        # warm start around last iteration's answer, then RE-ESTABLISH the
+        # bisection invariant exactly: the answer (smallest p with
+        # count(p) >= t) lies in [lo0, hi0] iff count(hi0) >= t and
+        # count(lo0 - 1) < t.  Slots where labels churned past the window
+        # widen back to the full range — correctness never depends on the
+        # window (VERDICT r3 #4: warm-started brackets, re-widened on
+        # churn).
+        lo0 = jnp.clip(prev_pos - _WARM_WINDOW, 0, n - 1)
+        hi0 = jnp.clip(prev_pos + _WARM_WINDOW, 0, n - 1)
+        ok_hi = count_at(hi0) >= tkf
+        ok_lo = (lo0 == 0) | (count_at(lo0 - 1) < tkf)
+        ok = ok_hi & ok_lo
+        lo0 = jnp.where(ok, lo0, 0)
+        hi0 = jnp.where(ok, hi0, n - 1)
+
+    # adaptive depth: warm brackets converge in ~log2(2W) trips instead of
+    # the full log2(n) (the while_loop stops as soon as every slot closes)
+    lo, _ = jax.lax.while_loop(
+        lambda st: jnp.any(st[0] < st[1]), step, (lo0, hi0)
+    )
     val = jnp.take_along_axis(
         svals, jnp.transpose(lo, (2, 0, 1)).reshape(2 * k, f), axis=0
     ).reshape(2, k, f)
-    return (val[0] + val[1]) * 0.5
+    return (val[0] + val[1]) * 0.5, lo
 
 
 class KMedians(_KCluster):
@@ -172,27 +210,35 @@ class KMedians(_KCluster):
             c2 = jnp.sum(c * c, axis=1)[None, :]
             return jnp.argmin(c2 - 2.0 * jnp.matmul(arr, c.T), axis=1)
 
-        def update(labels, c):
+        def update(labels, c, prev_pos):
             member = labels[:, None] == jnp.arange(k)
             onehot = member.astype(jnp.float32)
             counts = jnp.sum(member, axis=0, dtype=jnp.int32)
-            med = _cluster_medians(arr, svals, fmin, fmax, onehot, counts, k)
+            med, pos = _cluster_medians(
+                arr, svals, fmin, fmax, onehot, counts, k, prev_pos
+            )
             # keep the previous coordinate for empty clusters AND for NaN
             # medians (a NaN-feature member): a NaN center would poison
             # shift, silently end the loop, and NaN every distance
-            return jnp.where((counts > 0)[:, None] & ~jnp.isnan(med), med, c)
+            return jnp.where((counts > 0)[:, None] & ~jnp.isnan(med), med, c), pos
 
         def cond(state):
-            it, _, shift = state
+            it, _, shift, _ = state
             return jnp.logical_and(it < max_iter, shift > tol)
 
         def body(state):
-            it, c, _ = state
-            nc = update(assign(c), c)
-            return it + 1, nc, jnp.sum((nc - c) ** 2)
+            it, c, _, pos = state
+            nc, pos = update(assign(c), c, pos)
+            return it + 1, nc, jnp.sum((nc - c) ** 2), pos
 
-        init = (jnp.int32(0), centers, jnp.float32(jnp.inf))
-        n_iter, centers, _ = jax.lax.while_loop(cond, body, init)
+        # sentinel start: an impossible previous position makes the warm
+        # brackets collapse to [0, 0], whose exact validation widens every
+        # slot back to the full range — iteration 1 is a full bisection
+        # with no special-casing, later iterations warm-start (the answer
+        # rarely moves more than a few ranks once labels stabilize)
+        pos0 = jnp.full((k, arr.shape[1], 2), -2 * _WARM_WINDOW, jnp.int32)
+        init = (jnp.int32(0), centers, jnp.float32(jnp.inf), pos0)
+        n_iter, centers, _, _ = jax.lax.while_loop(cond, body, init)
         return centers, assign(centers), n_iter
 
     def fit(self, x: DNDarray) -> "KMedians":

@@ -24,6 +24,7 @@ from typing import Callable, Optional, Union
 
 import numpy as np
 
+import jax
 import jax.numpy as jnp
 
 from . import factories, sanitation, types
@@ -44,6 +45,66 @@ def _freeze(kwargs: dict):
         return items
     except TypeError:
         return None
+
+
+def _is_padded(t) -> bool:
+    """True when ``t`` is a DNDarray whose at-rest buffer carries a padded
+    (ragged) split axis."""
+    return (
+        isinstance(t, DNDarray)
+        and t.split is not None
+        and t.padshape != t.gshape
+    )
+
+
+def _binary_arrays(t1, t2, anchor):
+    """Choose the compute arrays for a binary op.
+
+    When the anchor's at-rest buffer is padded and the other operand's
+    padding lines up (same padded split axis, or broadcast dim 1/absent
+    there, or a scalar), the op runs directly on the buffers: elementwise
+    garbage in the pad rows stays in the pad rows, and the result commits
+    sharded with NO boundary collective.  Anything misaligned falls back
+    to the true-shape views (correct, but committing a ragged result costs
+    the boundary).
+
+    Returns ``(a1, a2, fused)``.
+    """
+
+    def true_view(t):
+        if np.isscalar(t):
+            return t
+        return t.larray if isinstance(t, DNDarray) else jnp.asarray(t)
+
+    if not _is_padded(anchor):
+        return true_view(t1), true_view(t2), False
+    s = anchor.split
+    n = anchor.gshape[s]
+    pn = anchor.padshape[s]
+
+    def aligned(t):
+        if t is anchor or np.isscalar(t):
+            return True
+        if not isinstance(t, DNDarray):
+            return False
+        if t.split is not None:
+            # must be the same padded axis at the same position and length
+            return (
+                t.ndim == anchor.ndim
+                and t.split == s
+                and t.gshape[s] == n
+                and t.padshape[s] == pn
+            )
+        # replicated: the dim aligning with the padded axis (right-aligned
+        # broadcasting) must be 1 or absent
+        d = s - (anchor.ndim - t.ndim)
+        return d < 0 or t.gshape[d] == 1
+
+    if aligned(t1) and aligned(t2):
+        a1 = t1 if np.isscalar(t1) else t1._buffer
+        a2 = t2 if np.isscalar(t2) else t2._buffer
+        return a1, a2, True
+    return true_view(t1), true_view(t2), False
 
 
 def _canonical_result(result):
@@ -107,31 +168,28 @@ def __binary_op(
     if not isinstance(anchor, DNDarray):
         raise TypeError(f"expected a DNDarray or scalar, got {type(anchor)}")
 
-    a1 = t1 if np.isscalar(t1) else t1.larray
-    a2 = t2 if np.isscalar(t2) else (t2.larray if isinstance(t2, DNDarray) else jnp.asarray(t2))
+    a1, a2, fused = _binary_arrays(t1, t2, anchor)
 
     # heat dtype promotion (reference :138; delegated to the jax lattice,
-    # which implements the same torch-flavored rules).  Python scalars are
-    # pre-cast with weak-type promotion (jnp.result_type treats them as
-    # weak) so they can be jit *arguments* — the compiled executable is
-    # reused across scalar values instead of recompiling per constant.
-    try:
-        if np.isscalar(a1):
-            a1 = jnp.asarray(a1, dtype=jnp.result_type(a2.dtype, a1))
-        elif np.isscalar(a2):
-            a2 = jnp.asarray(a2, dtype=jnp.result_type(a1.dtype, a2))
-    except OverflowError:
-        # e.g. uint8 array + 300: the weak-type result dtype cannot hold the
-        # scalar.  Keep the python scalar and fall through to the eager path,
-        # which reproduces jnp's wrapping semantics for out-of-range scalars.
-        pass
-    statics = _freeze(fn_kwargs) if not (np.isscalar(a1) or np.isscalar(a2)) else None
+    # which implements the same torch-flavored rules).  Python scalars go
+    # straight into the jitted executable as ARGUMENTS: jax traces them as
+    # weak-typed 0-d values, so one compiled program serves every scalar
+    # value AND the weak-promotion result dtype matches the eager jnp
+    # semantics — the r3 wrapper pre-cast them through jnp.asarray +
+    # result_type instead, which profiling showed was ~60% of the whole
+    # eager per-op cost (VERDICT r3 #7).
+    statics = _freeze(fn_kwargs)
     if statics is not None:
         fn = jitted(
             ("binary", operation, statics),
             lambda: lambda x, y: operation(x, y, **fn_kwargs),
         )
-        result = fn(a1, a2)
+        try:
+            result = fn(a1, a2)
+        except (OverflowError, TypeError):
+            # e.g. uint8 array + 2**70: the weak scalar cannot trace —
+            # eager jnp reproduces the wrap/raise semantics
+            result = operation(a1, a2, **fn_kwargs)
     else:
         result = operation(a1, a2, **fn_kwargs)
     result = _canonical_result(result)
@@ -147,7 +205,15 @@ def __binary_op(
     comm = anchor.comm
     device = anchor.device
     result = comm.apply_sharding(result, split)
-    wrapped = DNDarray(result, tuple(result.shape), out_dtype, split, device, comm, True)
+    if fused:
+        # buffers computed padded: the wrap's gshape is the broadcast of
+        # the TRUE shapes (the padded result is the at-rest buffer)
+        s1 = () if np.isscalar(t1) else tuple(t1.shape)
+        s2 = () if np.isscalar(t2) else tuple(t2.shape)
+        true_shape = broadcast_shape(s1, s2)
+    else:
+        true_shape = tuple(result.shape)
+    wrapped = DNDarray(result, true_shape, out_dtype, split, device, comm, True)
 
     if out is not None:
         sanitation.sanitize_out(out, wrapped.shape, wrapped.split, device)
@@ -172,7 +238,8 @@ def __local_op(
     if out is not None and not isinstance(out, DNDarray):
         raise TypeError(f"expected out to be None or a DNDarray, but was {type(out)}")
 
-    arr = x.larray
+    padded = _is_padded(x)
+    arr = x._buffer if padded else x.larray
     cast = None
     if not no_cast and types.heat_type_is_exact(x.dtype):
         cast = jnp.float32 if x.dtype is not types.int64 else jnp.float64
@@ -188,7 +255,23 @@ def __local_op(
     result = _canonical_result(result)
     dtype = types.canonical_heat_type(result.dtype)
     result = x.comm.apply_sharding(result, x.split if result.ndim else None)
-    wrapped = DNDarray(result, tuple(result.shape), dtype, x.split, x.device, x.comm, x.balanced)
+    if padded:
+        if tuple(result.shape) == tuple(arr.shape):
+            # elementwise on the padded buffer: result IS the at-rest buffer
+            gshape = x.gshape
+        else:
+            # a shape-changing op slipped through on a padded buffer — the
+            # pad rows may have leaked into the result; redo on the true view
+            arr = x.larray
+            result = _canonical_result(
+                operation(arr.astype(cast) if cast else arr, **kwargs)
+            )
+            dtype = types.canonical_heat_type(result.dtype)
+            result = x.comm.apply_sharding(result, x.split if result.ndim else None)
+            gshape = tuple(result.shape)
+    else:
+        gshape = tuple(result.shape)
+    wrapped = DNDarray(result, gshape, dtype, x.split, x.device, x.comm, x.balanced)
     if out is not None:
         sanitation.sanitize_out(out, wrapped.shape, wrapped.split, x.device)
         out.larray = wrapped.larray.astype(out.dtype.jax_type())
@@ -222,23 +305,9 @@ def __reduce_op(
     if dtype is not None:
         dtype = types.canonical_heat_type(dtype)
     cast = dtype.jax_type() if dtype is not None else None
-    statics = _freeze(kwargs)
-    if statics is not None:
-        fn = jitted(
-            ("reduce", reduction, axis, keepdims, cast, statics),
-            lambda: lambda a: (
-                lambda r: r.astype(cast) if cast is not None else r
-            )(reduction(a, axis=axis, keepdims=keepdims, **kwargs)),
-        )
-        result = fn(x.larray)
-    else:
-        result = reduction(x.larray, axis=axis, keepdims=keepdims, **kwargs)
-        if cast is not None:
-            result = result.astype(cast)
-    result = _canonical_result(result)
-    out_dtype = types.canonical_heat_type(result.dtype)
 
-    # split bookkeeping (reference :446-456)
+    # split bookkeeping first (reference :446-456) — the padded path needs
+    # the result's split axis to re-pad inside the compiled program
     split = x.split
     if split is not None:
         axes = (axis,) if isinstance(axis, int) else (tuple(range(x.ndim)) if axis is None else axis)
@@ -246,10 +315,63 @@ def __reduce_op(
             split = None
         elif not keepdims:
             split = split - builtins.sum(1 for a in axes if a < split)
+
+    padded = _is_padded(x)
+    pad_in = (x.split, x.gshape[x.split]) if padded else None
+    out_split_pad = split if padded else None
+    comm = x.comm
+    statics = _freeze(kwargs)
+    if statics is not None:
+        def make():
+            def f(a):
+                if pad_in is not None:
+                    # slice the buffer to its true length INSIDE the program:
+                    # pad rows never reach the reduction, and no boundary
+                    # crossing materializes the ragged view
+                    sl = [slice(None)] * a.ndim
+                    sl[pad_in[0]] = slice(0, pad_in[1])
+                    a = a[tuple(sl)]
+                r = reduction(a, axis=axis, keepdims=keepdims, **kwargs)
+                if cast is not None:
+                    r = r.astype(cast)
+                if out_split_pad is not None and r.ndim:
+                    n_out = int(r.shape[out_split_pad])
+                    pn = comm.padded_size(n_out)
+                    if pn != n_out:
+                        w = [(0, 0)] * r.ndim
+                        w[out_split_pad] = (0, pn - n_out)
+                        r = jnp.pad(r, w)
+                        r = jax.lax.with_sharding_constraint(
+                            r, comm.sharding(r.ndim, out_split_pad)
+                        )
+                return r
+
+            return f
+
+        fn = jitted(
+            ("reduce", reduction, axis, keepdims, cast, statics, pad_in, out_split_pad,
+             comm if padded else None),
+            make,
+        )
+        result = fn(x._buffer if padded else x.larray)
+    else:
+        result = reduction(x.larray, axis=axis, keepdims=keepdims, **kwargs)
+        if cast is not None:
+            result = result.astype(cast)
+        padded = False  # eager fallback computed on the true view
+    result = _canonical_result(result)
+    out_dtype = types.canonical_heat_type(result.dtype)
+
     if result.ndim == 0:
         split = None
     result = x.comm.apply_sharding(result, split)
-    wrapped = DNDarray(result, tuple(result.shape), out_dtype, split, x.device, x.comm, True)
+    if padded and split is not None:
+        gshape = list(result.shape)
+        gshape[split] = x.gshape[x.split]  # surviving split axis: true length
+        gshape = tuple(gshape)
+    else:
+        gshape = tuple(result.shape)
+    wrapped = DNDarray(result, gshape, out_dtype, split, x.device, x.comm, True)
 
     if out is not None:
         sanitation.sanitize_out(out, wrapped.shape, wrapped.split, x.device)
@@ -278,6 +400,7 @@ def __cum_op(
     if dtype is not None:
         dtype = types.canonical_heat_type(dtype)
     cast = dtype.jax_type() if dtype is not None else None
+    padded = _is_padded(x)
     scan_op = {jnp.cumsum: "sum", jnp.cumprod: "prod"}.get(operation)
     if scan_op is not None and axis == x.split and x.comm.size > 1:
         # cum-op ALONG the sharded axis: GSPMD's partitioned scan is
@@ -288,18 +411,26 @@ def __cum_op(
         result = prefix_scan(x.larray, scan_op, comm=x.comm, axis=axis)
         if cast is not None:
             result = result.astype(cast)
+        result = _canonical_result(result)
+        out_dtype = types.canonical_heat_type(result.dtype)
+        if not padded:
+            result = x.comm.apply_sharding(result, x.split)
+        # padded: result is true-shape; the constructor pads+commits it
+        # directly (apply_sharding on the ragged view would replicate first)
     else:
+        # any other axis is unpadded: the buffer feeds the op directly
+        arr = x._buffer if padded and axis != x.split else x.larray
         fn = jitted(
             ("cum", operation, axis, cast),
             lambda: lambda a: (
                 lambda r: r.astype(cast) if cast is not None else r
             )(operation(a, axis=axis)),
         )
-        result = fn(x.larray)
-    result = _canonical_result(result)
-    out_dtype = types.canonical_heat_type(result.dtype)
-    result = x.comm.apply_sharding(result, x.split)
-    wrapped = DNDarray(result, tuple(result.shape), out_dtype, x.split, x.device, x.comm, x.balanced)
+        result = fn(arr)
+        result = _canonical_result(result)
+        out_dtype = types.canonical_heat_type(result.dtype)
+        result = x.comm.apply_sharding(result, x.split)
+    wrapped = DNDarray(result, x.gshape, out_dtype, x.split, x.device, x.comm, x.balanced)
     if out is not None:
         sanitation.sanitize_out(out, wrapped.shape, wrapped.split, x.device)
         out.larray = wrapped.larray.astype(out.dtype.jax_type())

@@ -631,6 +631,11 @@ def _reshard(array, sh: NamedSharding):
     outputs), whereas a jitted sharding constraint lowers to the proper
     cross-host collective.  Host values (numpy / single-device arrays) keep
     the device_put path everywhere."""
+    if getattr(array, "sharding", None) == sh:
+        # already laid out: device_put would no-op anyway but costs ~50 us
+        # of dispatch per call — this check is ~0.1 us and sits on the
+        # eager per-op hot path (every wrapped result passes through here)
+        return array
     if (
         jax.process_count() > 1
         and isinstance(array, jax.Array)

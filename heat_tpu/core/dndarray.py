@@ -18,9 +18,22 @@ errors corrupt results.
 
 Design invariants:
 
-* ``self.larray`` is a global jax.Array with ``self.larray.shape ==
-  self.gshape`` (replaces the reference invariant that each local torch
-  tensor matches its chunk, dndarray.py:93);
+* the at-rest backing store (``self._buffer``) is a global jax.Array whose
+  split axis is **canonically padded**: an axis of true length ``n`` over a
+  ``p``-device mesh is stored zero-padded to ``p * ceil(n/p)`` and committed
+  SHARDED, so per-device memory is O(n/p) for *any* n — the TPU-first
+  equivalent of the reference invariant that each rank's torch tensor
+  matches its ``chunk()`` slice (reference communication.py:82-137,
+  dndarray.py:93).  Divisible axes (and replicated arrays) store exactly
+  ``gshape``;
+* ``self.larray`` is the true-shape view: ``larray.shape == gshape``
+  always.  For padded arrays it is a lazily-cached slice — cheap inside
+  compiled programs, but committing it at a program boundary materializes
+  a ragged (hence replicated) array, so scale paths consume ``_buffer``;
+* pad rows hold *unspecified* values after ops (elementwise garbage-in/
+  garbage-out is confined to the pad): every non-elementwise consumer
+  must go through ``larray``/masking.  The op wrappers in
+  ``_operations.py`` do this centrally;
 * ``split ∈ {None, 0..ndim-1}``; ``None`` = replicated on all devices;
 * shard layout is *canonical* (GSPMD ceil-division): arrays are always
   balanced, so ``balance_``/``redistribute_`` (reference dndarray.py:900,
@@ -39,11 +52,22 @@ import jax
 import jax.numpy as jnp
 
 from . import types
+from ._compile import jitted
 from .communication import Communication, sanitize_comm
 from .devices import Device
 from .stride_tricks import sanitize_axis
 
 __all__ = ["DNDarray", "LocalIndex"]
+
+#: Minimum element count of the operand before array-key indexing along the
+#: split axis routes through the bounded-memory ring gather/scatter
+#: (:mod:`heat_tpu.parallel.take`) instead of the GSPMD gather (which
+#: REPLICATES the operand for data-dependent cross-shard indexing).  Small
+#: operands keep the plain jnp path — the ring's p rounds only pay off once
+#: per-device memory is at stake.  Override with HEAT_TPU_RING_INDEX_MIN.
+import os as _os
+
+_RING_INDEX_MIN = int(_os.environ.get("HEAT_TPU_RING_INDEX_MIN", str(1 << 22)))
 
 
 class LocalIndex:
@@ -99,16 +123,51 @@ class DNDarray:
         comm: Communication,
         balanced: bool = True,
     ):
-        self.__array = array
         self.__gshape = tuple(int(s) for s in gshape)
         self.__dtype = dtype
+        if split is not None and self.__gshape:
+            ndim = len(self.__gshape)
+            if not -ndim <= split < ndim:
+                raise ValueError(
+                    f"split axis {split} out of range for {ndim}-dimensional "
+                    f"shape {self.__gshape}"
+                )
+            split = int(split) % ndim  # normalize negatives only
         self.__split = split
         self.__device = device
         self.__comm = comm
         self.__balanced = True if balanced is None else bool(balanced)
+        self.__true_view = None
         self.__halo_prev = None
         self.__halo_next = None
         self.__halo_size = 0
+        self.__array = self.__commit(array)
+
+    def __commit(self, array) -> jax.Array:
+        """Normalize ``array`` to the at-rest invariant: a ragged split axis
+        (gshape[split] not divisible by the mesh) is zero-padded to the
+        canonical length and committed sharded.  Accepts either the
+        true-shape array or an already-padded buffer; divisible/replicated
+        arrays pass through untouched (sharding them stays the caller's
+        job, as before)."""
+        split = self.__split
+        if split is None or not self.__gshape:
+            return array
+        comm = self.__comm
+        n = self.__gshape[split]
+        pn = comm.padded_size(n)
+        if pn == n:
+            return array
+        have = int(array.shape[split])
+        if have == pn:
+            return array  # already the at-rest buffer
+        if have != n:
+            raise ValueError(
+                f"backing array axis {split} has length {have}; expected the "
+                f"true length {n} or the padded length {pn} for gshape "
+                f"{self.__gshape} over {comm.size} devices"
+            )
+        return comm.pad_to_shards(array, axis=split)
 
     # ------------------------------------------------------------------ #
     # metadata properties (reference dndarray.py:95-360)                  #
@@ -147,21 +206,72 @@ class DNDarray:
 
     @property
     def larray(self) -> jax.Array:
-        """The backing jax.Array.
+        """The global array at its TRUE shape (``larray.shape == gshape``).
 
         Semantic shift from the reference (dndarray.py:123-135): there this
         is the rank-local torch tensor; here it is the *global* device array
         whose shards are distributed — the natural "local" object of
-        single-controller SPMD.
+        single-controller SPMD.  When the at-rest buffer is padded (ragged
+        split axis), this is a cached slice of the buffer; committing that
+        slice at a program boundary materializes a ragged array (GSPMD
+        replicates those), so scale pipelines consume :attr:`_buffer`.
         """
-        return self.__array
+        arr = self.__array
+        split = self.__split
+        if split is None or not self.__gshape:
+            return arr
+        n = self.__gshape[split]
+        if int(arr.shape[split]) == n:
+            return arr
+        if self.__true_view is None:
+            self.__true_view = self.__comm.unpad(arr, n, split)
+        return self.__true_view
 
     @larray.setter
     def larray(self, array: jax.Array):
+        """Rebind the backing data.  ``array`` is interpreted at its TRUE
+        shape (adopted as the new gshape); a ragged split axis is re-padded
+        to the at-rest invariant."""
         if tuple(array.shape) != self.__gshape:
             self.__gshape = tuple(int(s) for s in array.shape)
-        self.__array = array
+        self.__array = self.__commit(array)
         self._invalidate_halos()
+
+    @property
+    def _buffer(self) -> jax.Array:
+        """The at-rest backing buffer: the split axis canonically padded to
+        ``comm.padded_size(gshape[split])`` (== gshape for divisible axes).
+        Pad-row values are unspecified; mask or :meth:`larray` before any
+        non-elementwise use."""
+        return self.__array
+
+    @property
+    def padshape(self) -> Tuple[int, ...]:
+        """Shape of the at-rest buffer (gshape with the split axis padded)."""
+        return tuple(int(s) for s in self.__array.shape)
+
+    def _zeroed_buffer(self) -> jax.Array:
+        """The at-rest buffer with pad rows forced to zero — still padded
+        and sharded (no boundary crossing).  For consumers that assume the
+        canonical zero fill (halo exchange)."""
+        arr = self.__array
+        split = self.__split
+        if split is None or not self.__gshape:
+            return arr
+        n = self.__gshape[split]
+        pn = int(arr.shape[split])
+        if pn == n:
+            return arr
+        comm = self.__comm
+
+        def make():
+            def _z(x):
+                idx = jax.lax.broadcasted_iota(jnp.int32, x.shape, split)
+                return jnp.where(idx < n, x, jnp.zeros((), x.dtype))
+
+            return _z
+
+        return jitted(("dnd.zeropad", comm, split, n, pn, arr.ndim), make)(arr)
 
     @property
     def lloc(self) -> LocalIndex:
@@ -289,7 +399,7 @@ class DNDarray:
     def numpy(self) -> np.ndarray:
         """Gather to a host numpy array (reference dndarray.py: ``numpy`` —
         there an implicit resplit(None) + .numpy())."""
-        return np.asarray(self.__array)
+        return np.asarray(self.larray)
 
     def copy(self) -> "DNDarray":
         """An independent copy of this array (reference dndarray.py: ``copy``
@@ -329,19 +439,19 @@ class DNDarray:
         io.save_netcdf(self, path, variable, mode, **kwargs)
 
     def __array__(self, dtype=None):
-        arr = np.asarray(self.__array)
+        arr = np.asarray(self.larray)
         return arr.astype(dtype) if dtype is not None else arr
 
     def tolist(self, keepsplit: bool = False) -> list:
         """Nested python lists of the global data (reference dndarray.py:3718)."""
-        return np.asarray(self.__array).tolist()
+        return np.asarray(self.larray).tolist()
 
     def item(self):
         """The single element of a size-1 array as a python scalar
         (reference dndarray.py:1754)."""
         if self.size != 1:
             raise ValueError("only one-element DNDarrays can be converted to Python scalars")
-        return self.__array.reshape(()).item()
+        return self.larray.reshape(()).item()
 
     def __bool__(self) -> bool:
         return bool(self.item())
@@ -381,7 +491,7 @@ class DNDarray:
         if device == self.__device:
             return self
         comm = comm_for_device(device.platform)
-        arr = jax.device_put(np.asarray(self.__array), comm.sharding(self.ndim, None))
+        arr = jax.device_put(np.asarray(self.larray), comm.sharding(self.ndim, None))
         arr = comm.apply_sharding(arr, self.__split)
         return DNDarray(arr, self.shape, self.dtype, self.split, device, comm, True)
 
@@ -423,8 +533,13 @@ class DNDarray:
         axis = sanitize_axis(self.shape, axis)
         if axis == self.__split:
             return self
-        self.__array = self.__comm.resplit(self.__array, axis)
+        true = self.larray
         self.__split = axis
+        if axis is not None and self.__gshape and self.__gshape[axis] % max(self.__comm.size, 1):
+            # ragged target axis: pad+shard in one step (the at-rest form)
+            self.__array = self.__comm.pad_to_shards(true, axis=axis)
+        else:
+            self.__array = self.__comm.resplit(true, axis)
         self.__balanced = True
         self._invalidate_halos()
         return self
@@ -461,7 +576,7 @@ class DNDarray:
             return
         from ..parallel.primitives import halo_exchange
 
-        arr = self.__array
+        arr = self._zeroed_buffer()
         if self.__split != 0:
             arr = jnp.moveaxis(arr, self.__split, 0)
         # halo_exchange validates halo_size <= shard_width (raising before
@@ -475,8 +590,9 @@ class DNDarray:
         self.__halo_size = halo_size
 
     def _invalidate_halos(self) -> None:
-        """Drop cached halo strips; called whenever the backing array or
-        layout changes (halos describe a specific array + split)."""
+        """Drop cached derived views (halo strips, the true-shape slice);
+        called whenever the backing array or layout changes."""
+        self.__true_view = None
         self.__halo_prev = None
         self.__halo_next = None
         self.__halo_size = 0
@@ -505,10 +621,10 @@ class DNDarray:
         """
         h = self.__halo_size
         if self.__split is None or not h:
-            return self.__array
+            return self.larray  # no halos: the plain (true-shape) array
         comm = self.__comm
         split = self.__split
-        arr = self.__array
+        arr = self._zeroed_buffer()
         prev, nxt = self.__halo_prev, self.__halo_next
         if split != 0:
             arr = jnp.moveaxis(arr, split, 0)
@@ -651,12 +767,115 @@ class DNDarray:
             return None if result_ndim == 0 else min(max(split - dropped_before, 0), result_ndim - 1)
         return min(split - dropped_before, result_ndim - 1)
 
+    def __ring_index_plan(self, jkey):
+        """Detect the scale-sensitive fancy-indexing pattern: ONE 1-D
+        integer-array key on the split axis, every other axis untouched,
+        on a distributed operand big enough that GSPMD's replicate-the-
+        operand gather would hurt (≥ ``_RING_INDEX_MIN`` elements).
+        Returns the index array, or None for the plain jnp path."""
+        s = self.__split
+        if s is None or not self.__comm.is_distributed():
+            return None
+        if self.size < _RING_INDEX_MIN:
+            return None
+
+        def is_idx(k):
+            return (
+                isinstance(k, (jnp.ndarray, jax.Array))
+                and k.ndim == 1
+                and k.shape[0] > 0
+                and jnp.issubdtype(k.dtype, jnp.integer)
+            )
+
+        if isinstance(jkey, tuple):
+            if len(jkey) > self.ndim:
+                return None
+            idx = None
+            for d, k in enumerate(jkey):
+                if isinstance(k, slice):
+                    if k != slice(None):
+                        return None
+                elif is_idx(k):
+                    if d != s or idx is not None:
+                        return None
+                    idx = k
+                else:
+                    return None
+            return idx
+        return jkey if s == 0 and is_idx(jkey) else None
+
+    def __ring_getitem(self, idx) -> "DNDarray":
+        """Fancy gather along the split axis via the bounded-memory ring
+        (reference dndarray.py:1476-1726 exchanges per-rank key
+        intersections; GSPMD would replicate the operand instead —
+        parallel/take.py).  The operand's at-rest buffer feeds the ring
+        directly; the result commits padded+sharded at rest."""
+        from ..parallel.take import ring_take
+
+        s, comm = self.__split, self.__comm
+        n = self.__gshape[s]
+        m = int(idx.shape[0])
+        buf = self.__array
+        if s != 0:
+            buf = jnp.moveaxis(buf, s, 0)
+        # oob='clip': jnp gather clamp semantics (wrap negatives, clip to
+        # range) — sanitation happens exactly once, inside ring_take
+        out = ring_take(buf, idx, comm=comm, n=n, padded_out=True, oob="clip")
+        if s != 0:
+            out = jnp.moveaxis(out, 0, s)
+        gshape = self.__gshape[:s] + (m,) + self.__gshape[s + 1 :]
+        return DNDarray(out, gshape, self.__dtype, s, self.__device, comm, True)
+
+    def __ring_setitem(self, idx, value) -> None:
+        """Fancy scatter along the split axis via the ring dual
+        (reference dndarray.py:3190-3339).  Out-of-range indices drop and
+        duplicate destinations resolve in unspecified order — the same
+        contract as jnp's ``.at[].set`` scatter.  The new buffer replaces
+        the at-rest store without any boundary materialization."""
+        from ..parallel.take import ring_put
+
+        s, comm = self.__split, self.__comm
+        n = self.__gshape[s]
+        m = int(idx.shape[0])
+        vshape = self.__gshape[:s] + (m,) + self.__gshape[s + 1 :]
+        if (
+            isinstance(value, DNDarray)
+            and value.split == s
+            and value.gshape == vshape
+            and value._buffer.dtype == self.__array.dtype
+        ):
+            # aligned at-rest operand (e.g. the gather round-trip): its
+            # padded buffer feeds the ring directly — pad rows align with
+            # the masked pad queries and are never written.  Going through
+            # .larray here would materialize the ragged view REPLICATED at
+            # the boundary, the exact spike this path exists to avoid.
+            value = value._buffer
+        else:
+            if isinstance(value, DNDarray):
+                value = value.larray
+            value = jnp.asarray(value, dtype=self.__array.dtype)
+            # numpy setitem layout: the advanced axis stays in place (axis s)
+            value = jnp.broadcast_to(value, vshape)
+        buf = self.__array
+        if s != 0:
+            value = jnp.moveaxis(value, s, 0)
+            buf = jnp.moveaxis(buf, s, 0)
+        out = ring_put(n, idx, value, comm=comm, base=buf, padded_out=True)
+        if s != 0:
+            out = jnp.moveaxis(out, 0, s)
+        self.__array = out
+        self._invalidate_halos()
+
     def __getitem__(self, key) -> "DNDarray":
         """Global-semantics indexing (reference dndarray.py:1476-1726 — there
         each rank intersects the key with its chunk; here plain jnp indexing
-        on the global array)."""
+        on the global array, with big split-axis array keys routed through
+        the bounded-memory ring gather)."""
         jkey = self.__process_key(key)
-        result = self.__array[jkey]
+        ridx = self.__ring_index_plan(jkey)
+        if ridx is not None:
+            return self.__ring_getitem(ridx)
+        result = self.larray[jkey]
         if result.ndim == 0:
             return DNDarray(
                 result, (), self.__dtype, None, self.__device, self.__comm, True
@@ -671,12 +890,17 @@ class DNDarray:
         """Global-semantics assignment (reference dndarray.py:3190-3339),
         expressed functionally via ``.at[key].set`` and a rebind."""
         jkey = self.__process_key(key)
+        ridx = self.__ring_index_plan(jkey)
+        if ridx is not None:
+            self.__ring_setitem(ridx, value)
+            return
         if isinstance(value, DNDarray):
             value = value.larray
         value = jnp.asarray(value, dtype=self.__array.dtype)
-        self.__array = self.__comm.apply_sharding(
-            self.__array.at[jkey].set(value), self.__split
-        )
+        updated = self.larray.at[jkey].set(value)
+        if updated.shape == self.__array.shape:
+            updated = self.__comm.apply_sharding(updated, self.__split)
+        self.__array = self.__commit(updated)
         self._invalidate_halos()
 
     def fill_diagonal(self, value) -> "DNDarray":
@@ -727,7 +951,7 @@ class DNDarray:
                 f"non-broadcastable output operand with shape {self.__gshape} "
                 f"doesn't match the broadcast shape {tuple(res.shape)}"
             )
-        self.__array, self.__dtype, self.__split = res.larray, res.dtype, res.split
+        self.__array, self.__dtype, self.__split = res._buffer, res.dtype, res.split
         self._invalidate_halos()
         return self
 

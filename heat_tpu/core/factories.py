@@ -61,10 +61,13 @@ def _setup(device, comm):
 
 def _wrap(garr: jax.Array, dtype, split, device, comm) -> DNDarray:
     """Lay out a freshly built global array and wrap it."""
-    garr = comm.apply_sharding(garr, split if garr.ndim else None)
-    return DNDarray(
-        garr, tuple(garr.shape), dtype, split if garr.ndim else None, device, comm, True
-    )
+    split = split if garr.ndim else None
+    gshape = tuple(garr.shape)
+    if split is None or gshape[split] % max(comm.size, 1) == 0:
+        garr = comm.apply_sharding(garr, split)
+    # ragged split: skip the (replicated) boundary commit — the DNDarray
+    # constructor pads the axis and commits it sharded in one step
+    return DNDarray(garr, gshape, dtype, split, device, comm, True)
 
 
 def array(

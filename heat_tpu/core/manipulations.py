@@ -269,8 +269,10 @@ def resplit(arr: DNDarray, axis: Optional[int] = None) -> DNDarray:
     sanitize_in(arr)
     axis = sanitize_axis(arr.shape, axis)
     if axis == arr.split:
+        # same layout: share the at-rest buffer (re-wrapping the true view
+        # would unpad + re-pad a ragged split for nothing)
         return DNDarray(
-            arr.larray, arr.shape, arr.dtype, axis, arr.device, arr.comm, arr.balanced
+            arr._buffer, arr.shape, arr.dtype, axis, arr.device, arr.comm, arr.balanced
         )
     garr = arr.comm.resplit(arr.larray, axis)
     return DNDarray(garr, arr.shape, arr.dtype, axis, arr.device, arr.comm, True)

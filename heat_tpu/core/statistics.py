@@ -344,7 +344,7 @@ def percentile(x: DNDarray, q, axis=None, out=None, interpolation: str = "linear
         # sort is the pathological GSPMD global sort — rank-sort over the
         # ring instead, then interpolate locally on the sorted output
         svals, _ = _parallel_sort.ring_rank_sort(
-            jnp.ravel(x.larray), x.size, comm=x.comm
+            jnp.ravel(x.larray), x.size, comm=x.comm, want_indices=False
         )
         res = _interp_sorted(svals.astype(arr.dtype), qa, method)
         if keepdims:

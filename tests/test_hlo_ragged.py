@@ -58,10 +58,39 @@ def _ragged_rows():
     return 64 * d + 5, 32 * d  # rows NOT divisible by the mesh
 
 
-def test_ragged_axis_commits_replicated_divisible_commits_sharded():
-    """The boundary rule, locked in: a divisible split commits sharded,
-    a ragged split commits replicated (GSPMD refuses uneven boundary
-    layouts — the documented fallback, not an accident)."""
+def test_ragged_dndarray_commits_sharded_at_rest():
+    """The r4 storage invariant: a DNDarray with a ragged split axis stores
+    the canonically PADDED buffer, committed genuinely sharded — every
+    device holds exactly one padded shard (O(N/p) memory), never the full
+    array.  This flips the r2/r3 behavior (ragged commits replicated),
+    closing the last structural gap vs the reference's chunk() rule
+    (heat/core/communication.py:82-137)."""
+    comm = _comm()
+    if comm.size == 1:
+        pytest.skip("needs a mesh")
+    m, k = _ragged_rows()
+    X = ht.array(np.arange(m * k, dtype=np.float32).reshape(m, k), split=0)
+    buf = X._buffer
+    # buffer is the padded global array, sharded on axis 0
+    assert buf.shape == (comm.padded_size(m), k)
+    assert _spec_entries(buf)[0] == comm.axis_name
+    shard_shape = (comm.shard_width(m), k)
+    shards = list(buf.addressable_shards)
+    assert len(shards) == comm.size
+    for s in shards:
+        assert tuple(s.data.shape) == shard_shape, (s.data.shape, shard_shape)
+    # true-shape metadata is intact and values round-trip exactly
+    assert X.shape == (m, k) and X.larray.shape == (m, k)
+    np.testing.assert_array_equal(
+        X.numpy(), np.arange(m * k, dtype=np.float32).reshape(m, k)
+    )
+
+
+def test_raw_apply_sharding_on_ragged_still_replicates():
+    """The comm-level boundary rule is unchanged: GSPMD refuses uneven
+    shardings at program boundaries, so a RAW apply_sharding of a ragged
+    axis resolves to replicated — which is exactly why the DNDarray stores
+    the padded form instead (see test above)."""
     comm = _comm()
     if comm.size == 1:
         pytest.skip("needs a mesh")
@@ -71,6 +100,53 @@ def test_ragged_axis_commits_replicated_divisible_commits_sharded():
     ragged = comm.apply_sharding(jnp.zeros((m, k), jnp.float32), 0)
     entries = _spec_entries(ragged)
     assert entries is None or all(e is None for e in entries), entries
+
+
+def test_ragged_binary_op_lowers_without_boundary_collectives():
+    """Elementwise ops on two ragged-split arrays consume the padded
+    buffers directly: the compiled program contains NO collective at all,
+    and the result commits sharded at rest (VERDICT r3 directive #1's
+    done-criterion)."""
+    comm = _comm()
+    if comm.size == 1:
+        pytest.skip("needs a mesh")
+    m, k = _ragged_rows()
+    a = np.arange(m * k, dtype=np.float32).reshape(m, k)
+    X = ht.array(a, split=0)
+    Y = ht.array(2.0 * a, split=0)
+    import jax.numpy as _jnp
+    from heat_tpu.core._compile import jitted as _jitted
+
+    # the exact executable __binary_op replays: jitted add on the buffers
+    fn = _jitted(("binary", _jnp.add, ()), lambda: lambda x, y: _jnp.add(x, y))
+    hlo = fn.lower(X._buffer, Y._buffer).compile().as_text()
+    assert not _collectives(hlo), _collectives(hlo)
+    Z = X + Y
+    assert _spec_entries(Z._buffer)[0] == comm.axis_name  # sharded at rest
+    assert Z.padshape[0] == comm.padded_size(m)
+    np.testing.assert_allclose(Z.numpy(), 3.0 * a, rtol=1e-6)
+
+
+def test_ragged_reduction_masks_pad_and_stays_fused():
+    """Reductions slice the padded buffer to its true length INSIDE the
+    compiled program: values match numpy exactly (pad rows excluded —
+    critical for mean), and the lowering contains no all-gather of the
+    operand (cross-shard combining is all-reduce/reduce-scatter)."""
+    comm = _comm()
+    if comm.size == 1:
+        pytest.skip("needs a mesh")
+    m, k = _ragged_rows()
+    rng = np.random.default_rng(7)
+    a = rng.standard_normal((m, k)).astype(np.float32)
+    X = ht.array(a, split=0)
+    np.testing.assert_allclose(float(X.sum()), a.sum(), rtol=1e-4)
+    np.testing.assert_allclose(float(X.mean()), a.mean(), rtol=1e-4)
+    np.testing.assert_allclose(X.max(axis=0).numpy(), a.max(axis=0), rtol=1e-6)
+    # axis=1 reduction: split survives; result re-pads and stays sharded
+    S = X.sum(axis=1)
+    assert S.shape == (m,) and S.split == 0
+    assert _spec_entries(S._buffer)[0] == comm.axis_name
+    np.testing.assert_allclose(S.numpy(), a.sum(axis=1), rtol=1e-4, atol=1e-4)
 
 
 def test_ragged_compute_is_internally_sharded():
