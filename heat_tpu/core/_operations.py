@@ -408,15 +408,19 @@ def __cum_op(
         # two-level prefix scan (local cum-op + shard-offset all-gather)
         from ..parallel import prefix_scan
 
-        result = prefix_scan(x.larray, scan_op, comm=x.comm, axis=axis)
+        # the at-rest buffer feeds the scan directly: pad rows TRAIL the
+        # axis, so no real row's prefix ever includes one — garbage pads
+        # only poison the totals of all-pad trailing shards, i.e. pad rows
+        # of the result.  Going through .larray would commit the ragged
+        # view replicated at the boundary first.
+        result = prefix_scan(
+            x._buffer if padded else x.larray, scan_op, comm=x.comm, axis=axis
+        )
         if cast is not None:
             result = result.astype(cast)
         result = _canonical_result(result)
         out_dtype = types.canonical_heat_type(result.dtype)
-        if not padded:
-            result = x.comm.apply_sharding(result, x.split)
-        # padded: result is true-shape; the constructor pads+commits it
-        # directly (apply_sharding on the ragged view would replicate first)
+        result = x.comm.apply_sharding(result, x.split)  # padded ⇒ divisible
     else:
         # any other axis is unpadded: the buffer feeds the op directly
         arr = x._buffer if padded and axis != x.split else x.larray

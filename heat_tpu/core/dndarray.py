@@ -99,8 +99,13 @@ class DNDarray:
 
     array : jax.Array
         The **global** array (reference stores the local chunk instead).
+        On a ragged split axis this may be either the true-length array
+        (it will be padded to the at-rest form) or an already canonically
+        padded buffer (``comm.padded_size`` long on the split axis, pad
+        rows arbitrary) — anything else raises ``ValueError``.
     gshape : tuple of int
-        Global shape; must equal ``array.shape``.
+        TRUE global shape (``gshape[split]`` is the real length even when
+        ``array`` arrives padded); equals ``array.shape`` otherwise.
     dtype : heat type
         Element type (:mod:`heat_tpu.core.types`).
     split : int or None

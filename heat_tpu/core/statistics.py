@@ -343,8 +343,12 @@ def percentile(x: DNDarray, q, axis=None, out=None, interpolation: str = "linear
         # global percentile of a sharded array: jnp.percentile's internal
         # sort is the pathological GSPMD global sort — rank-sort over the
         # ring instead, then interpolate locally on the sorted output
+        # 1-D padded arrays feed their at-rest buffer straight in (the ring
+        # sort masks rows past the true length); n-D must ravel the true
+        # view — pad rows would interleave the flattened order
+        flat = x._buffer if x.ndim == 1 else jnp.ravel(x.larray)
         svals, _ = _parallel_sort.ring_rank_sort(
-            jnp.ravel(x.larray), x.size, comm=x.comm, want_indices=False
+            flat, x.size, comm=x.comm, want_indices=False
         )
         res = _interp_sorted(svals.astype(arr.dtype), qa, method)
         if keepdims:

@@ -122,6 +122,17 @@ def test_ragged_cumsum(axis):
     np.testing.assert_allclose(
         x.cumsum(axis=axis).numpy(), a.cumsum(axis=axis), rtol=1e-4, atol=1e-4
     )
+    # cumprod drives the same split-axis prefix scan with a different
+    # identity; the at-rest buffer's garbage pad rows trail the axis and
+    # must never leak into real prefixes
+    b = np.abs(a[:, :2]) ** 0.01
+    y = ht.array(b.astype(np.float32), split=0)
+    np.testing.assert_allclose(
+        y.cumprod(axis=axis if axis < 2 else 1).numpy(),
+        b.cumprod(axis=axis if axis < 2 else 1),
+        rtol=1e-3,
+        atol=1e-4,
+    )
 
 
 def test_ragged_matmul_contraction_over_padded_axis():

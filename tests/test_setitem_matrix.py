@@ -1,0 +1,289 @@
+"""The reference's setitem/getitem matrix, ported (VERDICT r3 #6).
+
+Scenario-for-scenario port of heat/core/tests/test_dndarray.py:957-1250
+(``test_setitem_getitem``) driven by a numpy oracle instead of per-rank
+lshape literals: every set/get pattern asserts values (against numpy on
+the same operation), result split (the layout hint the reference labels
+each result with), gshape, and dtype.  The reference's rank-conditional
+``lshape`` assertions translate here to ``chunk()``-derived lshape checks
+that hold on ANY mesh size, not just -np 2.
+
+Also pins the advanced-indexing layout heuristics (VERDICT r3 weak #4):
+Ellipsis and array-key results carry a deliberate, tested split hint —
+values never depend on it, but a silent hint change would reshard every
+downstream op.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import heat_tpu as ht
+
+
+def _chk(x, want, split=None, dtype=ht.float32):
+    """Assert values==numpy oracle, split hint, gshape, dtype."""
+    np.testing.assert_array_equal(np.asarray(x.larray), want)
+    assert x.gshape == tuple(want.shape), (x.gshape, want.shape)
+    assert x.split == split, (x.split, split)
+    assert x.dtype is dtype
+
+
+def _lshape_consistent(x):
+    """lshape must be this position's chunk of the true gshape."""
+    _, lsh, _ = x.comm.chunk(x.gshape, x.split, rank=x.comm.local_position())
+    assert x.lshape == lsh
+
+
+# ---------------------------------------------------------------- #
+# (13, 5) split=0 — reference :958-1070                             #
+# ---------------------------------------------------------------- #
+def test_scalar_set_get_split0():
+    a = ht.zeros((13, 5), split=0)
+    a[10, 0] = 1
+    assert float(a[10, 0]) == 1
+    assert a[10, 0].dtype is ht.float32
+    w = np.zeros((13, 5), np.float32)
+    w[10, 0] = 1
+    np.testing.assert_array_equal(a.numpy(), w)
+
+
+def test_row_set_get_split0():
+    a = ht.zeros((13, 5), split=0)
+    a[10] = 1
+    b = a[10]
+    assert bool((b == 1).all())
+    assert b.dtype is ht.float32 and b.gshape == (5,)
+
+
+def test_negative_row_split0():
+    a = ht.zeros((13, 5), split=0)
+    a[-1] = 1
+    b = a[-1]
+    assert bool((b == 1).all()) and b.gshape == (5,)
+
+
+@pytest.mark.parametrize("sl", [slice(1, 4), slice(1, 2)])
+def test_slice_first_dim_split0(sl):
+    a = ht.zeros((13, 5), split=0)
+    a[sl] = 1
+    w = np.zeros((13, 5), np.float32)
+    w[sl] = 1
+    _chk(a[sl], w[sl], split=0)
+    _lshape_consistent(a[sl])
+    np.testing.assert_array_equal(a.numpy(), w)
+
+
+def test_slice_with_scalar_second_split0():
+    for sl in (slice(1, 4), slice(1, 11), slice(8, 12)):
+        a = ht.zeros((13, 5), split=0)
+        a[sl, 1] = 1
+        w = np.zeros((13, 5), np.float32)
+        w[sl, 1] = 1
+        _chk(a[sl, 1], w[sl, 1], split=0)
+        np.testing.assert_array_equal(a.numpy(), w)
+
+
+def test_slice_both_dims_split0():
+    a = ht.zeros((13, 5), split=0)
+    a[3:13, 2:5:2] = 1
+    w = np.zeros((13, 5), np.float32)
+    w[3:13, 2:5:2] = 1
+    _chk(a[3:13, 2:5:2], w[3:13, 2:5:2], split=0)
+    np.testing.assert_array_equal(a.numpy(), w)
+
+
+def test_set_with_dndarray_and_arrays_split0():
+    for val in (
+        ht.arange(4),
+        np.arange(4),
+        [0, 1, 2, 3],
+        (0, 1, 2, 3),
+    ):
+        a = ht.zeros((4, 5), split=0)
+        a[1, 0:4] = val
+        for c in range(4):
+            assert float(a[1, c]) == c
+
+
+def test_tril_row_assignment_forms_split0():
+    """Reference :1234-1252: list/tuple/ndarray/DNDarray row writes."""
+    for val in ([6] * 5, (6,) * 5, np.full(5, 6), ht.full((5,), 6.0)):
+        a = ht.ones((4, 5), split=0).tril()
+        a[0] = val
+        assert bool((a[0] == 6).all())
+        assert bool((a[ht.array((0,))] == 6).all())
+
+
+# ---------------------------------------------------------------- #
+# (13, 5) split=1 — reference :1071-1166                            #
+# ---------------------------------------------------------------- #
+def test_row_get_split1():
+    a = ht.zeros((13, 5), split=1)
+    a[10] = 1
+    b = a[10]
+    assert b.dtype is ht.float32 and b.gshape == (5,)
+    # the consumed axis was 0; the surviving axis keeps the sharding
+    assert b.split == 0
+    _lshape_consistent(b)
+
+
+def test_scalar_set_get_split1():
+    a = ht.zeros((13, 5), split=1)
+    a[10, 0] = 1
+    assert float(a[10, 0]) == 1
+
+
+def test_slice_first_dim_split1():
+    a = ht.zeros((13, 5), split=1)
+    a[1:4] = 1
+    w = np.zeros((13, 5), np.float32)
+    w[1:4] = 1
+    _chk(a[1:4], w[1:4], split=1)
+    np.testing.assert_array_equal(a.numpy(), w)
+
+
+def test_scalar_second_dim_split1():
+    """Reference labels a[1:4, 1] on split=1 with result split=0."""
+    a = ht.zeros((13, 5), split=1)
+    a[1:4, 1] = 1
+    w = np.zeros((13, 5), np.float32)
+    w[1:4, 1] = 1
+    _chk(a[1:4, 1], w[1:4, 1], split=0)
+
+
+def test_row_slice_split1():
+    """Reference: a[11, 1:5] on split=1 -> gshape (4,), split 0."""
+    a = ht.zeros((13, 5), split=1)
+    a[11, 1:5] = 1
+    w = np.zeros((13, 5), np.float32)
+    w[11, 1:5] = 1
+    _chk(a[11, 1:5], w[11, 1:5], split=0)
+
+
+def test_tail_slice_scalar_split1():
+    a = ht.zeros((13, 5), split=1)
+    a[8:12, 1] = 1
+    w = np.zeros((13, 5), np.float32)
+    w[8:12, 1] = 1
+    _chk(a[8:12, 1], w[8:12, 1], split=0)
+
+
+def test_slice_both_dims_split1():
+    a = ht.zeros((13, 5), split=1)
+    a[3:13, 2::2] = 1
+    w = np.zeros((13, 5), np.float32)
+    w[3:13, 2::2] = 1
+    _chk(a[3:13, 2:5:2], w[3:13, 2:5:2], split=1)
+
+
+def test_set_with_dndarray_split1():
+    for val in (ht.arange(4), np.arange(4)):
+        a = ht.zeros((4, 5), split=1)
+        a[1, 0:4] = val
+        for c in range(4):
+            assert float(a[1, c]) == c
+
+
+# ---------------------------------------------------------------- #
+# (13, 5, 7) split=2 — reference :1168-1233                         #
+# ---------------------------------------------------------------- #
+def test_plane_set_get_split2():
+    a = ht.zeros((13, 5, 7), split=2)
+    a[10, :, :] = 1
+    b = a[10, :, :]
+    assert b.dtype is ht.float32 and b.gshape == (5, 7)
+    assert b.split == 1  # split axis 2 shifts down past the dropped axis
+    _lshape_consistent(b)
+
+
+def test_scalar_3d_split2():
+    a = ht.zeros((13, 5, 8), split=2)
+    a[10, 0, 0] = 1
+    assert float(a[10, 0, 0]) == 1
+
+
+def test_slice_first_dim_split2():
+    a = ht.zeros((13, 5, 7), split=2)
+    a[1:4] = 1
+    w = np.zeros((13, 5, 7), np.float32)
+    w[1:4] = 1
+    _chk(a[1:4], w[1:4], split=2)
+
+
+def test_mixed_key_split2():
+    """Reference: a[1:4, 1, :] on split=2 -> split=1 result."""
+    a = ht.zeros((13, 5, 7), split=2)
+    a[1:4, 1, :] = 1
+    w = np.zeros((13, 5, 7), np.float32)
+    w[1:4, 1, :] = 1
+    _chk(a[1:4, 1, :], w[1:4, 1, :], split=1)
+
+
+def test_strided_3d_split2():
+    a = ht.zeros((13, 5, 7), split=2)
+    a[3:13, 2:5:2, 1:7:3] = 1
+    w = np.zeros((13, 5, 7), np.float32)
+    w[3:13, 2:5:2, 1:7:3] = 1
+    _chk(a[3:13, 2:5:2, 1:7:3], w[3:13, 2:5:2, 1:7:3], split=2)
+    out = ht.ones((4, 5, 5), split=1)
+    assert out[0].gshape == (5, 5) and out[0].split == 0
+    _lshape_consistent(out[0])
+
+
+# ---------------------------------------------------------------- #
+# layout-hint pins for the heuristic paths (VERDICT r3 weak #4)     #
+# ---------------------------------------------------------------- #
+def test_ellipsis_layout_hints_pinned():
+    """Ellipsis keys bail to a conservative hint: min(split, ndim-1).
+    Values are oracle-exact regardless; this pins the HINT so a silent
+    change (which would reshard every downstream op) fails a test."""
+    a = np.arange(13 * 5 * 7, dtype=np.float32).reshape(13, 5, 7)
+    x = ht.array(a, split=2)
+    np.testing.assert_array_equal(np.asarray(x[..., 0].larray), a[..., 0])
+    assert x[..., 0].split == 1
+    np.testing.assert_array_equal(np.asarray(x[0, ...].larray), a[0, ...])
+    assert x[0, ...].split == 1
+    y = ht.array(a, split=0)
+    np.testing.assert_array_equal(np.asarray(y[..., 0].larray), a[..., 0])
+    assert y[..., 0].split == 0
+
+
+def test_array_key_layout_hints_pinned():
+    """Array keys on/off the split axis: the result hint follows the
+    nearest shardable axis."""
+    a = np.arange(12 * 6, dtype=np.float32).reshape(12, 6)
+    x = ht.array(a, split=0)
+    idx = np.array([0, 5, 11])
+    np.testing.assert_array_equal(np.asarray(x[idx].larray), a[idx])
+    assert x[idx].split == 0
+    np.testing.assert_array_equal(np.asarray(x[:, idx[:2]].larray), a[:, idx[:2]])
+    assert x[:, idx[:2]].split == 0
+    # boolean mask over the split axis
+    m = a[:, 0] > 20
+    np.testing.assert_array_equal(np.asarray(x[m].larray), a[m])
+    assert x[m].split == 0
+
+
+def test_newaxis_and_scalar_bool_layouts():
+    a = np.arange(10 * 4, dtype=np.float32).reshape(10, 4)
+    x = ht.array(a, split=0)
+    got = x[None]
+    np.testing.assert_array_equal(np.asarray(got.larray), a[None])
+    assert got.ndim == 3
+    got2 = x[True]
+    np.testing.assert_array_equal(np.asarray(got2.larray), a[True])
+
+
+def test_setitem_value_dtype_cast():
+    """Values cast to the array dtype on assignment (reference semantics:
+    the container dtype is stable under setitem)."""
+    a = ht.zeros((6, 3), split=0)
+    a[2] = np.arange(3)  # int value into float array
+    assert a.dtype is ht.float32
+    np.testing.assert_array_equal(np.asarray(a[2].larray), [0.0, 1.0, 2.0])
+    b = ht.zeros((6,), dtype=ht.int32, split=0)
+    b[1] = 7.9  # float value into int array truncates like numpy/jnp
+    assert b.dtype is ht.int32
+    assert int(b[1]) == 7
