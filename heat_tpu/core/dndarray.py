@@ -742,23 +742,64 @@ class DNDarray:
     def __result_split(self, key, result_ndim: int) -> Optional[int]:
         """Split bookkeeping for indexing results.
 
-        Basic slicing on non-split axes preserves the split (shifted by the
-        number of integer keys before it); anything that consumes or
-        reorders the split axis yields the nearest shardable axis — a
-        performance heuristic only, since layout never affects values.
-        """
+        For BASIC keys (ints, slices, None, Ellipsis, scalar bools) the
+        output axis of the split is computed exactly: slices preserve it,
+        ints drop axes before it, None/bool insert axes, and an Ellipsis
+        expands to the full slices it stands for.  Advanced (array) keys
+        keep the nearest-shardable-axis heuristic — a performance hint
+        only, since layout never affects values (pinned by
+        tests/test_setitem_matrix.py)."""
         if self.__split is None or result_ndim == 0:
             return None
         split = self.__split
-        if not isinstance(key, tuple):
-            key = (key,)
-        # count integer keys before the split axis; detect split-axis key kind
+        keyt = key if isinstance(key, tuple) else (key,)
+
+        def is_basic(k):
+            return (
+                k is Ellipsis
+                or k is None
+                or isinstance(k, (bool, np.bool_, slice))
+                or (isinstance(k, (int, np.integer)) and not isinstance(k, (bool, np.bool_)))
+            )
+
+        if all(is_basic(k) for k in keyt):
+            consumed = sum(
+                1
+                for k in keyt
+                if isinstance(k, (int, np.integer, slice))
+                and not isinstance(k, (bool, np.bool_))
+            )
+            expanded: List = []
+            for k in keyt:
+                if k is Ellipsis:
+                    expanded.extend([slice(None)] * (self.ndim - consumed))
+                else:
+                    expanded.append(k)
+            dim = 0  # input axis cursor
+            out = 0  # output axis cursor
+            for k in expanded:
+                if k is None or isinstance(k, (bool, np.bool_)):
+                    out += 1  # newaxis / scalar-bool mask inserts an axis
+                    continue
+                if isinstance(k, slice):
+                    if dim == split:
+                        return min(out, result_ndim - 1)
+                    dim += 1
+                    out += 1
+                else:  # integer: drops this input axis
+                    if dim == split:
+                        # split axis consumed: nearest shardable axis
+                        return min(out, result_ndim - 1)
+                    dim += 1
+            # key exhausted before the split axis: the rest map one-to-one
+            return min(out + (split - dim), result_ndim - 1)
+
+        # advanced keys: nearest-shardable heuristic (as before)
         dim = 0
         dropped_before = 0
         split_key = slice(None)
-        for k in key:
+        for k in keyt:
             if k is Ellipsis:
-                # dims after the ellipsis align to the end; conservative bail
                 return min(split, result_ndim - 1)
             if k is None:
                 continue
@@ -769,7 +810,7 @@ class DNDarray:
                 dropped_before += 1
             dim += 1
         if isinstance(split_key, (int, np.integer)):
-            return None if result_ndim == 0 else min(max(split - dropped_before, 0), result_ndim - 1)
+            return min(max(split - dropped_before, 0), result_ndim - 1)
         return min(split - dropped_before, result_ndim - 1)
 
     def __ring_index_plan(self, jkey):

@@ -330,9 +330,14 @@ def percentile(x: DNDarray, q, axis=None, out=None, interpolation: str = "linear
     axis = sanitize_axis(x.shape, axis)
     method = {"linear": "linear", "lower": "lower", "higher": "higher", "midpoint": "midpoint", "nearest": "nearest"}[interpolation]
     qa = jnp.asarray(q, dtype=jnp.float64)
-    arr = x.larray
-    if types.heat_type_is_exact(x.dtype):
-        arr = arr.astype(jnp.float64)
+    # interpolation dtype only — materializing the (possibly ragged) true
+    # view or an f64 copy up front would defeat the padded fast paths below
+    idt = jnp.float64 if types.heat_type_is_exact(x.dtype) else x._buffer.dtype
+
+    def _cast_view():
+        arr = x.larray
+        return arr.astype(jnp.float64) if types.heat_type_is_exact(x.dtype) else arr
+
     from ..parallel import sort as _parallel_sort  # lazy: parallel imports core
 
     if (
@@ -350,7 +355,7 @@ def percentile(x: DNDarray, q, axis=None, out=None, interpolation: str = "linear
         svals, _ = _parallel_sort.ring_rank_sort(
             flat, x.size, comm=x.comm, want_indices=False
         )
-        res = _interp_sorted(svals.astype(arr.dtype), qa, method)
+        res = _interp_sorted(svals.astype(idt), qa, method)
         if keepdims:
             res = jnp.reshape(res, qa.shape + (1,) * x.ndim)
     elif (
@@ -367,7 +372,7 @@ def percentile(x: DNDarray, q, axis=None, out=None, interpolation: str = "linear
         svals, _ = _parallel_sort.sort_axis0(
             moved, x.shape[axis], comm=x.comm, want_indices=False
         )
-        res = _interp_sorted(svals.astype(arr.dtype), qa, method)
+        res = _interp_sorted(svals.astype(idt), qa, method)
         # res: qa.shape + (dims of x without `axis`, original order) —
         # exactly jnp.percentile's layout; keepdims re-inserts the axis
         if keepdims:
@@ -376,11 +381,11 @@ def percentile(x: DNDarray, q, axis=None, out=None, interpolation: str = "linear
         # jnp.percentile only takes rank-<=1 q; numpy allows any shape —
         # flatten, compute, and fold the q axes back in front
         flat = jnp.percentile(
-            arr, qa.reshape(-1), axis=axis, method=method, keepdims=keepdims
+            _cast_view(), qa.reshape(-1), axis=axis, method=method, keepdims=keepdims
         )
         res = flat.reshape(qa.shape + flat.shape[1:])
     else:
-        res = jnp.percentile(arr, qa, axis=axis, method=method, keepdims=keepdims)
+        res = jnp.percentile(_cast_view(), qa, axis=axis, method=method, keepdims=keepdims)
     if np.isscalar(q) or qa.ndim == 0:
         result = _wrap_reduced(x, res, axis, keepdims=keepdims)
     else:

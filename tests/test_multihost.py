@@ -62,6 +62,27 @@ Z = ht.load_hdf5(p, "var", split=0)
 assert float(Z.sum()) == 276.0
 lmap = Z.lshape_map[:, 0].tolist()
 assert lmap == [1, 1, 1, 1, 0, 0, 0, 0], lmap  # ceil-division of 4 over 8
+# r4: ragged padded-at-rest storage spanning both processes — elementwise
+# chain, masked reduction, split-axis cumsum, and the distributed sort all
+# run on the padded buffers with the cluster in lockstep
+R = ht.arange(19, dtype=ht.float32, split=0)  # 19 over 8 devices: ragged
+assert R.padshape == (24,), R.padshape
+assert float(R.sum()) == 171.0
+assert float((R * 2.0 + 1.0).sum()) == 2.0 * 171.0 + 19.0
+assert abs(float(R.mean()) - 9.0) < 1e-5  # pad rows excluded
+cs = R.cumsum(0)
+assert float(cs.max()) == 171.0
+v, idx = ht.sort(-1.0 * R)
+assert float(v.sum()) == -171.0 and float(v.min()) == -18.0
+# r4: ring take/put fancy indexing across the process boundary
+from heat_tpu.core import dndarray as _dnd
+_dnd._RING_INDEX_MIN = 0
+perm = np.random.default_rng(1).permutation(19)
+taken = R[perm]
+assert float(taken.sum()) == 171.0
+back = ht.zeros_like(R)
+back[perm] = taken
+assert float(abs(back - R).sum()) == 0.0
 print(f"proc {{pid}} OK", flush=True)
 """
 

@@ -287,3 +287,18 @@ def test_setitem_value_dtype_cast():
     b[1] = 7.9  # float value into int array truncates like numpy/jnp
     assert b.dtype is ht.int32
     assert int(b[1]) == 7
+
+
+def test_ellipsis_with_newaxis_exact_hint():
+    """r4: basic keys compute the split's output axis EXACTLY — a leading
+    newaxis shifts the hint to the axis that actually carries the data
+    (the old conservative bail returned axis 0 here: the size-1 inserted
+    axis, a useless sharding)."""
+    a = np.arange(13 * 5, dtype=np.float32).reshape(13, 5)
+    x = ht.array(a, split=0)
+    got = x[None, ..., 0]
+    np.testing.assert_array_equal(np.asarray(got.larray), a[None, ..., 0])
+    assert got.split == 1  # the 13-axis, not the inserted 1-axis
+    got2 = x[None, 2:9]
+    np.testing.assert_array_equal(np.asarray(got2.larray), a[None, 2:9])
+    assert got2.split == 1
