@@ -380,6 +380,16 @@ class XlaCommunication(Communication):
         None→split a local slice-discard, split→split an all-to-all."""
         return self.apply_sharding(array, split)
 
+    def commit_split(self, array: jax.Array, split: Optional[int]) -> jax.Array:
+        """Reshard a TRUE-shape global array to ``split`` in its at-rest
+        form: a ragged target axis pads+shards in ONE step (apply_sharding
+        on the ragged view would commit it replicated first); divisible or
+        replicated targets take the plain reshard.  The single dispatch
+        site shared by in-place and out-of-place resplit."""
+        if split is not None and array.ndim and array.shape[split] % max(self.size, 1):
+            return self.pad_to_shards(array, axis=split)
+        return self.apply_sharding(array, split)
+
     def allreduce(self, array: jax.Array, op: str = "sum") -> jax.Array:
         """All-reduce a *per-position* quantity (reference ``Allreduce``,
         communication.py:516-523).

@@ -540,11 +540,8 @@ class DNDarray:
             return self
         true = self.larray
         self.__split = axis
-        if axis is not None and self.__gshape and self.__gshape[axis] % max(self.__comm.size, 1):
-            # ragged target axis: pad+shard in one step (the at-rest form)
-            self.__array = self.__comm.pad_to_shards(true, axis=axis)
-        else:
-            self.__array = self.__comm.resplit(true, axis)
+        # commit_split pads+shards ragged target axes in one step
+        self.__array = self.__comm.commit_split(true, axis)
         self.__balanced = True
         self._invalidate_halos()
         return self

@@ -274,7 +274,7 @@ def resplit(arr: DNDarray, axis: Optional[int] = None) -> DNDarray:
         return DNDarray(
             arr._buffer, arr.shape, arr.dtype, axis, arr.device, arr.comm, arr.balanced
         )
-    garr = arr.comm.resplit(arr.larray, axis)
+    garr = arr.comm.commit_split(arr.larray, axis)
     return DNDarray(garr, arr.shape, arr.dtype, axis, arr.device, arr.comm, True)
 
 

@@ -343,7 +343,7 @@ def percentile(x: DNDarray, q, axis=None, out=None, interpolation: str = "linear
     if (
         axis is None
         and x.split is not None
-        and _parallel_sort.supports(x.larray.dtype, x.size, x.comm)
+        and _parallel_sort.supports(x._buffer.dtype, x.size, x.comm)
     ):
         # global percentile of a sharded array: jnp.percentile's internal
         # sort is the pathological GSPMD global sort — rank-sort over the
@@ -361,7 +361,7 @@ def percentile(x: DNDarray, q, axis=None, out=None, interpolation: str = "linear
     elif (
         isinstance(axis, int)
         and axis == x.split
-        and _parallel_sort.supports_axis(x.larray.dtype, x.shape, axis, x.comm)
+        and _parallel_sort.supports_axis(x._buffer.dtype, x.shape, axis, x.comm)
     ):
         # axis-quantile ALONG the split axis: the reference resolves this
         # with a distributed partition gather (statistics.py:1171-1422);
