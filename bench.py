@@ -79,6 +79,20 @@ _KNOWN_OUTLIERS = {
 #: every flagged delta ships with a written disposition).  Update per round
 #: when the relevant code paths change.
 _FLAG_DISPOSITIONS = {
+    "kmeans_iter_per_sec":
+        "whole-fit while_loop unchanged since r2; same-day same-binary runs "
+        "spanned 9174-9888 iter/s with up to 20% spread under tunnel "
+        "degradation — read spread_pct before calling a <10% slide real",
+    "kmedians_iter_per_sec":
+        "r4 warm-started bisection measures the steady-state regime "
+        "(init = generating centers, the KMeans convention); r1-r3 history "
+        "used the data-row churn init and maps to "
+        "kmedians_churn_iter_per_sec instead",
+    "kmedians_churn_iter_per_sec":
+        "the adversarial regime: a permanent ~3% label limit cycle forces "
+        "full-range bisections every iteration; ~143 iter/s is the "
+        "structural rate there (see docs/design.md §8 for the measured "
+        "probe-strategy dead ends)",
     "cdist_gb_per_sec":
         "kernel unchanged since r1 (quadratic_d2 + fused fori loop); r1-r4 "
         "measured 1005/1354/1033/~1075 — day-scale tunnel/machine variance "

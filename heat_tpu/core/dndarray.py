@@ -70,6 +70,39 @@ import os as _os
 _RING_INDEX_MIN = int(_os.environ.get("HEAT_TPU_RING_INDEX_MIN", str(1 << 22)))
 
 
+def _fit_index_array(k, n: int):
+    """Normalize an integer index array for axis length ``n`` so jax's
+    documented clamp (gather) / drop (scatter) semantics hold WITHOUT the
+    silent int32 truncation jax applies to wide keys (an int64 index of
+    2**32+3 otherwise reads/writes row 3), and without the OverflowError
+    narrow keys (int8 on an axis longer than their range) trigger.
+
+    Values are mapped into int32-safe sentinels that jax post-processes to
+    its own semantics: OOB-high → ``n`` (gather clamps to n-1, scatter
+    drops), OOB-low → ``-2n`` (one wrap later still negative: gather
+    clamps to 0, scatter drops).  Host numpy arrays normalize for free;
+    device arrays pay two elementwise ops only for risky dtypes.
+    """
+    if n <= 0 or 2 * n >= 2**31:
+        return k
+    if isinstance(k, np.ndarray):
+        if np.issubdtype(k.dtype, np.unsignedinteger):
+            return np.minimum(k, np.asarray(n, np.uint64)).astype(np.int32)
+        kk = k.astype(np.int64)
+        return np.where(kk >= n, n, np.where(kk < -n, -2 * n, kk)).astype(np.int32)
+    dt = k.dtype
+    if jnp.issubdtype(dt, jnp.unsignedinteger):
+        if np.dtype(dt).itemsize <= 2:
+            return k  # uint8/16 fit int32; jax clamps/drops them natively
+        return jnp.minimum(k, jnp.asarray(n, dt)).astype(jnp.int32)
+    if np.dtype(dt).itemsize <= 2:
+        return k.astype(jnp.int32)  # widen int8/16 past their own range
+    if np.dtype(dt).itemsize == 4:
+        return k  # int32 cannot out-range int32
+    kk = jnp.where(k >= n, n, jnp.where(k < -n, -2 * n, k))
+    return kk.astype(jnp.int32)
+
+
 class LocalIndex:
     """Indexing proxy over the raw backing array
     (reference dndarray.py:37-50, exposed as ``x.lloc``).
@@ -700,7 +733,20 @@ class DNDarray:
             if isinstance(k, np.ndarray):
                 if k.size == 0:  # numpy: a[[]] selects nothing, not float64
                     k = k.astype(np.int32)
+                if (
+                    np.issubdtype(k.dtype, np.integer)
+                    and dim is not None
+                    and dim < self.ndim
+                ):
+                    k = _fit_index_array(k, self.__gshape[dim])
                 return jnp.asarray(k)
+            if (
+                isinstance(k, (jnp.ndarray, jax.Array))
+                and jnp.issubdtype(k.dtype, jnp.integer)
+                and dim is not None
+                and dim < self.ndim
+            ):
+                return _fit_index_array(k, self.__gshape[dim])
             return k
 
         def consumed(k):

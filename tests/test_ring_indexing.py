@@ -187,7 +187,10 @@ def test_getitem_end_to_end_lowering_stays_ring(ring_always):
 def test_ring_put_wide_oob_index_drops_not_truncates(ring_always):
     """A 64-bit out-of-range index must DROP, not truncate into a valid
     row (int32 cast before the range check silently corrupted row
-    idx % 2**32 — r4 review finding)."""
+    idx % 2**32 — r4 review finding).  Holds on BOTH paths: the ring
+    sanitizes in _sanitize_index; the plain jnp path (single device /
+    below the size gate) sanitizes in __process_key via
+    _fit_index_array — raw jnp would write row 3 here."""
     import jax as _jax
 
     if not _jax.config.jax_enable_x64:
@@ -228,3 +231,30 @@ def test_ring_unsigned_index_dtypes(ring_always):
     x[big] = 42.0
     np.testing.assert_array_equal(x.numpy(), before)
     np.testing.assert_allclose(x[big].numpy(), a[[n - 1]])
+
+
+def test_plain_path_below_gate_shares_oob_semantics(monkeypatch):
+    """Below _RING_INDEX_MIN the plain jnp path serves — its OOB handling
+    must match the ring path exactly (clamp on gather, drop on scatter),
+    never jax's raw int32 truncation (r4 review finding: the guarantee
+    silently held only above the size gate)."""
+    import jax as _jax
+
+    monkeypatch.setattr(_dnd, "_RING_INDEX_MIN", 10**9)  # force plain path
+    n = 14
+    a, x = _mk((n,), 0)
+    if _jax.config.jax_enable_x64:
+        big = np.array([2**32 + 3], dtype=np.int64)
+        x[big] = 99.0
+        np.testing.assert_array_equal(x.numpy(), a)        # drop, row 3 intact
+        np.testing.assert_allclose(x[big].numpy(), a[[n - 1]])  # clamp
+    # narrow dtype past its own range
+    m = 200
+    b, y = _mk((m,), 0, seed=3)
+    idx8 = np.array([-5, 3], dtype=np.int8)
+    np.testing.assert_allclose(y[idx8].numpy(), b[idx8])
+    # very-negative: gather clamps to row 0, scatter drops
+    far = np.array([-(3 * n)])
+    np.testing.assert_allclose(x[far].numpy(), a[[0]])
+    x[far] = -1.0
+    np.testing.assert_array_equal(x.numpy(), a)
