@@ -79,17 +79,21 @@ def _fit_index_array(k, n: int):
 
     Values are mapped into int32-safe sentinels that jax post-processes to
     its own semantics: OOB-high → ``n`` (gather clamps to n-1, scatter
-    drops), OOB-low → ``-2n`` (one wrap later still negative: gather
-    clamps to 0, scatter drops).  Host numpy arrays normalize for free;
+    drops), OOB-low → ``-(n+1)`` (one wrap later still ``-1`` < 0: gather
+    clamps to 0, scatter drops).  Both sentinels fit int32 for every
+    ``n < 2**31 - 1``, i.e. for every axis jax itself can index with
+    int32 — there is no unguarded large-``n`` regime (the r4 advisor
+    found the previous ``2n``-based sentinel silently skipped
+    normalization for n ≥ 2**30).  Host numpy arrays normalize for free;
     device arrays pay two elementwise ops only for risky dtypes.
     """
-    if n <= 0 or 2 * n >= 2**31:
+    if n <= 0 or n >= 2**31 - 1:
         return k
     if isinstance(k, np.ndarray):
         if np.issubdtype(k.dtype, np.unsignedinteger):
             return np.minimum(k, np.asarray(n, np.uint64)).astype(np.int32)
         kk = k.astype(np.int64)
-        return np.where(kk >= n, n, np.where(kk < -n, -2 * n, kk)).astype(np.int32)
+        return np.where(kk >= n, n, np.where(kk < -n, -(n + 1), kk)).astype(np.int32)
     dt = k.dtype
     if jnp.issubdtype(dt, jnp.unsignedinteger):
         if np.dtype(dt).itemsize <= 2:
@@ -99,7 +103,7 @@ def _fit_index_array(k, n: int):
         return k.astype(jnp.int32)  # widen int8/16 past their own range
     if np.dtype(dt).itemsize == 4:
         return k  # int32 cannot out-range int32
-    kk = jnp.where(k >= n, n, jnp.where(k < -n, -2 * n, k))
+    kk = jnp.where(k >= n, n, jnp.where(k < -n, -(n + 1), k))
     return kk.astype(jnp.int32)
 
 
@@ -908,7 +912,11 @@ class DNDarray:
         if s != 0:
             buf = jnp.moveaxis(buf, s, 0)
         # oob='clip': jnp gather clamp semantics (wrap negatives, clip to
-        # range) — sanitation happens exactly once, inside ring_take
+        # range).  The key arrives already sentinel-mapped by
+        # _fit_index_array (__process_key); ring_take's own _sanitize_index
+        # composes with those sentinels (n stays a drop, -(n+1) wraps to -1
+        # and still clamps/drops) — two cheap passes on the index vector,
+        # each safe alone
         out = ring_take(buf, idx, comm=comm, n=n, padded_out=True, oob="clip")
         if s != 0:
             out = jnp.moveaxis(out, 0, s)
@@ -1405,10 +1413,10 @@ class DNDarray:
 
         return statistics.min(self, axis, out, keepdims, keepdim)
 
-    def mean(self, axis=None):
+    def mean(self, axis=None, keepdims=None, keepdim=None):
         from . import statistics
 
-        return statistics.mean(self, axis)
+        return statistics.mean(self, axis, keepdims=keepdims, keepdim=keepdim)
 
     def median(self, axis=None, keepdim=None, keepdims=None):
         from . import statistics

@@ -138,8 +138,16 @@ def array(
     if copy and isinstance(obj, (jnp.ndarray, jax.Array, DNDarray)):
         garr = jnp.array(garr, copy=True)
 
-    while garr.ndim < ndmin:
-        garr = garr[jnp.newaxis]
+    if not isinstance(ndmin, (int, np.integer)) or isinstance(ndmin, bool):
+        raise TypeError(f"expected ndmin to be int, but was {type(ndmin)}")
+    # pad to abs(ndmin) dims by PREPENDING singleton axes.  The reference
+    # accepts negative ndmin and prepends for it (factories.py:361-365);
+    # for positive ndmin its code appends while its own docstring example
+    # (factories.py:204-205) shows numpy's prepend — we follow numpy and
+    # the docstring (see docs/migration.md)
+    ndmin_abs = abs(int(ndmin)) - garr.ndim
+    if ndmin_abs > 0:
+        garr = garr.reshape((1,) * ndmin_abs + tuple(garr.shape))
 
     split = sanitize_axis(garr.shape, split)
     return _wrap(garr, dtype, split, device, comm)

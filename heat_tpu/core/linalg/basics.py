@@ -95,6 +95,18 @@ def matmul(a: DNDarray, b: DNDarray, allow_resplit: bool = False) -> DNDarray:
     """
     sanitize_in(a)
     sanitize_in(b)
+    if a.ndim == 0 or b.ndim == 0:
+        raise ValueError("matmul does not accept 0-d operands (use mul)")
+    # numpy contraction rule: last axis of a against b's second-to-last
+    # (or only) axis — mismatches are the reference's ValueError contract
+    # (basics.py:83-96), not a backend TypeError
+    k_a = a.shape[-1]
+    k_b = b.shape[-2] if b.ndim >= 2 else b.shape[0]
+    if k_a != k_b:
+        raise ValueError(
+            f"matmul shape mismatch: {a.shape} @ {b.shape} "
+            f"(contracting {k_a} vs {k_b})"
+        )
     promoted = types.promote_types(a.dtype, b.dtype)
     aa = a.larray.astype(promoted.jax_type())
     ba = b.larray.astype(promoted.jax_type())

@@ -283,17 +283,23 @@ def maximum(x1, x2, out=None):
     return _operations.__binary_op(jnp.maximum, x1, x2, out)
 
 
-def mean(x, axis=None):
+def mean(x, axis=None, keepdims=None, keepdim=None):
     """Arithmetic mean (reference statistics.py:728-869; cross-shard moment
-    combination is XLA's)."""
+    combination is XLA's).  ``axis`` may be an int or a tuple of ints;
+    ``keepdims``/``keepdim`` follow numpy/torch spelling like every other
+    reduction here (the reference's mean lacks it — kept for oracle
+    conformance)."""
+    keepdims = merge_keepdims(keepdims, keepdim)
     sanitize_in(x)
     axis = sanitize_axis(x.shape, axis)
     cast = jnp.float32 if types.heat_type_is_exact(x.dtype) else None
     fn = jitted(
-        ("stat.mean", axis, cast),
-        lambda: lambda a: jnp.mean(a.astype(cast) if cast else a, axis=axis),
+        ("stat.mean", axis, cast, keepdims),
+        lambda: lambda a: jnp.mean(
+            a.astype(cast) if cast else a, axis=axis, keepdims=keepdims
+        ),
     )
-    return _wrap_reduced(x, fn(x.larray), axis)
+    return _wrap_reduced(x, fn(x.larray), axis, keepdims=keepdims)
 
 
 def median(x: DNDarray, axis=None, keepdim=None, out=None, keepdims=None):
@@ -445,19 +451,23 @@ def _moment2(x, axis, ddof, kwargs, name, finalize):
     if ddof not in (0, 1):
         raise ValueError(f"ddof must be 0 or 1, got {ddof}")
     axis = sanitize_axis(x.shape, axis)
+    keepdims = merge_keepdims(kwargs.pop("keepdims", None), kwargs.pop("keepdim", None))
+    if kwargs:
+        raise TypeError(f"unexpected keyword arguments: {sorted(kwargs)}")
     cast = jnp.float32 if types.heat_type_is_exact(x.dtype) else None
     fn = jitted(
-        (name, axis, ddof, cast),
+        (name, axis, ddof, cast, keepdims),
         lambda: lambda a: finalize(
-            jnp.var(a.astype(cast) if cast else a, axis=axis, ddof=ddof)
+            jnp.var(a.astype(cast) if cast else a, axis=axis, ddof=ddof, keepdims=keepdims)
         ),
     )
-    return _wrap_reduced(x, fn(x.larray), axis)
+    return _wrap_reduced(x, fn(x.larray), axis, keepdims=keepdims)
 
 
 def std(x, axis=None, ddof: int = 0, **kwargs):
     """Standard deviation (reference statistics.py:1466-1558) — one fused
-    sqrt(var) executable rather than two dispatches."""
+    sqrt(var) executable rather than two dispatches.  Accepts numpy's
+    ``keepdims`` and tuple axes like :func:`var`."""
     return _moment2(x, axis, ddof, kwargs, "stat.std", jnp.sqrt)
 
 

@@ -76,11 +76,15 @@ class SplitTiles:
         """Global-coordinate slices of the tile at grid position ``pos``
         (partial keys select position 0 on the omitted trailing dims, like
         ``__getitem__``)."""
-        if isinstance(pos, int):
+        if isinstance(pos, (int, np.integer)):
             pos = (pos,)
         pos = tuple(pos) + (0,) * (len(self.__tile_ends) - len(pos))
         slices = []
         for dim, p in enumerate(pos):
+            if not isinstance(p, (int, np.integer)):
+                raise TypeError(
+                    f"tile keys must be ints, got {type(p)}"
+                )  # reference tiling.py:166-171
             ends = self.__tile_ends[dim]
             start = 0 if p == 0 else int(ends[p - 1])
             slices.append(slice(start, int(ends[p])))
@@ -130,6 +134,13 @@ class SquareDiagTiles:
     """
 
     def __init__(self, arr, tiles_per_proc: int = 1):
+        from .sanitation import sanitize_in
+
+        sanitize_in(arr)  # reference tiling.py:349-352: TypeError contract
+        if not isinstance(tiles_per_proc, (int, np.integer)) or isinstance(
+            tiles_per_proc, bool
+        ):
+            raise TypeError(f"tiles_per_proc must be an int, got {type(tiles_per_proc)}")
         if arr.ndim != 2:
             raise ValueError("SquareDiagTiles requires a 2-D DNDarray")
         if tiles_per_proc < 1:

@@ -1,0 +1,184 @@
+"""Domain-edge and special-value semantics for the elementwise tier —
+the scenario corners of the reference's test_exponential.py,
+test_trigonometrics.py, test_rounding.py and the ``__local_op``
+float-promotion rule (reference _operations.py:295-300): out-of-domain
+inputs produce numpy's nan/inf pattern (never crash), integer inputs
+float-promote through transcendental ops, and sign conventions of
+mod/fmod/floordiv match the oracle."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import heat_tpu as ht
+
+SPLITS = [None, 0]
+
+
+@pytest.mark.parametrize("split", SPLITS)
+@pytest.mark.parametrize(
+    "name", ["log", "log2", "log10", "log1p", "sqrt"]
+)
+def test_out_of_domain_nan_inf_pattern(split, name):
+    vals = np.array([-2.0, -1.0, 0.0, 1.0, 4.0], dtype=np.float32)
+    x = ht.array(vals, split=split)
+    with np.errstate(all="ignore"):
+        want = getattr(np, name)(vals)
+    got = getattr(ht, name)(x).numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-6, equal_nan=True)
+
+
+@pytest.mark.parametrize("split", SPLITS)
+def test_arcsin_arccos_out_of_domain(split):
+    vals = np.array([-1.5, -1.0, 0.0, 1.0, 1.0001], dtype=np.float32)
+    x = ht.array(vals, split=split)
+    with np.errstate(all="ignore"):
+        np.testing.assert_allclose(
+            ht.arcsin(x).numpy(), np.arcsin(vals), rtol=1e-6, equal_nan=True
+        )
+        np.testing.assert_allclose(
+            ht.arccos(x).numpy(), np.arccos(vals), rtol=1e-6, equal_nan=True
+        )
+
+
+@pytest.mark.parametrize("split", SPLITS)
+def test_division_by_zero_signs(split):
+    num = np.array([-1.0, 0.0, 1.0], dtype=np.float32)
+    x = ht.array(num, split=split)
+    z = ht.array(np.zeros(3, np.float32), split=split)
+    with np.errstate(all="ignore"):
+        want = num / np.zeros(3, np.float32)  # [-inf, nan, inf]
+    np.testing.assert_array_equal(np.isnan((x / z).numpy()), np.isnan(want))
+    got = (x / z).numpy()
+    assert np.isneginf(got[0]) and np.isposinf(got[2])
+
+
+@pytest.mark.parametrize(
+    "name", ["sin", "cos", "exp", "sqrt", "log", "tanh", "arctan"]
+)
+@pytest.mark.parametrize("dtype", [ht.int32, ht.int64, ht.uint8, ht.bool])
+def test_local_op_float_promotion(name, dtype):
+    # reference _operations.py:295-300: transcendental maps promote
+    # non-float inputs to float
+    x = ht.array(np.array([1, 2, 3]), dtype=dtype, split=0)
+    out = getattr(ht, name)(x)
+    assert ht.types.heat_type_is_exact(out.dtype) is False
+    npdt = np.dtype(x.numpy().dtype)
+    with np.errstate(all="ignore"):
+        want = getattr(np, name)(x.numpy().astype(np.float64))
+    np.testing.assert_allclose(out.numpy().astype(np.float64), want, rtol=1e-5)
+
+
+@pytest.mark.parametrize("split", SPLITS)
+def test_modf_parts_and_dtype(split):
+    vals = np.array([1.5, -2.25, 0.0, 3.999], dtype=np.float32)
+    x = ht.array(vals, split=split)
+    frac, whole = ht.modf(x)
+    nf, nw = np.modf(vals)
+    np.testing.assert_allclose(frac.numpy(), nf, rtol=1e-6)
+    np.testing.assert_allclose(whole.numpy(), nw, rtol=1e-6)
+    assert frac.dtype is ht.float32 and whole.dtype is ht.float32
+    # out= tuple form (reference rounding.py modf signature)
+    fo = ht.zeros(4, dtype=ht.float32, split=split)
+    wo = ht.zeros(4, dtype=ht.float32, split=split)
+    ht.modf(x, out=(fo, wo))
+    np.testing.assert_allclose(fo.numpy(), nf, rtol=1e-6)
+    np.testing.assert_allclose(wo.numpy(), nw, rtol=1e-6)
+
+
+@pytest.mark.parametrize("split", SPLITS)
+def test_round_half_even_and_decimals(split):
+    vals = np.array([0.5, 1.5, 2.5, -0.5, -1.5, 2.675], dtype=np.float32)
+    x = ht.array(vals, split=split)
+    np.testing.assert_array_equal(ht.round(x).numpy(), np.round(vals))
+    np.testing.assert_allclose(
+        ht.round(ht.array(np.array([1.234, 5.678], np.float32), split=split), 2).numpy(),
+        np.array([1.23, 5.68], np.float32),
+        rtol=1e-6,
+    )
+
+
+@pytest.mark.parametrize("split", SPLITS)
+def test_clip_forms(split):
+    vals = np.arange(10, dtype=np.float32)
+    x = ht.array(vals, split=split)
+    np.testing.assert_array_equal(ht.clip(x, 2, 7).numpy(), np.clip(vals, 2, 7))
+    np.testing.assert_array_equal(ht.clip(x, 2, None).numpy(), np.clip(vals, 2, None))
+    np.testing.assert_array_equal(ht.clip(x, None, 7).numpy(), np.clip(vals, None, 7))
+    # method form, matching the reference's DNDarray.clip
+    np.testing.assert_array_equal(x.clip(3, 6).numpy(), np.clip(vals, 3, 6))
+
+
+@pytest.mark.parametrize("split", SPLITS)
+@pytest.mark.parametrize("dtype", [np.float32, np.int32])
+def test_mod_fmod_floordiv_sign_conventions(split, dtype):
+    a = np.array([-7, 7, -7, 7], dtype=dtype)
+    b = np.array([3, 3, -3, -3], dtype=dtype)
+    x, y = ht.array(a, split=split), ht.array(b, split=split)
+    # mod: sign of divisor (python/numpy); fmod: sign of dividend (C)
+    np.testing.assert_array_equal(ht.mod(x, y).numpy(), np.mod(a, b))
+    np.testing.assert_array_equal(ht.fmod(x, y).numpy(), np.fmod(a, b))
+    np.testing.assert_array_equal(ht.floordiv(x, y).numpy(), a // b)
+
+
+@pytest.mark.parametrize("split", SPLITS)
+def test_pow_edge_exponents(split):
+    base = np.array([2.0, 3.0, 0.5], dtype=np.float32)
+    x = ht.array(base, split=split)
+    np.testing.assert_allclose(ht.pow(x, -2).numpy(), base ** -2.0, rtol=1e-4)
+    np.testing.assert_allclose(ht.pow(x, 0).numpy(), np.ones(3), rtol=1e-6)
+    np.testing.assert_allclose(ht.pow(x, 0.5).numpy(), base ** 0.5, rtol=1e-6)
+    with np.errstate(all="ignore"):
+        want = np.array([-2.0, 0.0, 2.0], np.float32) ** 0.5
+    got = ht.pow(ht.array(np.array([-2.0, 0.0, 2.0], np.float32), split=split), 0.5)
+    np.testing.assert_allclose(got.numpy(), want, rtol=1e-6, equal_nan=True)
+
+
+@pytest.mark.parametrize("split", SPLITS)
+def test_abs_sign_and_aliases(split):
+    vals = np.array([-3.5, 0.0, 2.25], dtype=np.float32)
+    x = ht.array(vals, split=split)
+    np.testing.assert_array_equal(ht.abs(x).numpy(), np.abs(vals))
+    np.testing.assert_array_equal(ht.absolute(x).numpy(), np.abs(vals))
+    np.testing.assert_array_equal(ht.sign(x).numpy(), np.sign(vals))
+    iv = np.array([-3, 0, 4], dtype=np.int32)
+    out = ht.abs(ht.array(iv, split=split))
+    assert out.dtype is ht.int32
+    np.testing.assert_array_equal(out.numpy(), np.abs(iv))
+
+
+@pytest.mark.parametrize("split", SPLITS)
+def test_nan_propagation_through_binary_chain(split):
+    a = np.array([1.0, np.nan, 3.0], dtype=np.float32)
+    b = np.array([np.inf, 2.0, -np.inf], dtype=np.float32)
+    x, y = ht.array(a, split=split), ht.array(b, split=split)
+    with np.errstate(all="ignore"):
+        want = (a + b) * (a - b) / (a * b)
+    got = ((x + y) * (x - y) / (x * y)).numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-5, equal_nan=True)
+    # isnan/isinf/isfinite agree with the oracle on the chain result
+    np.testing.assert_array_equal(ht.isnan(ht.array(got, split=split)).numpy(), np.isnan(want))
+    np.testing.assert_array_equal(ht.isinf(ht.array(got, split=split)).numpy(), np.isinf(want))
+    np.testing.assert_array_equal(
+        ht.isfinite(ht.array(got, split=split)).numpy(), np.isfinite(want)
+    )
+
+
+@pytest.mark.parametrize("split", SPLITS)
+def test_expm1_log1p_precision_near_zero(split):
+    # the whole reason expm1/log1p exist: tiny-x precision
+    tiny = np.array([1e-7, -1e-7, 1e-6], dtype=np.float32)
+    x = ht.array(tiny, split=split)
+    np.testing.assert_allclose(ht.expm1(x).numpy(), np.expm1(tiny), rtol=1e-6)
+    np.testing.assert_allclose(ht.log1p(x).numpy(), np.log1p(tiny), rtol=1e-6)
+    # naive exp(x)-1 would lose everything; check we didn't implement it that way
+    assert abs(float(ht.expm1(ht.array(np.float32(1e-7)))) - 1e-7) < 1e-12
+
+
+def test_trunc_floor_ceil_negative_values():
+    vals = np.array([-2.7, -0.5, 0.5, 2.7], dtype=np.float32)
+    x = ht.array(vals, split=0)
+    np.testing.assert_array_equal(ht.trunc(x).numpy(), np.trunc(vals))
+    np.testing.assert_array_equal(ht.floor(x).numpy(), np.floor(vals))
+    np.testing.assert_array_equal(ht.ceil(x).numpy(), np.ceil(vals))

@@ -1,0 +1,154 @@
+"""Factory error contracts and edge forms — the exception sweeps of the
+reference's test_factories.py (:110-114, :286-308, :380-384, :424-426,
+:526-530, :574-576, :632-636, :686-690, ...) plus retstep/ndmin edge
+semantics, against this package's constructors."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import heat_tpu as ht
+
+
+def test_arange_contracts():
+    # reference test_factories.py:110-114
+    with pytest.raises(ValueError):
+        ht.arange(-5, 3, split=1)
+    with pytest.raises(TypeError):
+        ht.arange()
+    with pytest.raises(TypeError):
+        ht.arange(1, 2, 3, 4)
+    # float step keeps numpy's count semantics
+    a = ht.arange(0, 1, 0.1)
+    assert a.shape == (10,)
+    np.testing.assert_allclose(a.numpy(), np.arange(0, 1, 0.1, dtype=np.float32), rtol=1e-6)
+    # negative direction
+    np.testing.assert_array_equal(ht.arange(5, 0, -2).numpy(), np.arange(5, 0, -2))
+    # empty range
+    assert ht.arange(3, 3).shape == (0,)
+
+
+def test_array_contracts():
+    # reference test_factories.py:286-308
+    with pytest.raises(ValueError):
+        ht.array([[1.0, 2.0], [3.0, 4.0]], split=0, is_split=0)
+    with pytest.raises(TypeError):
+        ht.array(map)
+    with pytest.raises(TypeError):
+        ht.array("abc")
+    with pytest.raises(TypeError):
+        ht.array((4,), dtype="a")
+    with pytest.raises(TypeError):
+        ht.array((4,), ndmin=3.0)
+    with pytest.raises(TypeError):
+        ht.array((4,), split="a")
+    with pytest.raises(ValueError):
+        ht.array((4,), split=3)
+    with pytest.raises(TypeError):
+        ht.array((4,), comm={})
+
+
+def test_array_ndmin_signs():
+    # positive: numpy/docstring prepend; negative: reference extension,
+    # also prepend (factories.py:361-365) — see docs/migration.md
+    assert ht.array([1, 2, 3], ndmin=2).shape == (1, 3)
+    assert ht.array([[1.0, 2.0, 3.0], [4.0, 5.0, 6.0]], ndmin=-3).shape == (1, 2, 3)
+    assert ht.array([1, 2, 3], ndmin=1).shape == (3,)
+    assert ht.array(5.0, ndmin=2).shape == (1, 1)
+
+
+def test_empty_zeros_ones_full_contracts():
+    # reference test_factories.py:380-384, :526-530, :732-736, :824-828
+    for factory in (ht.empty, ht.zeros, ht.ones):
+        with pytest.raises(TypeError):
+            factory("(2, 3,)", dtype=ht.float64)
+        with pytest.raises(ValueError):
+            factory((-1, 3), dtype=ht.float64)
+        with pytest.raises(TypeError):
+            factory((2, 3), split="axis")
+    with pytest.raises(TypeError):
+        ht.full((2, 2), [1, 2, 3])
+    # scalar shape forms
+    assert ht.zeros(4).shape == (4,)
+    assert ht.ones(np.int64(3)).shape == (3,)
+    f = ht.full((2, 3), 7, dtype=ht.int32)
+    assert f.dtype is ht.int32
+    np.testing.assert_array_equal(f.numpy(), np.full((2, 3), 7, np.int32))
+
+
+def test_like_contracts():
+    # reference test_factories.py:424-426, :574-576, :780-782
+    base = ht.ones((4, 3), split=0)
+    with pytest.raises(TypeError):
+        ht.empty_like(base, dtype="abc")
+    with pytest.raises(TypeError):
+        ht.empty_like(base, split="axis")
+    for like in (ht.zeros_like, ht.ones_like, ht.empty_like):
+        out = like(base)
+        assert out.shape == (4, 3) and out.split == 0 and out.dtype is base.dtype
+    fl = ht.full_like(base, 2.5)
+    assert np.all(fl.numpy() == 2.5)
+
+
+def test_linspace_logspace_contracts():
+    # reference test_factories.py:632-636, :686-690
+    with pytest.raises(ValueError):
+        ht.linspace(-5, 3, split=1)
+    with pytest.raises(ValueError):
+        ht.linspace(-5, 3, num=-1)
+    with pytest.raises(ValueError):
+        ht.linspace(-5, 3, num=0)
+    arr, step = ht.linspace(-5, 3, num=70, retstep=True)
+    assert isinstance(step, float)
+    assert np.isclose(step, 0.11594202898550725)
+    np.testing.assert_allclose(
+        arr.numpy(), np.linspace(-5, 3, 70, dtype=np.float32), rtol=1e-5, atol=1e-6
+    )
+    # single-sample and endpoint=False forms
+    np.testing.assert_allclose(ht.linspace(2, 10, num=1).numpy(), [2.0])
+    np.testing.assert_allclose(
+        ht.linspace(0, 1, num=5, endpoint=False).numpy(),
+        np.linspace(0, 1, 5, endpoint=False, dtype=np.float32),
+        rtol=1e-6,
+    )
+    with pytest.raises(ValueError):
+        ht.logspace(-5, 3, split=1)
+    np.testing.assert_allclose(
+        ht.logspace(0, 3, num=4, base=2.0).numpy(),
+        np.logspace(0, 3, num=4, base=2.0, dtype=np.float32),
+        rtol=1e-5,
+    )
+
+
+@pytest.mark.parametrize("split", [None, 0, 1])
+def test_eye_forms(split):
+    # reference test_factories.py:429-492: square, wide, tall, dtypes
+    for shape in (5, (4, 7), (9, 3)):
+        got = ht.eye(shape, split=split, dtype=ht.float32)
+        want = np.eye(*((shape, shape) if isinstance(shape, int) else shape), dtype=np.float32)
+        np.testing.assert_array_equal(got.numpy(), want)
+        assert got.split == split
+    i = ht.eye(4, dtype=ht.int32)
+    assert i.dtype is ht.int32
+
+
+def test_empty_is_allocated_not_poisoned():
+    # reference empty only guarantees shape/dtype; ours must at least be
+    # finite-sized and writable
+    e = ht.empty((3, 4), dtype=ht.float32, split=0)
+    assert e.shape == (3, 4)
+    e[:] = 1.0
+    assert np.all(e.numpy() == 1.0)
+
+
+def test_asarray_no_copy_semantics():
+    # reference test_factories.py:311-344
+    x = ht.arange(6, dtype=ht.float32, split=0)
+    y = ht.asarray(x)
+    assert y is x  # same dtype, no copy requested -> identity
+    z = ht.asarray(x, dtype=ht.int32)
+    assert z.dtype is ht.int32
+    a = np.arange(4, dtype=np.float32)
+    w = ht.asarray(a)
+    np.testing.assert_array_equal(w.numpy(), a)

@@ -1,0 +1,270 @@
+"""Higher-rank split-axis battery: 3-D/4-D arrays with the split on
+interior and trailing axes.
+
+The reference's suite sweeps EVERY split axis of n-D data in every test
+via ``assert_func_equal`` (test_suites/basic_test.py:141); this module
+gives the split=1/2/3 axes of higher-rank arrays the same systematic
+treatment — reductions, sort, cum-ops, percentile, manipulations,
+resplit, indexing — against the numpy oracle on any mesh size.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import heat_tpu as ht
+
+SPLITS = [None, 0, 1, 2]
+
+D3 = np.arange(6 * 5 * 8, dtype=np.float32).reshape(6, 5, 8)
+# ragged: no axis divisible by 2/4/7/8 — forces the padded-at-rest path
+R3 = np.random.default_rng(7).normal(size=(7, 5, 9)).astype(np.float32)
+
+
+def _np(x):
+    return x.numpy() if hasattr(x, "numpy") else np.asarray(x)
+
+
+@pytest.mark.parametrize("split", SPLITS)
+@pytest.mark.parametrize("data", [D3, R3], ids=["even", "ragged"])
+def test_binary_ops_same_split_3d(data, split):
+    x = ht.array(data, split=split)
+    y = ht.array(2.0 * data + 1.0, split=split)
+    np.testing.assert_allclose(_np(x + y), 3.0 * data + 1.0, rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(_np(x * 2.0 - y), -1.0, rtol=1e-5, atol=1e-5)
+    got = x / (y + 3.0)
+    assert got.split == split
+    np.testing.assert_allclose(_np(got), data / (2.0 * data + 4.0), rtol=1e-6)
+
+
+@pytest.mark.parametrize("split", SPLITS)
+@pytest.mark.parametrize("axis", [None, 0, 1, 2, (0, 2), (1, 2)])
+@pytest.mark.parametrize("keepdims", [False, True])
+def test_reductions_3d(split, axis, keepdims):
+    x = ht.array(R3, split=split)
+    np.testing.assert_allclose(
+        _np(ht.sum(x, axis=axis, keepdims=keepdims)),
+        R3.sum(axis=axis, keepdims=keepdims),
+        rtol=1e-4,
+    )
+    np.testing.assert_allclose(
+        _np(ht.mean(x, axis=axis, keepdims=keepdims)),
+        R3.mean(axis=axis, keepdims=keepdims),
+        rtol=1e-4,
+    )
+    np.testing.assert_allclose(
+        _np(ht.max(x, axis=axis, keepdims=keepdims)),
+        R3.max(axis=axis, keepdims=keepdims),
+        rtol=1e-6,
+    )
+
+
+@pytest.mark.parametrize("split", SPLITS)
+@pytest.mark.parametrize("axis", [0, 1, 2])
+def test_argreductions_and_var_3d(split, axis):
+    x = ht.array(R3, split=split)
+    np.testing.assert_array_equal(_np(ht.argmax(x, axis=axis)), R3.argmax(axis=axis))
+    np.testing.assert_array_equal(_np(ht.argmin(x, axis=axis)), R3.argmin(axis=axis))
+    np.testing.assert_allclose(
+        _np(ht.var(x, axis=axis)), R3.var(axis=axis), rtol=1e-4, atol=1e-5
+    )
+    np.testing.assert_allclose(
+        _np(ht.std(x, axis=axis, ddof=1)), R3.std(axis=axis, ddof=1), rtol=1e-4
+    )
+
+
+@pytest.mark.parametrize("split", SPLITS)
+@pytest.mark.parametrize("axis", [0, 1, 2])
+@pytest.mark.parametrize("descending", [False, True])
+def test_sort_3d_every_axis_split_combo(split, axis, descending):
+    # axis == split exercises the distributed n-D sort on interior axes
+    x = ht.array(R3, split=split)
+    v, i = ht.sort(x, axis=axis, descending=descending)
+    want = np.sort(R3, axis=axis)
+    if descending:
+        want = np.flip(want, axis=axis)
+    np.testing.assert_allclose(_np(v), want, rtol=1e-6)
+    np.testing.assert_allclose(
+        np.take_along_axis(R3, _np(i).astype(np.int64), axis=axis), want, rtol=1e-6
+    )
+    assert v.split == x.split
+
+
+@pytest.mark.parametrize("split", SPLITS)
+@pytest.mark.parametrize("axis", [0, 1, 2])
+def test_cum_ops_3d(split, axis):
+    x = ht.array(R3, split=split)
+    np.testing.assert_allclose(
+        _np(ht.cumsum(x, axis=axis)), np.cumsum(R3, axis=axis), rtol=1e-4
+    )
+    small = ht.array(R3 * 0.1, split=split)
+    np.testing.assert_allclose(
+        _np(ht.cumprod(small, axis=axis)),
+        np.cumprod(R3 * 0.1, axis=axis),
+        rtol=1e-4,
+        atol=1e-6,
+    )
+
+
+@pytest.mark.parametrize("split", SPLITS)
+@pytest.mark.parametrize("axis", [0, 1, 2])
+def test_percentile_3d_axes(split, axis):
+    x = ht.array(R3, split=split)
+    np.testing.assert_allclose(
+        _np(ht.percentile(x, [10.0, 50.0, 90.0], axis=axis)),
+        np.percentile(R3, [10.0, 50.0, 90.0], axis=axis),
+        rtol=1e-5,
+        atol=1e-5,
+    )
+    np.testing.assert_allclose(
+        _np(ht.median(x, axis=axis)), np.median(R3, axis=axis), rtol=1e-5
+    )
+
+
+@pytest.mark.parametrize("split", SPLITS)
+@pytest.mark.parametrize("cat_axis", [0, 1, 2])
+def test_concatenate_3d(split, cat_axis):
+    x = ht.array(R3, split=split)
+    y = ht.array(R3 + 1.0, split=split)
+    got = ht.concatenate([x, y], axis=cat_axis)
+    np.testing.assert_allclose(
+        _np(got), np.concatenate([R3, R3 + 1.0], axis=cat_axis), rtol=1e-6
+    )
+    assert got.gshape == tuple(
+        2 * s if d == cat_axis else s for d, s in enumerate(R3.shape)
+    )
+
+
+@pytest.mark.parametrize("src", SPLITS)
+@pytest.mark.parametrize("dst", SPLITS)
+def test_resplit_all_pairs_3d(src, dst):
+    x = ht.array(R3, split=src)
+    y = ht.resplit(x, dst)
+    assert y.split == dst
+    np.testing.assert_array_equal(_np(y), R3)
+
+
+@pytest.mark.parametrize("split", SPLITS)
+def test_reshape_3d_up_down(split):
+    x = ht.array(D3, split=split)
+    np.testing.assert_array_equal(_np(ht.reshape(x, (30, 8))), D3.reshape(30, 8))
+    np.testing.assert_array_equal(_np(ht.reshape(x, (6, 40))), D3.reshape(6, 40))
+    np.testing.assert_array_equal(
+        _np(ht.reshape(x, (2, 3, 5, 8))), D3.reshape(2, 3, 5, 8)
+    )
+    np.testing.assert_array_equal(_np(ht.reshape(x, (-1,))), D3.ravel())
+
+
+@pytest.mark.parametrize("split", SPLITS)
+@pytest.mark.parametrize("perm", [(1, 0, 2), (2, 1, 0), (0, 2, 1), (2, 0, 1)])
+def test_transpose_tracks_split_3d(split, perm):
+    x = ht.array(R3, split=split)
+    y = ht.transpose(x, perm)
+    np.testing.assert_array_equal(_np(y), R3.transpose(perm))
+    if split is None:
+        assert y.split is None
+    else:
+        assert y.split == perm.index(split)
+
+
+@pytest.mark.parametrize("split", SPLITS)
+def test_getitem_setitem_3d(split):
+    x = ht.array(R3, split=split)
+    np.testing.assert_array_equal(_np(x[2]), R3[2])
+    np.testing.assert_array_equal(_np(x[:, 3]), R3[:, 3])
+    np.testing.assert_array_equal(_np(x[..., 4]), R3[..., 4])
+    np.testing.assert_array_equal(_np(x[1:5, ::2, -3:]), R3[1:5, ::2, -3:])
+    np.testing.assert_array_equal(_np(x[::-1, :, ::2]), R3[::-1, :, ::2])
+    np.testing.assert_array_equal(_np(x[2, 1:4, 5]), R3[2, 1:4, 5])
+
+    y = ht.array(R3.copy(), split=split)
+    y[1:3, :, 2:5] = 0.0
+    b = R3.copy()
+    b[1:3, :, 2:5] = 0.0
+    np.testing.assert_array_equal(_np(y), b)
+    y = ht.array(R3.copy(), split=split)
+    y[:, 2] = ht.array(np.ones((7, 9), np.float32), split=None)
+    b = R3.copy()
+    b[:, 2] = 1.0
+    np.testing.assert_array_equal(_np(y), b)
+
+
+@pytest.mark.parametrize("split", SPLITS)
+def test_flip_repeat_squeeze_3d(split):
+    x = ht.array(R3, split=split)
+    for ax in (0, 1, 2, (0, 2), None):
+        np.testing.assert_array_equal(_np(ht.flip(x, ax)), np.flip(R3, ax))
+    np.testing.assert_array_equal(
+        _np(ht.repeat(x, 2, axis=1)), np.repeat(R3, 2, axis=1)
+    )
+    e = ht.expand_dims(x, 1)
+    assert e.gshape == (7, 1, 5, 9)
+    np.testing.assert_array_equal(_np(ht.squeeze(e, 1)), R3)
+    if split is not None:
+        # expand before the split axis shifts it right
+        assert e.split == (split + 1 if split >= 1 else 0)
+
+
+@pytest.mark.parametrize("split", SPLITS)
+def test_where_nonzero_3d(split):
+    x = ht.array(R3, split=split)
+    nz = ht.nonzero(x > 0.5)
+    want = np.nonzero(R3 > 0.5)
+    got = _np(nz)
+    # nonzero returns the index tuple stacked as a (nnz, ndim) array
+    np.testing.assert_array_equal(got, np.stack(want, axis=-1))
+    np.testing.assert_allclose(
+        _np(ht.where(x > 0.5, x, -x)), np.where(R3 > 0.5, R3, -R3), rtol=1e-6
+    )
+
+
+@pytest.mark.parametrize("split", SPLITS)
+def test_diff_3d(split):
+    x = ht.array(R3, split=split)
+    for ax in (0, 1, 2):
+        np.testing.assert_allclose(
+            _np(ht.diff(x, axis=ax)), np.diff(R3, axis=ax), rtol=1e-5, atol=1e-6
+        )
+
+
+@pytest.mark.parametrize("split", [None, 0, 1, 2, 3])
+def test_4d_split_sweep(split):
+    d4 = np.random.default_rng(11).normal(size=(5, 4, 3, 6)).astype(np.float32)
+    x = ht.array(d4, split=split)
+    assert x.split == split
+    # reduce the split axis away and a non-split axis
+    np.testing.assert_allclose(_np(ht.sum(x, axis=split)) if split is not None
+                               else _np(ht.sum(x)), d4.sum(axis=split), rtol=1e-4)
+    np.testing.assert_allclose(_np(ht.mean(x, axis=1)), d4.mean(axis=1), rtol=1e-4)
+    # sort along the split axis (distributed path) and the last axis
+    if split is not None:
+        v, _ = ht.sort(x, axis=split)
+        np.testing.assert_allclose(_np(v), np.sort(d4, axis=split), rtol=1e-6)
+    v2, _ = ht.sort(x, axis=-1)
+    np.testing.assert_allclose(_np(v2), np.sort(d4, axis=-1), rtol=1e-6)
+    # resplit interior -> trailing and back
+    y = ht.resplit(ht.resplit(x, 3), split)
+    np.testing.assert_array_equal(_np(y), d4)
+
+
+@pytest.mark.parametrize("split", SPLITS)
+def test_stack_unstack_3d(split):
+    x = ht.array(R3, split=split)
+    y = ht.array(R3 * 2.0, split=split)
+    for ax in (0, 1, 3):
+        got = ht.stack((x, y), axis=ax)
+        np.testing.assert_allclose(
+            _np(got), np.stack([R3, R3 * 2.0], axis=ax), rtol=1e-6
+        )
+    parts = ht.split(x, [2, 5], axis=2)
+    assert [p.gshape[2] for p in parts] == [2, 3, 4]
+    np.testing.assert_array_equal(_np(parts[1]), R3[:, :, 2:5])
+
+
+@pytest.mark.parametrize("split", SPLITS)
+def test_unique_flat_3d(split):
+    v = (np.arange(6 * 5 * 8) % 17).astype(np.int32).reshape(6, 5, 8)
+    x = ht.array(v, split=split)
+    u = ht.unique(x, sorted=True)
+    np.testing.assert_array_equal(_np(u), np.unique(v))

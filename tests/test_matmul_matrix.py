@@ -1,0 +1,96 @@
+"""Matmul conformance matrix — the reference's test_basics.test_matmul
+sweep (heat/core/linalg/tests/test_basics.py:67-536): every operand-split
+combination x edge shapes (vectors, single-row/column, ragged extents vs
+the mesh), plus result-split rules and error contracts."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import heat_tpu as ht
+from tests.suite import all_splits, assert_array_equal
+
+RNG = np.random.default_rng(23)
+
+SHAPES = [
+    ((7, 11), (11, 5)),   # ragged both ways vs any mesh size
+    ((8, 16), (16, 8)),   # divisible on 1/2/4/8
+    ((1, 9), (9, 4)),     # single-row left operand
+    ((13, 3), (3, 1)),    # single-column result
+    ((9,), (9, 4)),       # vec @ mat
+    ((5, 9), (9,)),       # mat @ vec
+    ((9,), (9,)),         # vec @ vec -> scalar
+]
+
+
+def _cases():
+    for sa_shape, sb_shape in SHAPES:
+        for sa in all_splits(sa_shape):
+            for sb in all_splits(sb_shape):
+                yield sa_shape, sb_shape, sa, sb
+
+
+@pytest.mark.parametrize("sa_shape,sb_shape,sa,sb", list(_cases()))
+def test_matmul_shape_split_matrix(sa_shape, sb_shape, sa, sb):
+    a = RNG.normal(size=sa_shape).astype(np.float32)
+    b = RNG.normal(size=sb_shape).astype(np.float32)
+    x = ht.array(a, split=sa)
+    y = ht.array(b, split=sb)
+    got = ht.matmul(x, y)
+    want = a @ b
+    if np.ndim(want) == 0:
+        assert np.isclose(float(got), float(want), rtol=1e-4)
+    else:
+        assert_array_equal(got, want, rtol=1e-4, atol=1e-4)
+
+
+def test_matmul_result_split_rules_2d():
+    # reference basics.py:273-283 — the four split cases' result layouts
+    a = RNG.normal(size=(12, 8)).astype(np.float32)
+    b = RNG.normal(size=(8, 12)).astype(np.float32)
+    # split0 @ split0 -> rows stay sharded
+    r = ht.matmul(ht.array(a, split=0), ht.array(b, split=0))
+    assert r.split == 0
+    # split1 @ split1 -> columns stay sharded
+    r = ht.matmul(ht.array(a, split=1), ht.array(b, split=1))
+    assert r.split == 1
+    # split0 @ None -> rows sharded
+    r = ht.matmul(ht.array(a, split=0), ht.array(b))
+    assert r.split == 0
+    # None @ split1 -> columns sharded
+    r = ht.matmul(ht.array(a), ht.array(b, split=1))
+    assert r.split == 1
+    # None @ None -> replicated
+    r = ht.matmul(ht.array(a), ht.array(b))
+    assert r.split is None
+
+
+def test_matmul_errors_and_scalars():
+    a = ht.array(RNG.normal(size=(4, 5)).astype(np.float32), split=0)
+    with pytest.raises(ValueError):
+        ht.matmul(a, ht.array(RNG.normal(size=(4, 5)).astype(np.float32)))
+    with pytest.raises((ValueError, TypeError)):
+        ht.matmul(a, ht.array(3.0))
+
+
+@pytest.mark.parametrize("sa", [None, 0, 1])
+def test_matmul_int_inputs_promote_and_match(sa):
+    # reference basics.py:152-166: integer operands must produce exact
+    # integer results through the float MXU path
+    a = RNG.integers(-7, 8, size=(6, 9)).astype(np.int32)
+    b = RNG.integers(-7, 8, size=(9, 5)).astype(np.int32)
+    got = ht.matmul(ht.array(a, split=sa), ht.array(b, split=sa if sa != 1 else 0))
+    np.testing.assert_array_equal(got.numpy(), a @ b)
+
+
+def test_matmul_chain_resplit_roundtrip():
+    # a realistic pipeline: dp @ replicated -> resplit -> tp matmul
+    a = RNG.normal(size=(16, 12)).astype(np.float32)
+    w1 = RNG.normal(size=(12, 10)).astype(np.float32)
+    w2 = RNG.normal(size=(10, 6)).astype(np.float32)
+    x = ht.array(a, split=0)
+    h = ht.matmul(x, ht.array(w1))
+    h = ht.resplit(h, 1)
+    out = ht.matmul(h, ht.array(w2, split=1))
+    np.testing.assert_allclose(out.numpy(), a @ w1 @ w2, rtol=1e-4, atol=1e-4)
