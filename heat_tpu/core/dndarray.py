@@ -81,14 +81,15 @@ def _fit_index_array(k, n: int):
     its own semantics: OOB-high → ``n`` (gather clamps to n-1, scatter
     drops), OOB-low → ``-(n+1)`` (one wrap later still ``-1`` < 0: gather
     clamps to 0, scatter drops).  Both sentinels fit int32 for every
-    ``n < 2**31 - 1``, i.e. for every axis jax itself can index with
-    int32 — there is no unguarded large-``n`` regime (the r4 advisor
-    found the previous ``2n``-based sentinel silently skipped
-    normalization for n ≥ 2**30).  Host numpy arrays normalize for free;
+    ``n < 2**31`` (``n`` ≤ int32 max, ``-(n+1)`` ≥ int32 min), i.e. for
+    every axis jax itself can index with int32 — there is no unguarded
+    large-``n`` regime (the r4 advisor found the previous ``2n``-based
+    sentinel silently skipped normalization for n ≥ 2**30).  Host numpy
+    arrays normalize for free;
     device arrays pay two elementwise ops only for risky dtypes.
     """
-    if n <= 0 or n >= 2**31 - 1:
-        return k
+    if n <= 0 or n >= 2**31:
+        return k  # n itself no longer fits int32; jax must gather in int64
     if isinstance(k, np.ndarray):
         if np.issubdtype(k.dtype, np.unsignedinteger):
             return np.minimum(k, np.asarray(n, np.uint64)).astype(np.int32)

@@ -107,6 +107,16 @@ def matmul(a: DNDarray, b: DNDarray, allow_resplit: bool = False) -> DNDarray:
             f"matmul shape mismatch: {a.shape} @ {b.shape} "
             f"(contracting {k_a} vs {k_b})"
         )
+    # batched operands: leading dims must broadcast, same ValueError contract
+    if a.ndim > 2 or b.ndim > 2:
+        batch_a = a.shape[:-2] if a.ndim > 2 else ()
+        batch_b = b.shape[:-2] if b.ndim > 2 else ()
+        for da, db in zip(reversed(batch_a), reversed(batch_b)):
+            if da != db and da != 1 and db != 1:
+                raise ValueError(
+                    f"matmul batch dimensions do not broadcast: "
+                    f"{a.shape} @ {b.shape} ({da} vs {db})"
+                )
     promoted = types.promote_types(a.dtype, b.dtype)
     aa = a.larray.astype(promoted.jax_type())
     ba = b.larray.astype(promoted.jax_type())

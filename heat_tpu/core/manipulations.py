@@ -406,7 +406,12 @@ def stack(arrays: Sequence[DNDarray], axis: int = 0, out=None) -> DNDarray:
     for a in arrays[1:]:
         if a.shape != a0.shape:
             raise ValueError(f"all input arrays must have the same shape, {a.shape} != {a0.shape}")
-    axis = axis % (a0.ndim + 1)
+    ndim_out = a0.ndim + 1
+    if not -ndim_out <= axis < ndim_out:
+        raise ValueError(
+            f"axis {axis} is out of bounds for the {ndim_out}-dimensional result"
+        )
+    axis = axis % ndim_out
     out_type = a0.dtype
     for a in arrays[1:]:
         out_type = types.promote_types(out_type, a.dtype)

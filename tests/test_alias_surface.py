@@ -1,0 +1,163 @@
+"""Alias, constant, and auxiliary-helper surface — every public name the
+rest of the suite does not exercise directly: numpy/torch-spelling
+aliases, dtype aliases, math constants, estimator mixins, precision
+knobs, sanitation helpers, and the linalg namedtuples (reference:
+constants.py, types.py:62-210 aliases, base.py:92-227 mixins,
+sanitation.py helpers)."""
+
+from __future__ import annotations
+
+import math
+
+import numpy as np
+import pytest
+
+import heat_tpu as ht
+
+
+def test_function_aliases_are_identities():
+    # torch spellings alias the numpy ones (reference trigonometrics.py)
+    assert ht.acos is ht.arccos
+    assert ht.asin is ht.arcsin
+    assert ht.atan is ht.arctan
+    assert ht.atan2 is ht.arctan2
+    assert ht.cumproduct is ht.cumprod
+    assert ht.floor_divide is ht.floordiv
+    assert ht.bitwise_not is ht.invert
+
+
+def test_constants():
+    # reference constants.py: pi/e/inf/nan + uppercase aliases
+    assert math.isclose(ht.Euler, math.e)
+    assert ht.Infinity == float("inf") and ht.Infty == float("inf")
+    assert math.isclose(ht.pi, math.pi)
+    assert np.isnan(ht.nan)
+
+
+def test_dtype_aliases():
+    # reference types.py:62-210 alias table
+    assert ht.double is ht.float64
+    assert ht.long is ht.int64
+    assert ht.float_ is ht.float32 or ht.float_ is ht.float64
+    assert ht.int_ in (ht.int32, ht.int64)
+    assert ht.ubyte is ht.uint8
+    assert ht.bool_ is ht.bool
+    # abstract hierarchy is importable and ordered
+    assert issubclass(ht.float32, ht.floating)
+    assert issubclass(ht.int32, ht.signedinteger)
+    assert issubclass(ht.signedinteger, ht.integer)
+    assert issubclass(ht.integer, ht.number)
+    assert issubclass(ht.number, ht.generic)
+    assert issubclass(ht.flexible, ht.generic)
+
+
+def test_estimator_mixins_and_predicates():
+    # reference base.py:92-297
+    from heat_tpu.cluster import KMeans
+    from heat_tpu.regression import Lasso
+    from heat_tpu.classification import KNN
+
+    km, ls = KMeans(), Lasso()
+    assert isinstance(km, ht.BaseEstimator)
+    assert isinstance(km, ht.ClusteringMixin)
+    assert isinstance(ls, ht.RegressionMixin)
+    assert ht.is_estimator(km) and ht.is_clusterer(km)
+    assert ht.is_regressor(ls) and not ht.is_classifier(ls)
+    assert not ht.is_transformer(km)
+
+    class T(ht.BaseEstimator, ht.TransformMixin):
+        def fit(self, x):
+            return self
+
+        def transform(self, x):
+            return x
+
+    t = T()
+    assert ht.is_transformer(t)
+    x = ht.arange(3, dtype=ht.float32)
+    assert t.fit_transform(x) is x
+    # KNN is a classifier through the mixin
+    assert ht.is_classifier(KNN(ht.ones((4, 2)), ht.zeros(4, dtype=ht.int32), 1))
+
+
+def test_matmul_precision_knob():
+    # docs/design.md §4: linalg defaults to 'highest' to protect f32
+    # numerics from the bf16 MXU default
+    assert ht.get_matmul_precision() == "highest"
+    ht.set_matmul_precision("default")
+    try:
+        assert ht.get_matmul_precision() == "default"
+    finally:
+        ht.set_matmul_precision("highest")
+    with pytest.raises(ValueError):
+        ht.set_matmul_precision("wat")
+
+
+def test_matrix_vector_norms():
+    m = ht.array(np.array([[1.0, 2.0], [3.0, 4.0]], np.float32), split=0)
+    np.testing.assert_allclose(
+        float(ht.matrix_norm(m)), np.linalg.norm(m.numpy()), rtol=1e-5
+    )
+    v = ht.array(np.array([3.0, 4.0], np.float32), split=0)
+    assert math.isclose(float(ht.vector_norm(v)), 5.0, rel_tol=1e-5)
+    # norm on a matrix is Frobenius (reference basics.py:788-811)
+    np.testing.assert_allclose(
+        float(ht.linalg.norm(m)), np.linalg.norm(m.numpy()), rtol=1e-5
+    )
+
+
+def test_svd_namedtuple_fields():
+    # the QR/SVD results are namedtuples with reference field names
+    assert ht.SVD._fields == ("U", "S", "V")
+    a = ht.array(np.random.default_rng(0).normal(size=(8, 3)).astype(np.float32), split=0)
+    res = ht.linalg.svd(a)
+    assert res.U.shape == (8, 3) and res.S.shape == (3,) and res.V.shape == (3, 3)
+    qr = ht.linalg.qr(a)
+    assert qr._fields == ("Q", "R")
+
+
+def test_sanitation_helpers():
+    # reference sanitation.py:24-180
+    x = ht.arange(4, dtype=ht.float32)
+    ht.sanitize_in(x)  # no raise
+    with pytest.raises(TypeError):
+        ht.sanitize_in(np.arange(4))
+    t = ht.sanitize_in_tensor(np.arange(4, dtype=np.float32))
+    assert t.shape == (4,)
+    with pytest.raises(TypeError):
+        ht.sanitize_sequence(3)
+    assert ht.sanitize_sequence((1, 2)) == [1, 2]
+    s = ht.scalar_to_1d(ht.array(3.0))
+    assert s.shape == (1,) and float(s[0]) == 3.0
+    # sanitize_infinity: the saturation value for a dtype
+    assert ht.sanitize_infinity(ht.array(np.array([1, 2], np.int32))) == np.iinfo(np.int32).max
+    assert ht.sanitize_infinity(ht.array(np.array([1.0], np.float32))) == float("inf")
+    # lshape check passes on a consistent array
+    ht.sanitize_lshape(x, x.larray)
+    # out-buffer validation
+    out = ht.zeros(4, dtype=ht.float32)
+    ht.sanitize_out(out, (4,), out.split, out.device)
+    with pytest.raises(ValueError):
+        ht.sanitize_out(out, (5,), out.split, out.device)
+    with pytest.raises(TypeError):
+        ht.sanitize_out("nope", (4,), None, None)
+
+
+def test_merge_keepdims_rule():
+    assert ht.merge_keepdims(None, None) is False
+    assert ht.merge_keepdims(True, None) is True
+    assert ht.merge_keepdims(None, True) is True
+    assert ht.merge_keepdims(False, True) is False  # keepdims wins
+
+
+def test_local_index_proxy():
+    x = ht.array(np.arange(6, dtype=np.float32).reshape(3, 2), split=0)
+    assert isinstance(x.lloc, ht.LocalIndex)
+    np.testing.assert_array_equal(np.asarray(x.lloc[1]), x.numpy()[1])
+
+
+def test_device_and_comm_helpers():
+    d = ht.get_device()
+    assert isinstance(d, ht.Device)
+    assert ht.comm_for_device(d) is not None
+    assert repr(d)

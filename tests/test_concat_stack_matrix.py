@@ -1,0 +1,139 @@
+"""Concatenate / stack-family matrix — the reference's largest
+test_manipulations group (test_concatenate, :52-366: every operand-split
+combination x axis, dtype promotion, error contracts; stack siblings
+:9-51, :1118-1167, :2144-2186, :2754-2833, :3036-3084) against numpy,
+with the result-layout rule pinned: the first split operand's layout
+wins (the reference instead forbids mixed splits outright)."""
+
+from __future__ import annotations
+
+import itertools
+
+import numpy as np
+import pytest
+
+import heat_tpu as ht
+
+A = np.zeros((16, 15), np.float32)
+B = np.ones((16, 15), np.float32)
+
+
+@pytest.mark.parametrize(
+    "sa,sb", list(itertools.product([None, 0, 1], repeat=2))
+)
+@pytest.mark.parametrize("axis", [0, 1])
+def test_concatenate_split_matrix(sa, sb, axis):
+    # reference test_manipulations.py:52-366 runs exactly this grid
+    x, y = ht.array(A, split=sa), ht.array(B, split=sb)
+    res = ht.concatenate((x, y), axis=axis)
+    want = np.concatenate([A, B], axis=axis)
+    np.testing.assert_array_equal(res.numpy(), want)
+    assert res.gshape == want.shape
+    assert res.dtype is ht.float32
+    # layout rule: first split operand's split wins; all-replicated stays
+    # replicated (the reference raises on sa != sb instead — this grid is
+    # a superset of its contract)
+    expected_split = sa if sa is not None else sb
+    assert res.split == expected_split
+
+
+def test_concatenate_many_operands_and_promotion():
+    xs = [
+        ht.array(A[:4], split=0),
+        ht.array(B[:3].astype(np.int32), split=0),
+        ht.array(A[:2].astype(np.uint8), split=0),
+    ]
+    res = ht.concatenate(xs, axis=0)
+    assert res.gshape == (9, 15)
+    assert res.dtype is ht.float32  # float wins the promotion lattice
+    want = np.concatenate([A[:4], B[:3], A[:2]], axis=0)
+    np.testing.assert_array_equal(res.numpy(), want)
+    bi = ht.concatenate(
+        (ht.array(np.array([True, False])), ht.array(np.array([1, 2], np.int32)))
+    )
+    assert bi.dtype is ht.int32
+
+
+def test_concatenate_error_contracts():
+    x = ht.array(A, split=0)
+    with pytest.raises(ValueError):
+        ht.concatenate((x, ht.array(B[:, :10], split=0)), axis=0)  # col mismatch
+    with pytest.raises(ValueError):
+        ht.concatenate((x, ht.array(np.ones((2, 15, 3), np.float32))), axis=0)
+    with pytest.raises((ValueError, IndexError)):
+        ht.concatenate((x, x), axis=5)
+    with pytest.raises(TypeError):
+        ht.concatenate(x, axis=0)
+    with pytest.raises(TypeError):
+        ht.concatenate((x, "not an array"), axis=0)
+
+
+VEC = np.arange(6, dtype=np.float32)
+MAT = np.arange(12, dtype=np.float32).reshape(2, 6)
+
+
+@pytest.mark.parametrize("split", [None, 0])
+def test_hstack_vstack_vectors(split):
+    # numpy corner the reference pins (test_manipulations.py:1118-1167,
+    # :3036-3084): hstack on 1-D concatenates, vstack promotes to rows
+    v, w = ht.array(VEC, split=split), ht.array(VEC + 10.0, split=split)
+    np.testing.assert_array_equal(
+        ht.hstack((v, w)).numpy(), np.hstack([VEC, VEC + 10.0])
+    )
+    np.testing.assert_array_equal(
+        ht.vstack((v, w)).numpy(), np.vstack([VEC, VEC + 10.0])
+    )
+    np.testing.assert_array_equal(
+        ht.column_stack((v, w)).numpy(), np.column_stack([VEC, VEC + 10.0])
+    )
+    np.testing.assert_array_equal(
+        ht.row_stack((v, w)).numpy(), np.row_stack([VEC, VEC + 10.0])
+    )
+
+
+@pytest.mark.parametrize("split", [None, 0, 1])
+def test_stack_family_matrices(split):
+    x, y = ht.array(MAT, split=split), ht.array(MAT * 2.0, split=split)
+    np.testing.assert_array_equal(ht.hstack((x, y)).numpy(), np.hstack([MAT, MAT * 2.0]))
+    np.testing.assert_array_equal(ht.vstack((x, y)).numpy(), np.vstack([MAT, MAT * 2.0]))
+    np.testing.assert_array_equal(
+        ht.column_stack((x, y)).numpy(), np.column_stack([MAT, MAT * 2.0])
+    )
+    np.testing.assert_array_equal(
+        ht.row_stack((x, y)).numpy(), np.row_stack([MAT, MAT * 2.0])
+    )
+    for ax in (0, 1, 2, -1):
+        np.testing.assert_array_equal(
+            ht.stack((x, y), axis=ax).numpy(), np.stack([MAT, MAT * 2.0], axis=ax)
+        )
+
+
+def test_stack_error_contracts():
+    # reference test_manipulations.py:2754-2833
+    x = ht.array(MAT, split=0)
+    with pytest.raises(ValueError):
+        ht.stack((x, ht.array(MAT[:, :3], split=0)), axis=0)  # shape mismatch
+    with pytest.raises((ValueError, IndexError)):
+        ht.stack((x, x), axis=4)  # axis out of bounds
+    with pytest.raises((TypeError, ValueError)):
+        ht.stack((), axis=0)  # empty sequence
+
+
+def test_column_stack_mixed_vector_matrix():
+    # reference test_manipulations.py:9-51: vector + matrix columns
+    v = ht.array(VEC, split=0)
+    m = ht.array(np.arange(18, dtype=np.float32).reshape(6, 3), split=0)
+    got = ht.column_stack((v, m))
+    want = np.column_stack([VEC, np.arange(18, dtype=np.float32).reshape(6, 3)])
+    np.testing.assert_array_equal(got.numpy(), want)
+    assert got.gshape == (6, 4)
+
+
+@pytest.mark.parametrize("split", [None, 0, 1, 2])
+def test_dstack_equivalent_3d_stack(split):
+    d3 = np.arange(24, dtype=np.float32).reshape(2, 3, 4)
+    x = ht.array(d3, split=split)
+    y = ht.array(d3 + 1.0, split=split)
+    got = ht.concatenate((x, y), axis=2)
+    np.testing.assert_array_equal(got.numpy(), np.concatenate([d3, d3 + 1.0], axis=2))
+    assert got.gshape == (2, 3, 8)
