@@ -100,6 +100,10 @@ class _KCluster(ClusteringMixin, BaseEstimator):
         self._inertia = None
         self._n_iter = None
 
+    def _checkpoint_attrs(self):
+        # fitted state lives in private storage behind the *_ properties
+        return ["_cluster_centers", "_labels", "_inertia", "_n_iter"]
+
     @property
     def cluster_centers_(self) -> DNDarray:
         return self._cluster_centers

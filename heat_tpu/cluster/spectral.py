@@ -72,6 +72,11 @@ class Spectral(ClusteringMixin, BaseEstimator):
         self._labels = None
         self._cluster_centers = None
 
+    def _checkpoint_attrs(self):
+        # the fitted KMeans nests recursively; _laplacian is rebuilt by
+        # __init__ from the constructor params
+        return ["_labels", "_cluster_centers", "_kmeans", "_embedding_dim"]
+
     @property
     def labels_(self):
         return self._labels

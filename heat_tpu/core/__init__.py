@@ -36,6 +36,8 @@ from .printing import get_printoptions, set_printoptions
 from . import random
 from . import io
 from .io import *
+from . import checkpoint
+from .checkpoint import *
 from . import tiling
 from .tiling import *
 from .base import *

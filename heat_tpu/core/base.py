@@ -73,6 +73,38 @@ class BaseEstimator:
         params = ", ".join(f"{k}={v!r}" for k, v in sorted(self.get_params(deep=False).items()))
         return f"{self.__class__.__name__}({params})"[:N_CHAR_MAX]
 
+    def _checkpoint_attrs(self):
+        """Instance attributes :func:`heat_tpu.save_estimator` persists
+        beyond the constructor params.  Default: every public ``*_``
+        attribute (the sklearn fitted convention).  Estimators whose
+        fitted state lives in private storage override this."""
+        return [
+            n for n in vars(self) if n.endswith("_") and not n.startswith("_")
+        ]
+
+    def save(self, path: str) -> None:
+        """Checkpoint this estimator — constructor params plus fitted
+        state — to one HDF5 file (extension; the reference persists data
+        only, SURVEY §5.4).  See :func:`heat_tpu.save_estimator`."""
+        from .checkpoint import save_estimator
+
+        save_estimator(self, path)
+
+    @classmethod
+    def load(cls, path: str) -> "BaseEstimator":
+        """Restore an estimator saved with :meth:`save`; raises TypeError
+        if the checkpoint holds a different estimator class than ``cls``
+        (call ``BaseEstimator.load`` / ``ht.load_estimator`` to accept
+        any)."""
+        from .checkpoint import load_estimator
+
+        est = load_estimator(path)
+        if cls is not BaseEstimator and not isinstance(est, cls):
+            raise TypeError(
+                f"{path} holds a {type(est).__name__}, not a {cls.__name__}"
+            )
+        return est
+
 
 class ClassificationMixin:
     """fit/predict contract for classifiers (reference base.py:92-141)."""

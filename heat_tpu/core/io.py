@@ -217,6 +217,51 @@ def _writer_save(data: DNDarray, prepare) -> None:
     _finish_save(err or werr)
 
 
+def _save_hdf5_many(path: str, datasets, attrs=None, mode: str = "w") -> None:
+    """Write several datasets plus file attributes in ONE file open and
+    ONE cross-process failure barrier.  ``datasets`` is an ordered
+    sequence of (key, DNDarray); every process must pass the same
+    sequence (the slab fetches are collectives executed in order).  This
+    is the multi-dataset generalization of :func:`_writer_save` — the
+    deferred-error choreography lives here once, shared by
+    :func:`save_hdf5` (via that helper) and estimator checkpointing."""
+    datasets = list(datasets)
+    if jax.process_index() == 0:
+        err, f = None, None
+        try:
+            f = h5py.File(path, mode)
+        except Exception as e:  # noqa: BLE001 — deferred past the collectives
+            err = e
+        for key, arr in datasets:
+            write = None
+            if f is not None and err is None:
+                try:
+                    dset = f.create_dataset(
+                        key, arr.shape, dtype=np.dtype(arr.dtype._np_type)
+                    )
+                    write = dset.__setitem__
+                except Exception as e:  # noqa: BLE001
+                    err = e
+            werr = _emit_slabs(arr, write)
+            err = err or werr
+        if f is not None:
+            if err is None and attrs:
+                try:
+                    for k, v in attrs.items():
+                        f.attrs[k] = v
+                except Exception as e:  # noqa: BLE001
+                    err = e
+            try:
+                f.close()
+            except Exception as e:  # noqa: BLE001
+                err = err or e
+        _finish_save(err)
+    else:
+        for _, arr in datasets:
+            _emit_slabs(arr, None)
+        _finish_save(None)
+
+
 def save_hdf5(data: DNDarray, path: str, dataset: str, mode: str = "w", **kwargs) -> None:
     """Save to HDF5 (reference io.py:129-234 — rank-0 metadata + ordered
     per-rank slab writes; here process 0 writes each shard slab)."""
@@ -427,7 +472,20 @@ def load(path: str, *args, **kwargs) -> DNDarray:
 
 
 def save(data: DNDarray, path: str, *args, **kwargs) -> None:
-    """Extension-dispatched save (reference io.py:886-923)."""
+    """Extension-dispatched save (reference io.py:886-923).  Estimators
+    dispatch to :func:`heat_tpu.save_estimator` (extension): one call
+    saves data or a fitted model alike."""
+    from .base import BaseEstimator
+
+    if isinstance(data, BaseEstimator):
+        if args or kwargs:
+            raise TypeError(
+                "estimator checkpoints take no dataset/option arguments: "
+                "use ht.save(estimator, path)"
+            )
+        from .checkpoint import save_estimator
+
+        return save_estimator(data, path)
     if not isinstance(path, str):
         raise TypeError(f"Expected path to be str, but was {type(path)}")
     ext = os.path.splitext(path)[-1].strip().lower()

@@ -39,6 +39,10 @@ class Lasso(RegressionMixin, BaseEstimator):
         self.__theta = None
         self.n_iter = None
 
+    def _checkpoint_attrs(self):
+        # fitted state is the name-mangled theta plus the sweep count
+        return ["_Lasso__theta", "n_iter"]
+
     @property
     def lam(self) -> float:
         return self.__lam

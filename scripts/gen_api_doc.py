@@ -142,6 +142,9 @@ def main() -> None:
     section("Manipulations", [manipulations], exported(manipulations))
     section("Indexing", [indexing], exported(indexing))
     section("IO", [io_mod], exported(io_mod))
+    from heat_tpu.core import checkpoint
+
+    section("Estimator checkpointing", [checkpoint], exported(checkpoint))
     section("Random", [random], exported(random), "ht.random.")
     section("Tiling", [tiling], exported(tiling), "ht.core.tiling.")
     section("Printing", [printing], exported(printing))

@@ -87,7 +87,7 @@ def test_hstack_vstack_vectors(split):
         ht.column_stack((v, w)).numpy(), np.column_stack([VEC, VEC + 10.0])
     )
     np.testing.assert_array_equal(
-        ht.row_stack((v, w)).numpy(), np.row_stack([VEC, VEC + 10.0])
+        ht.row_stack((v, w)).numpy(), np.vstack([VEC, VEC + 10.0])
     )
 
 
@@ -100,7 +100,7 @@ def test_stack_family_matrices(split):
         ht.column_stack((x, y)).numpy(), np.column_stack([MAT, MAT * 2.0])
     )
     np.testing.assert_array_equal(
-        ht.row_stack((x, y)).numpy(), np.row_stack([MAT, MAT * 2.0])
+        ht.row_stack((x, y)).numpy(), np.vstack([MAT, MAT * 2.0])
     )
     for ax in (0, 1, 2, -1):
         np.testing.assert_array_equal(

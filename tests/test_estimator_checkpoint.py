@@ -1,0 +1,269 @@
+"""Estimator checkpoint/restore — save_estimator/load_estimator round-trips
+for every estimator family, layout restoration, nested fitted estimators,
+and the error contracts.  Extension beyond the reference: its persistence
+is data-level only (reference io.py:622-921; SURVEY §5.4 notes estimators
+have get_params but no fitted-state save/restore)."""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+import pytest
+
+import heat_tpu as ht
+
+RNG = np.random.default_rng(17)
+Xn = RNG.normal(size=(67, 4)).astype(np.float32)  # ragged on 2/4/7/8
+
+
+@pytest.fixture
+def X():
+    return ht.array(Xn, split=0)
+
+
+def test_kmeans_roundtrip_exact(tmp_path, X):
+    km = ht.cluster.KMeans(n_clusters=3, max_iter=10, random_state=5)
+    km.fit(X)
+    p = str(tmp_path / "km.h5")
+    km.save(p)
+    km2 = ht.load_estimator(p)
+    assert isinstance(km2, ht.cluster.KMeans)
+    np.testing.assert_allclose(
+        km2.cluster_centers_.numpy(), km.cluster_centers_.numpy(), rtol=1e-6
+    )
+    np.testing.assert_array_equal(km2.labels_.numpy(), km.labels_.numpy())
+    assert km2.inertia_ == km.inertia_
+    assert km2.n_iter_ == km.n_iter_
+    assert km2.get_params() == km.get_params()
+    np.testing.assert_array_equal(km2.predict(X).numpy(), km.predict(X).numpy())
+
+
+def test_layouts_restored(tmp_path, X):
+    # a split DNDarray attribute must come back with its split
+    km = ht.cluster.KMeans(n_clusters=2, max_iter=5, random_state=0)
+    km.fit(X)
+    assert km.labels_.split == 0
+    p = str(tmp_path / "km.h5")
+    km.save(p)
+    km2 = ht.load_estimator(p)
+    assert km2.labels_.split == 0
+    assert km2.cluster_centers_.split is None
+
+
+@pytest.mark.parametrize("cls", [ht.cluster.KMedians, ht.cluster.KMedoids])
+def test_kvariants_roundtrip(tmp_path, X, cls):
+    est = cls(n_clusters=3, max_iter=5, random_state=1)
+    est.fit(X)
+    p = str(tmp_path / "est.h5")
+    est.save(p)
+    back = cls.load(p)
+    np.testing.assert_allclose(
+        back.cluster_centers_.numpy(), est.cluster_centers_.numpy(), rtol=1e-6
+    )
+    np.testing.assert_array_equal(
+        back.predict(X).numpy(), est.predict(X).numpy()
+    )
+
+
+def test_lasso_roundtrip_predict(tmp_path, X):
+    y = ht.array(RNG.normal(size=(67,)).astype(np.float32))
+    ls = ht.regression.Lasso(lam=0.05, max_iter=20)
+    ls.fit(X, y)
+    p = str(tmp_path / "ls.h5")
+    ls.save(p)
+    ls2 = ht.load_estimator(p)
+    np.testing.assert_allclose(ls2.coef_.numpy(), ls.coef_.numpy(), rtol=1e-6)
+    np.testing.assert_allclose(
+        ls2.intercept_.numpy(), ls.intercept_.numpy(), rtol=1e-6
+    )
+    np.testing.assert_allclose(
+        ls2.predict(X).numpy(), ls.predict(X).numpy(), rtol=1e-5
+    )
+    assert ls2.lam == ls.lam
+
+
+def test_gaussiannb_numpy_state_roundtrip(tmp_path, X):
+    labels = (RNG.random(67) > 0.5).astype(np.int32)
+    nb = ht.naive_bayes.GaussianNB()
+    nb.fit(X, ht.array(labels))
+    p = str(tmp_path / "nb.h5")
+    nb.save(p)
+    nb2 = ht.load_estimator(p)
+    np.testing.assert_allclose(nb2.theta_, nb.theta_, rtol=1e-6)
+    np.testing.assert_allclose(nb2.sigma_, nb.sigma_, rtol=1e-6)
+    np.testing.assert_array_equal(nb2.classes_, nb.classes_)
+    np.testing.assert_array_equal(nb2.predict(X).numpy(), nb.predict(X).numpy())
+    # partial_fit continues from restored state
+    nb2.partial_fit(X, ht.array(labels))
+    assert nb2.class_count_.sum() == 2 * nb.class_count_.sum()
+
+
+def test_knn_dndarray_params_roundtrip(tmp_path, X):
+    labels = ht.array((RNG.random(67) > 0.5).astype(np.int32))
+    knn = ht.classification.KNN(X, labels, 3)
+    p = str(tmp_path / "knn.h5")
+    knn.save(p)
+    knn2 = ht.load_estimator(p)
+    np.testing.assert_array_equal(knn2.predict(X).numpy(), knn.predict(X).numpy())
+
+
+def test_spectral_nested_estimator_roundtrip(tmp_path, X):
+    sp = ht.cluster.Spectral(n_clusters=2, n_lanczos=25)
+    sp.fit(X)
+    p = str(tmp_path / "sp.h5")
+    sp.save(p)
+    sp2 = ht.load_estimator(p)
+    np.testing.assert_array_equal(sp2.labels_.numpy(), sp.labels_.numpy())
+    # the nested fitted KMeans came back as a real estimator and predict works
+    assert isinstance(sp2._kmeans, ht.cluster.KMeans)
+    assert sp2.predict(X).shape == (67,)
+
+
+def test_unfitted_estimator_roundtrip(tmp_path):
+    km = ht.cluster.KMeans(n_clusters=4, tol=0.5)
+    p = str(tmp_path / "unfit.h5")
+    km.save(p)
+    km2 = ht.load_estimator(p)
+    assert km2.get_params() == km.get_params()
+    assert km2.cluster_centers_ is None
+
+
+def test_error_contracts(tmp_path, X):
+    with pytest.raises(TypeError):
+        ht.save_estimator("not an estimator", str(tmp_path / "x.h5"))
+    km = ht.cluster.KMeans(n_clusters=2)
+    with pytest.raises(TypeError):
+        ht.save_estimator(km, 123)
+    # loading a plain data file is a clear error, not a crash
+    data_file = str(tmp_path / "plain.h5")
+    ht.save(X, data_file, "data")
+    with pytest.raises(ValueError):
+        ht.load_estimator(data_file)
+    # wrong-class typed load
+    km.fit(X)
+    p = str(tmp_path / "km.h5")
+    km.save(p)
+    with pytest.raises(TypeError):
+        ht.regression.Lasso.load(p)
+    # missing file surfaces the io error
+    with pytest.raises(Exception):
+        ht.load_estimator(str(tmp_path / "nope.h5"))
+
+
+def test_ht_save_dispatches_estimators(tmp_path, X):
+    km = ht.cluster.KMeans(n_clusters=2, random_state=9)
+    km.fit(X)
+    p = str(tmp_path / "disp.h5")
+    ht.save(km, p)  # one entry point for data and models alike
+    km2 = ht.load_estimator(p)
+    np.testing.assert_allclose(
+        km2.cluster_centers_.numpy(), km.cluster_centers_.numpy(), rtol=1e-6
+    )
+
+
+def test_tuple_param_type_survives(tmp_path):
+    # JSON collapses tuples to lists; the manifest records which it was
+    from heat_tpu.core.checkpoint import _SaveContext, _encode, _decode
+
+    ctx = _SaveContext()
+    e_t = _encode((10, 20), "k", ctx)
+    e_l = _encode([10, 20], "k2", ctx)
+    assert _decode(e_t, "unused", {}) == (10, 20)
+    assert isinstance(_decode(e_t, "unused", {}), tuple)
+    assert _decode(e_l, "unused", {}) == [10, 20]
+    assert isinstance(_decode(e_l, "unused", {}), list)
+
+
+def test_large_host_array_spills_to_dataset(tmp_path, X):
+    # GaussianNB-style library-managed numpy state beyond the inline cap
+    # must not fail the save — it spills to an HDF5 dataset
+    import h5py
+    from heat_tpu.core import checkpoint as cp
+
+    labels = (RNG.random(67) > 0.5).astype(np.int32)
+    nb = ht.naive_bayes.GaussianNB()
+    nb.fit(X, ht.array(labels))
+    big = RNG.normal(size=(300, 80))  # 24,000 elements > inline cap
+    nb.theta_ = big
+    p = str(tmp_path / "nbbig.h5")
+    nb.save(p)
+    with h5py.File(p, "r") as f:
+        keys = []
+        f.visit(keys.append)
+        assert "fitted/theta_" in keys  # spilled, not inlined
+    nb2 = ht.load_estimator(p)
+    assert isinstance(nb2.theta_, np.ndarray)
+    np.testing.assert_allclose(nb2.theta_, big, rtol=1e-7)
+    assert nb2.theta_.dtype == big.dtype
+
+
+def test_shared_arrays_written_once(tmp_path, X):
+    # Spectral._labels IS its nested KMeans's labels_ — one dataset, and
+    # the load re-links them to one object
+    import h5py
+
+    sp = ht.cluster.Spectral(n_clusters=2, n_lanczos=25)
+    sp.fit(X)
+    assert sp._labels is sp._kmeans.labels_  # the premise
+    p = str(tmp_path / "sp.h5")
+    sp.save(p)
+    with h5py.File(p, "r") as f:
+        keys = []
+        f.visitall = f.visit(keys.append)
+        dset_keys = [k for k in keys if isinstance(f[k], h5py.Dataset)]
+    # the shared labels appear as ONE dataset (under whichever key was
+    # reached first), not two copies
+    label_sets = [k for k in dset_keys if k.endswith("_labels") or k.endswith("labels_")]
+    assert len(label_sets) == 1, dset_keys
+    sp2 = ht.load_estimator(p)
+    assert sp2._labels is sp2._kmeans._labels
+
+
+def test_ht_save_estimator_rejects_dataset_arg(tmp_path, X):
+    km = ht.cluster.KMeans(n_clusters=2)
+    km.fit(X)
+    with pytest.raises(TypeError):
+        ht.save(km, str(tmp_path / "x.h5"), "data")
+
+
+def test_typosquat_module_rejected():
+    # heat_tpu_evil must NOT pass the heat_tpu-only import guard
+    from heat_tpu.core.checkpoint import _resolve_class
+
+    with pytest.raises(ValueError):
+        _resolve_class("heat_tpu_evil.x:Cls")
+    with pytest.raises(ValueError):
+        _resolve_class("os:system")
+
+
+def test_tampered_class_is_rejected(tmp_path, X):
+    # the loader refuses to import classes outside heat_tpu
+    import h5py
+    import json
+
+    km = ht.cluster.KMeans(n_clusters=2)
+    km.fit(X)
+    p = str(tmp_path / "km.h5")
+    km.save(p)
+    with h5py.File(p, "a") as f:
+        manifest = json.loads(f.attrs["heat_tpu_estimator"])
+        manifest["root"]["class"] = "os:system"
+        f.attrs["heat_tpu_estimator"] = json.dumps(manifest)
+    with pytest.raises(ValueError):
+        ht.load_estimator(p)
+
+
+def test_file_is_one_artifact_with_datasets(tmp_path, X):
+    import h5py
+
+    km = ht.cluster.KMeans(n_clusters=2, random_state=3)
+    km.fit(X)
+    p = str(tmp_path / "km.h5")
+    km.save(p)
+    with h5py.File(p, "r") as f:
+        assert "heat_tpu_estimator" in f.attrs
+        keys = []
+        f.visit(keys.append)
+        assert any(k.startswith("fitted/") for k in keys)
+    assert os.path.getsize(p) > 0

@@ -1,0 +1,115 @@
+"""ML algorithms on the bundled real datasets — the reference's canonical
+fixtures (reference cluster/tests/test_kmeans.py:77-113 fits iris across
+splits, test_spectral.py:37-86, naive_bayes/tests/test_gaussiannb.py:25-165
+fit iris; regression/tests/test_lasso.py uses diabetes.h5;
+classification/tests/test_knn.py uses the iris train/test split)."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import heat_tpu as ht
+
+SPLITS = [None, 0]
+
+
+@pytest.mark.parametrize("split", SPLITS)
+def test_kmeans_fit_iris(split):
+    # reference test_kmeans.py:77-113
+    iris = ht.datasets.load_iris(split=split)
+    k = 3
+    km = ht.cluster.KMeans(n_clusters=k, random_state=1)
+    km.fit(iris)
+    assert km.cluster_centers_.shape == (k, iris.shape[1])
+    assert km.labels_.shape == (150,)
+    labels = km.labels_.numpy()
+    assert set(np.unique(labels)) <= set(range(k))
+    # iris has 3 well-separated-ish species; a sane fit uses all clusters
+    assert len(np.unique(labels)) == k
+    assert np.isfinite(km.inertia_) and km.inertia_ > 0
+    # functional API
+    pred = km.predict(iris)
+    np.testing.assert_array_equal(pred.numpy(), labels)
+
+
+@pytest.mark.parametrize("cls", [ht.cluster.KMedians, ht.cluster.KMedoids])
+def test_kvariants_fit_iris(cls):
+    # reference test_kmedians.py / test_kmedoids.py
+    iris = ht.datasets.load_iris(split=0)
+    est = cls(n_clusters=3, random_state=1)
+    labels = est.fit_predict(iris)
+    assert labels.shape == (150,)
+    assert est.cluster_centers_.shape == (3, 4)
+    if cls is ht.cluster.KMedoids:
+        # medoids are actual data points
+        X = iris.numpy()
+        for c in est.cluster_centers_.numpy():
+            assert np.min(np.abs(X - c).sum(axis=1)) < 1e-5
+
+
+def test_spectral_fit_iris():
+    # reference test_spectral.py:37-86
+    iris = ht.datasets.load_iris(split=0)
+    sp = ht.cluster.Spectral(n_clusters=3, n_lanczos=30)
+    labels = sp.fit_predict(iris)
+    assert labels.shape == (150,)
+    assert len(np.unique(labels.numpy())) <= 3
+
+
+@pytest.mark.parametrize("split", SPLITS)
+def test_gaussiannb_fit_iris_accuracy(split):
+    # reference test_gaussiannb.py:25-165: fit iris, predictions mostly
+    # match the labels (sklearn's own GaussianNB scores ~0.95 here)
+    X_tr, X_te, y_tr, y_te = ht.datasets.load_iris_split(split=split)
+    nb = ht.naive_bayes.GaussianNB()
+    nb.fit(X_tr, y_tr)
+    acc = float((nb.predict(X_te).numpy() == y_te.numpy()).mean())
+    assert acc > 0.9, acc
+    # partial_fit path reaches the same model
+    nb2 = ht.naive_bayes.GaussianNB()
+    nb2.partial_fit(X_tr, y_tr, classes=np.unique(y_tr.numpy()))
+    np.testing.assert_allclose(nb2.theta_, nb.theta_, rtol=1e-6)
+
+
+@pytest.mark.parametrize("split", SPLITS)
+def test_knn_iris_split_accuracy(split):
+    # reference test_knn.py: the bundled 75/75 split
+    X_tr, X_te, y_tr, y_te = ht.datasets.load_iris_split(split=split)
+    knn = ht.classification.KNN(X_tr, y_tr, 5)
+    acc = float((knn.predict(X_te).numpy() == y_te.numpy()).mean())
+    assert acc > 0.9, acc
+
+
+def test_lasso_fit_diabetes():
+    # reference test_lasso.py:14-74: diabetes.h5, coefficients shrink
+    # monotonically with lam and the fit predicts better than the mean
+    x, y = ht.datasets.load_diabetes(split=0)
+    x = x.astype(ht.float32)
+    y = y.astype(ht.float32)
+    # standardize features for coordinate descent
+    x = (x - x.mean(axis=0)) / x.std(axis=0)
+    ls = ht.regression.Lasso(lam=0.01, max_iter=100)
+    ls.fit(x, y)
+    assert ls.coef_.shape[0] == 10
+    pred = ls.predict(x).numpy().ravel()
+    resid = np.mean((pred - y.numpy()) ** 2)
+    base = np.var(y.numpy())
+    assert resid < 0.7 * base, (resid, base)
+    # heavier regularization shrinks the coefficient mass
+    heavy = ht.regression.Lasso(lam=10.0, max_iter=100)
+    heavy.fit(x, y)
+    assert np.abs(heavy.coef_.numpy()).sum() < np.abs(ls.coef_.numpy()).sum()
+
+
+def test_kmeans_iris_checkpoint_roundtrip(tmp_path):
+    # the full workflow: fit on iris, checkpoint, reload, predict
+    iris = ht.datasets.load_iris(split=0)
+    km = ht.cluster.KMeans(n_clusters=3, random_state=7)
+    km.fit(iris)
+    p = str(tmp_path / "iris_km.h5")
+    ht.save(km, p)
+    km2 = ht.load_estimator(p)
+    np.testing.assert_array_equal(
+        km2.predict(iris).numpy(), km.predict(iris).numpy()
+    )

@@ -83,6 +83,14 @@ assert float(taken.sum()) == 171.0
 back = ht.zeros_like(R)
 back[perm] = taken
 assert float(abs(back - R).sum()) == 0.0
+# r4: estimator checkpoint across processes — ONE writer barrier for all
+# datasets + manifest, every process loads the restored layout
+ckpt = sys.argv[3] + ".est.h5"
+km.save(ckpt)
+km2 = ht.load_estimator(ckpt)
+assert type(km2).__name__ == "KMeans"
+assert km2.labels_.split == 0
+assert float(abs(km2.cluster_centers_ - km.cluster_centers_).sum()) < 1e-5
 print(f"proc {{pid}} OK", flush=True)
 """
 
