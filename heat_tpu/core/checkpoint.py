@@ -59,11 +59,15 @@ class _SaveContext:
         self.datasets: Dict[str, DNDarray] = {}
         self._by_id: Dict[int, str] = {}
 
-    def add(self, value: DNDarray, key: str) -> str:
-        existing = self._by_id.get(id(value))
+    def add(self, value: DNDarray, key: str, ident=None) -> str:
+        """Register ``value`` under ``key`` unless the identity object
+        (``ident``, default the value itself — pass the ORIGINAL host
+        array when spilling a numpy attribute) was registered before."""
+        ident_id = id(value if ident is None else ident)
+        existing = self._by_id.get(ident_id)
         if existing is not None:
             return existing
-        self._by_id[id(value)] = key
+        self._by_id[ident_id] = key
         self.datasets[key] = value
         return key
 
@@ -92,13 +96,21 @@ def _encode(value, key: str, ctx: _SaveContext) -> Dict[str, Any]:
     if isinstance(value, np.ndarray):
         if value.size > _NPARRAY_INLINE_MAX:
             # library-managed host state (e.g. GaussianNB theta_ on many
-            # features) must not fail the save — spill it to a dataset
-            from . import factories
+            # features) must not fail the save — spill it to a dataset.
+            # Dedup keys on the ORIGINAL numpy object: two attributes
+            # aliasing one array write one dataset
+            existing = ctx._by_id.get(id(value))
+            if existing is not None:
+                arr = ctx.datasets[existing]
+                used = existing
+            else:
+                from . import factories
 
-            arr = factories.array(np.ascontiguousarray(value))
+                arr = factories.array(np.ascontiguousarray(value))
+                used = ctx.add(arr, key, ident=value)
             return {
                 "kind": "nparray_dataset",
-                "key": ctx.add(arr, key),
+                "key": used,
                 "dtype": value.dtype.str,
                 "heat_dtype": arr.dtype.__name__,
             }

@@ -286,7 +286,34 @@ def heat_type_of(obj: Any) -> type:
     if isinstance(obj, numbers.Real):
         return float32
     if isinstance(obj, (list, tuple)):
-        return canonical_heat_type(np.asarray(obj).dtype)
+        # promote over the ELEMENT types (reference types.py:343-441 scans
+        # the iterable), so python scalars keep their 32-bit default —
+        # np.asarray would silently widen [1, 2, 3] to int64.  A scalar's
+        # heat type is a function of its PYTHON type alone, so one
+        # representative per distinct type suffices (O(n) type lookups,
+        # ~3 promote calls — not a promote per element)
+        if len(obj) == 0:
+            return float32
+        reps = {}
+        for el in obj:
+            reps.setdefault(type(el), el)
+        if all(
+            isinstance(v, (builtins.bool, numbers.Number, np.generic))
+            for v in reps.values()
+        ):
+            result = None
+            for v in reps.values():
+                t = heat_type_of(v)
+                result = t if result is None else promote_types(result, t)
+            return result
+        # nested lists / array elements: let numpy probe the leaf dtype in
+        # C, keeping the factory's 32-bit default for python scalars
+        npdt = np.asarray(obj).dtype
+        if npdt == np.int64:
+            return int32
+        if npdt == np.float64:
+            return float32
+        return canonical_heat_type(npdt)
     raise TypeError(f"cannot determine heat type of {type(obj)}")
 
 
@@ -327,6 +354,12 @@ def can_cast(from_: Any, to: Any, casting: str = "intuitive") -> builtins.bool:
     the same bit width (e.g. int32→float32), matching the reference's
     default rule.
     """
+    if not isinstance(casting, str):
+        raise TypeError(f"expected casting to be str, found {type(casting)}")
+    if casting not in ("no", "safe", "same_kind", "unsafe", "intuitive"):
+        # validate BEFORE any early return so a typo'd rule never silently
+        # acts as one of the real ones (reference types.py:502-506)
+        raise ValueError(f"invalid casting rule {casting!r}")
     if not isinstance(from_, type):
         from_ = heat_type_of(from_)
     src = canonical_heat_type(from_)
@@ -338,7 +371,7 @@ def can_cast(from_: Any, to: Any, casting: str = "intuitive") -> builtins.bool:
     s_np, d_np = np.dtype(src._np_type), np.dtype(dst._np_type)
     if casting == "same_kind":
         if src is bfloat16 or dst is bfloat16:
-            return issubclass(dst, floating) or casting == "unsafe"
+            return issubclass(dst, floating)
         return np.can_cast(s_np, d_np, casting="same_kind")
     # safe / intuitive
     if src is bfloat16:
@@ -350,11 +383,10 @@ def can_cast(from_: Any, to: Any, casting: str = "intuitive") -> builtins.bool:
         safe = np.can_cast(s_np, d_np, casting="safe")
     if safe or casting == "safe":
         return safe
-    if casting == "intuitive":
-        if (issubclass(src, integer) or src is bool) and issubclass(dst, floating):
-            return __width(dst) >= min(__width(src), 32) or dst in (float32, float64)
-        return False
-    raise ValueError(f"invalid casting rule {casting!r}")
+    # casting == "intuitive": safe + int→float of at least the same width
+    if (issubclass(src, integer) or src is bool) and issubclass(dst, floating):
+        return __width(dst) >= min(__width(src), 32) or dst in (float32, float64)
+    return False
 
 
 def promote_types(type1: Any, type2: Any) -> type:

@@ -210,7 +210,7 @@ def test_shared_arrays_written_once(tmp_path, X):
     sp.save(p)
     with h5py.File(p, "r") as f:
         keys = []
-        f.visitall = f.visit(keys.append)
+        f.visit(keys.append)
         dset_keys = [k for k in keys if isinstance(f[k], h5py.Dataset)]
     # the shared labels appear as ONE dataset (under whichever key was
     # reached first), not two copies
@@ -225,6 +225,36 @@ def test_ht_save_estimator_rejects_dataset_arg(tmp_path, X):
     km.fit(X)
     with pytest.raises(TypeError):
         ht.save(km, str(tmp_path / "x.h5"), "data")
+    # checkpoints are HDF5 — a NetCDF/CSV extension is a clear error, not
+    # silently-misfiled bytes
+    with pytest.raises(ValueError):
+        ht.save(km, str(tmp_path / "x.nc"))
+    with pytest.raises(ValueError):
+        ht.save(km, str(tmp_path / "x.csv"))
+
+
+def test_aliased_numpy_attrs_spill_once(tmp_path, X):
+    # two attributes referencing ONE large host array -> one dataset,
+    # re-linked on load
+    import h5py
+
+    labels = (RNG.random(67) > 0.5).astype(np.int32)
+    nb = ht.naive_bayes.GaussianNB()
+    nb.fit(X, ht.array(labels))
+    big = RNG.normal(size=(300, 80))
+    nb.theta_ = big
+    nb.sigma_ = big  # alias
+    p = str(tmp_path / "alias.h5")
+    nb.save(p)
+    with h5py.File(p, "r") as f:
+        keys = []
+        f.visit(keys.append)
+        spilled = [k for k in keys if k.startswith("fitted/") and
+                   isinstance(f[k], h5py.Dataset) and f[k].size == big.size]
+    assert len(spilled) == 1, spilled
+    nb2 = ht.load_estimator(p)
+    np.testing.assert_allclose(nb2.theta_, big, rtol=1e-7)
+    assert nb2.theta_ is nb2.sigma_  # aliasing restored
 
 
 def test_typosquat_module_rejected():

@@ -46,6 +46,13 @@ MANIP = [
     (lambda x: ht.expand_dims(x, 0), lambda a: np.expand_dims(a, 0)),
     (lambda x: x.T, lambda a: a.T),
     (lambda x: ht.sort(x, axis=-1)[0], lambda a: np.sort(a, axis=-1)),
+    (lambda x: ht.reshape(x, (-1,)), lambda a: a.reshape(-1)),
+    (lambda x: ht.concatenate([x, x], axis=0), lambda a: np.concatenate([a, a], axis=0)),
+    (lambda x: ht.cumsum(x, axis=0), lambda a: np.cumsum(a, axis=0)),
+    (
+        lambda x: ht.where(x > 0, ht.clip(x, -1.0, 1.0), x * 0.5),
+        lambda a: np.where(a > 0, np.clip(a, -1.0, 1.0), a * 0.5),
+    ),
 ]
 
 
