@@ -58,16 +58,21 @@ class _SaveContext:
     def __init__(self):
         self.datasets: Dict[str, DNDarray] = {}
         self._by_id: Dict[int, str] = {}
+        # id() keys are only valid while the object lives — retain every
+        # identity object so a freed temporary's recycled address can
+        # never produce a false dedup hit
+        self._keepalive: list = []
 
     def add(self, value: DNDarray, key: str, ident=None) -> str:
         """Register ``value`` under ``key`` unless the identity object
         (``ident``, default the value itself — pass the ORIGINAL host
         array when spilling a numpy attribute) was registered before."""
-        ident_id = id(value if ident is None else ident)
-        existing = self._by_id.get(ident_id)
+        obj = value if ident is None else ident
+        existing = self._by_id.get(id(obj))
         if existing is not None:
             return existing
-        self._by_id[ident_id] = key
+        self._by_id[id(obj)] = key
+        self._keepalive.append(obj)
         self.datasets[key] = value
         return key
 
@@ -168,6 +173,12 @@ def save_estimator(est: BaseEstimator, path: str) -> None:
         raise TypeError(f"est must be a BaseEstimator, got {type(est)}")
     if not isinstance(path, str):
         raise TypeError(f"path must be str, not {type(path)}")
+    import os
+
+    if os.path.splitext(path)[-1].strip().lower() not in (".h5", ".hdf5"):
+        # guard EVERY entry point (est.save, ht.save, save_estimator):
+        # HDF5 bytes under a .nc/.csv name would misdirect the loader
+        raise ValueError("estimator checkpoints are HDF5: use a .h5/.hdf5 path")
 
     ctx = _SaveContext()
     manifest = {

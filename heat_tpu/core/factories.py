@@ -117,7 +117,8 @@ def array(
         # (observed as an alignment-dependent flake).  A fresh host copy
         # is owned by nobody else, so the later jnp aliasing is harmless,
         # and accelerator backends pay no second device-side copy.
-        garr = jnp.asarray(np.array(obj, copy=True if copy else None))
+        host = np.array(obj, copy=True if copy else None)
+        garr = jnp.asarray(host)
 
     # dtype resolution: heat defaults promote python float data to float32
     # (reference factories.py:240-260)
@@ -127,12 +128,21 @@ def array(
     else:
         npdt = np.dtype(garr.dtype)
         if not isinstance(obj, (DNDarray, jnp.ndarray, jax.Array, np.ndarray)):
-            # python scalars/lists default to 32-bit (TPU-first; matches the
-            # jax convention and the reference's float32 default)
+            # python scalars/lists default to 32-bit (TPU-first; matches
+            # the jax convention and the reference's float32 default) —
+            # unless the VALUES need 64 bits: [2**40] must not truncate.
+            # The range probe runs on the HOST copy: an accelerator with
+            # emulated f64 may already have clobbered wide values
             if npdt == np.float64:
-                garr = garr.astype(jnp.float32)
+                finite = host[np.isfinite(host)] if host.size else host
+                mx = float(np.abs(finite).max()) if finite.size else 0.0
+                if mx <= float(np.finfo(np.float32).max):
+                    garr = garr.astype(jnp.float32)
             elif npdt == np.int64:
-                garr = garr.astype(jnp.int32)
+                if host.size == 0 or (
+                    int(host.min()) >= -(2**31) and int(host.max()) < 2**31
+                ):
+                    garr = garr.astype(jnp.int32)
         dtype = types.canonical_heat_type(garr.dtype)
 
     if copy and isinstance(obj, (jnp.ndarray, jax.Array, DNDarray)):

@@ -483,14 +483,10 @@ def save(data: DNDarray, path: str, *args, **kwargs) -> None:
                 "estimator checkpoints take no dataset/option arguments: "
                 "use ht.save(estimator, path)"
             )
-        if not isinstance(path, str):
-            raise TypeError(f"Expected path to be str, but was {type(path)}")
-        if os.path.splitext(path)[-1].strip().lower() not in __HDF5_EXTENSIONS:
-            raise ValueError(
-                "estimator checkpoints are HDF5: use a .h5/.hdf5 path"
-            )
         from .checkpoint import save_estimator
 
+        # path/extension validation lives in save_estimator so est.save()
+        # and ht.save() enforce the same contract
         return save_estimator(data, path)
     if not isinstance(path, str):
         raise TypeError(f"Expected path to be str, but was {type(path)}")

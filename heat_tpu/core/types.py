@@ -286,34 +286,35 @@ def heat_type_of(obj: Any) -> type:
     if isinstance(obj, numbers.Real):
         return float32
     if isinstance(obj, (list, tuple)):
-        # promote over the ELEMENT types (reference types.py:343-441 scans
-        # the iterable), so python scalars keep their 32-bit default —
-        # np.asarray would silently widen [1, 2, 3] to int64.  A scalar's
-        # heat type is a function of its PYTHON type alone, so one
-        # representative per distinct type suffices (O(n) type lookups,
-        # ~3 promote calls — not a promote per element)
+        # python-scalar leaves keep the package's 32-bit default (the
+        # reference scans element TYPES, types.py:343-441; np.asarray
+        # would widen [1, 2, 3] to int64) — but only when the VALUES fit:
+        # a list holding 2**40 must still type int64, not truncate.  All
+        # probing is C-speed (np.asarray + min/max); leaves that carry an
+        # explicit numpy dtype keep it verbatim.
         if len(obj) == 0:
             return float32
-        reps = {}
-        for el in obj:
-            reps.setdefault(type(el), el)
-        if all(
-            isinstance(v, (builtins.bool, numbers.Number, np.generic))
-            for v in reps.values()
-        ):
-            result = None
-            for v in reps.values():
-                t = heat_type_of(v)
-                result = t if result is None else promote_types(result, t)
-            return result
-        # nested lists / array elements: let numpy probe the leaf dtype in
-        # C, keeping the factory's 32-bit default for python scalars
-        npdt = np.asarray(obj).dtype
-        if npdt == np.int64:
-            return int32
-        if npdt == np.float64:
-            return float32
-        return canonical_heat_type(npdt)
+        leaf = obj
+        while isinstance(leaf, (list, tuple)) and len(leaf):
+            leaf = leaf[0]
+        arr = np.asarray(obj)
+        if arr.dtype == object:
+            raise TypeError(f"cannot determine heat type of ragged/object {type(obj)}")
+        explicit = isinstance(leaf, (np.generic, np.ndarray)) or hasattr(leaf, "dtype")
+        if not explicit and arr.size:
+            if arr.dtype == np.int64:
+                lo, hi = builtins.int(arr.min()), builtins.int(arr.max())
+                return int64 if lo < -(2**31) or hi >= 2**31 else int32
+            if arr.dtype == np.float64:
+                finite = arr[np.isfinite(arr)]
+                mx = builtins.float(np.abs(finite).max()) if finite.size else 0.0
+                return float64 if mx > builtins.float(np.finfo(np.float32).max) else float32
+        elif not explicit:
+            if arr.dtype == np.int64:
+                return int32
+            if arr.dtype == np.float64:
+                return float32
+        return canonical_heat_type(arr.dtype)
     raise TypeError(f"cannot determine heat type of {type(obj)}")
 
 

@@ -1,0 +1,87 @@
+"""diag/diagonal scenario matrix — the reference's 360-line
+test_diag/test_diagonal group (test_manipulations.py:367-727): construct
+vs extract duality, offset sweeps scaled by mesh size, n-D dim pairs,
+and the error contracts."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import heat_tpu as ht
+
+
+def _p():
+    return ht.get_comm().size
+
+
+@pytest.mark.parametrize("split", [None, 0])
+def test_diag_construct_mesh_scaled_offsets(split):
+    # reference :371-407 uses offsets of +-size so every mesh size probes
+    # a different remainder pattern
+    p = _p()
+    data = np.arange(2 * p, dtype=np.float32)
+    a = ht.array(data, split=split)
+    for off in (0, p, -p, 1, -1):
+        res = ht.diag(a, offset=off)
+        np.testing.assert_array_equal(res.numpy(), np.diag(data, off))
+        assert res.split == split
+        assert res.gshape == (2 * p + abs(off),) * 2
+
+
+def test_diag_of_diag_roundtrip():
+    # reference :409: diag(diag(v)) == v
+    p = _p()
+    v = ht.array(np.arange(2 * p, dtype=np.float32), split=0)
+    back = ht.diag(ht.diag(v))
+    np.testing.assert_array_equal(back.numpy(), v.numpy())
+    assert back.gshape == v.gshape
+
+
+def test_diag_3d_equals_diagonal():
+    # reference :411-414: for ndim > 2, diag falls through to diagonal
+    a = np.random.default_rng(3).normal(size=(6, 8, 5)).astype(np.float32)
+    for split in (None, 0, 1, 2):
+        x = ht.array(a, split=split)
+        np.testing.assert_array_equal(
+            ht.diag(x).numpy(), ht.diagonal(x).numpy()
+        )
+        np.testing.assert_array_equal(
+            ht.diagonal(x).numpy(), np.diagonal(a, axis1=0, axis2=1)
+        )
+
+
+@pytest.mark.parametrize("dims", [(0, 1), (0, 2), (1, 2), (2, 0), (1, 0)])
+@pytest.mark.parametrize("offset", [0, 2, -1])
+def test_diagonal_dim_pairs_3d(dims, offset):
+    # reference :549-706: the dim1/dim2 sweep
+    a = np.random.default_rng(5).normal(size=(6, 8, 5)).astype(np.float32)
+    x = ht.array(a, split=0)
+    got = ht.diagonal(x, offset=offset, dim1=dims[0], dim2=dims[1])
+    want = np.diagonal(a, offset=offset, axis1=dims[0], axis2=dims[1])
+    np.testing.assert_array_equal(got.numpy(), want)
+
+
+def test_diag_error_contracts():
+    # reference :416-430
+    with pytest.raises(TypeError):
+        ht.diag(np.arange(4))  # raw arrays rejected
+    a = ht.arange(4, dtype=ht.float32)
+    with pytest.raises((ValueError, TypeError)):
+        ht.diag(a, offset=None)
+    with pytest.raises((ValueError, TypeError)):
+        ht.diag(a, offset="3")
+    with pytest.raises(ValueError):
+        ht.diag(ht.array(3.0))  # 0-d
+    with pytest.raises(ValueError):
+        ht.diagonal(ht.array(np.zeros((3, 3), np.float32)), dim1=1, dim2=1)
+
+
+def test_diagonal_split_tracks_surviving_axis():
+    # extracting dims (0,1) from a split=2 3-D array leaves the old axis 2
+    # as the result's trailing axis — layout follows the data
+    a = np.arange(60, dtype=np.float32).reshape(3, 4, 5)
+    x = ht.array(a, split=2)
+    got = ht.diagonal(x, dim1=0, dim2=1)
+    np.testing.assert_array_equal(got.numpy(), np.diagonal(a, axis1=0, axis2=1))
+    assert got.gshape == (5, 3)

@@ -1,0 +1,84 @@
+"""Logical-tier matrix — the reference's test_logical.py sweep (:24-316):
+all/any over axis x keepdims x out, allclose/isclose tolerance and nan
+semantics, and the bool-coercion of the logical_* family, across splits."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import heat_tpu as ht
+
+M = (np.arange(24) % 5 > 0).reshape(4, 6)
+
+
+@pytest.mark.parametrize("split", [None, 0, 1])
+@pytest.mark.parametrize("axis", [None, 0, 1])
+@pytest.mark.parametrize("keepdims", [False, True])
+def test_all_any_matrix(split, axis, keepdims):
+    x = ht.array(M, split=split)
+    got_all = ht.all(x, axis=axis, keepdims=keepdims)
+    got_any = ht.any(x, axis=axis, keepdims=keepdims)
+    want_all = M.all(axis=axis, keepdims=keepdims)
+    want_any = M.any(axis=axis, keepdims=keepdims)
+    np.testing.assert_array_equal(np.asarray(got_all.numpy()), want_all)
+    np.testing.assert_array_equal(np.asarray(got_any.numpy()), want_any)
+
+
+@pytest.mark.parametrize("split", [None, 0])
+def test_all_any_out_buffers(split):
+    x = ht.array(M, split=split)
+    out = ht.zeros(6, dtype=ht.bool)
+    r = ht.any(x, axis=0, out=out)
+    assert r is out
+    np.testing.assert_array_equal(out.numpy(), M.any(axis=0))
+
+
+def test_allclose_tolerance_matrix():
+    a = ht.array(np.array([1.0, 2.0, 3.0], np.float32), split=0)
+    assert ht.allclose(a, ht.array(np.array([1.0001, 2.0002, 3.0003], np.float32), split=0), rtol=1e-3)
+    assert not ht.allclose(a, ht.array(np.array([1.1, 2.0, 3.0], np.float32), split=0), rtol=1e-3)
+    # atol-only closeness near zero
+    assert ht.allclose(
+        ht.array(np.array([0.0], np.float32)),
+        ht.array(np.array([1e-9], np.float32)),
+        atol=1e-8,
+    )
+    # nan semantics (reference logical.py allclose)
+    n = ht.array(np.array([np.nan], np.float32))
+    assert not ht.allclose(n, n)
+    assert ht.allclose(n, n, equal_nan=True)
+
+
+@pytest.mark.parametrize("split", [None, 0])
+def test_isclose_elementwise(split):
+    a = np.array([1.0, 2.0, np.nan, np.inf], np.float32)
+    b = np.array([1.0001, 3.0, np.nan, np.inf], np.float32)
+    x, y = ht.array(a, split=split), ht.array(b, split=split)
+    np.testing.assert_array_equal(
+        ht.isclose(x, y, rtol=1e-3).numpy(), np.isclose(a, b, rtol=1e-3)
+    )
+    np.testing.assert_array_equal(
+        ht.isclose(x, y, rtol=1e-3, equal_nan=True).numpy(),
+        np.isclose(a, b, rtol=1e-3, equal_nan=True),
+    )
+
+
+@pytest.mark.parametrize("split", [None, 0])
+def test_logical_family_coerces_numbers(split):
+    a = np.array([0, 1, 2, 0], np.int32)
+    b = np.array([1, 1, 0, 0], np.int32)
+    x, y = ht.array(a, split=split), ht.array(b, split=split)
+    np.testing.assert_array_equal(ht.logical_and(x, y).numpy(), np.logical_and(a, b))
+    np.testing.assert_array_equal(ht.logical_or(x, y).numpy(), np.logical_or(a, b))
+    np.testing.assert_array_equal(ht.logical_xor(x, y).numpy(), np.logical_xor(a, b))
+    np.testing.assert_array_equal(ht.logical_not(x).numpy(), np.logical_not(a))
+    assert ht.logical_and(x, y).dtype is ht.bool
+
+
+def test_equal_whole_array():
+    # reference relational.equal returns ONE bool for the whole comparison
+    a = ht.array(np.arange(6, dtype=np.float32), split=0)
+    assert ht.equal(a, a)
+    assert not ht.equal(a, a + 1.0)
+    assert ht.equal(a, a.resplit(None))

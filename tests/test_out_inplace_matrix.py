@@ -1,0 +1,108 @@
+"""out= buffers and in-place dunder matrix — the reference's binary-op
+out-parameter coverage (test_arithmetics.py sweeps out= on every op) and
+the augmented-assignment surface, across splits."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import heat_tpu as ht
+
+A = np.arange(1, 13, dtype=np.float32).reshape(3, 4)
+B = np.full((3, 4), 2.0, np.float32)
+
+BINARY = [
+    (ht.add, np.add),
+    (ht.sub, np.subtract),
+    (ht.mul, np.multiply),
+    (ht.div, np.divide),
+    (ht.pow, np.power),
+    (ht.fmod, np.fmod),
+    (ht.maximum, np.maximum),
+    (ht.minimum, np.minimum),
+]
+
+
+@pytest.mark.parametrize("split", [None, 0, 1])
+@pytest.mark.parametrize("hfn,nfn", BINARY, ids=[f.__name__ for f, _ in BINARY])
+def test_binary_out_buffer(split, hfn, nfn):
+    x, y = ht.array(A, split=split), ht.array(B, split=split)
+    out = ht.zeros((3, 4), dtype=ht.float32, split=split)
+    r = hfn(x, y, out)
+    assert r is out
+    np.testing.assert_allclose(out.numpy(), nfn(A, B), rtol=1e-6)
+    # the inputs are untouched (no aliasing surprises)
+    np.testing.assert_array_equal(x.numpy(), A)
+
+
+@pytest.mark.parametrize("split", [None, 0, 1])
+def test_unary_out_buffer(split):
+    x = ht.array(A, split=split)
+    out = ht.zeros((3, 4), dtype=ht.float32, split=split)
+    r = ht.exp(x, out)
+    assert r is out
+    np.testing.assert_allclose(out.numpy(), np.exp(A), rtol=1e-6)
+
+
+@pytest.mark.parametrize("split", [None, 0, 1])
+def test_inplace_dunder_chain(split):
+    x = ht.array(A.copy(), split=split)
+    y = ht.array(B, split=split)
+    want = A.copy()
+    x += y
+    want += B
+    x -= 1.0
+    want -= 1.0
+    x *= 2.0
+    want *= 2.0
+    x /= 4.0
+    want /= 4.0
+    np.testing.assert_allclose(x.numpy(), want, rtol=1e-6)
+    assert x.split == split
+    z = ht.array(np.array([7, 8, 9], np.int32), split=None if split == 1 else split)
+    z //= 2
+    np.testing.assert_array_equal(z.numpy(), np.array([3, 4, 4]))
+    z %= 3
+    np.testing.assert_array_equal(z.numpy(), np.array([0, 1, 1]))
+    z <<= 2
+    np.testing.assert_array_equal(z.numpy(), np.array([0, 4, 4]))
+    z >>= 1
+    np.testing.assert_array_equal(z.numpy(), np.array([0, 2, 2]))
+    z ^= 3
+    np.testing.assert_array_equal(z.numpy(), np.array([3, 1, 1]))
+    z |= 4
+    np.testing.assert_array_equal(z.numpy(), np.array([7, 5, 5]))
+    z &= 6
+    np.testing.assert_array_equal(z.numpy(), np.array([6, 4, 4]))
+
+
+def test_ipow_imatmul():
+    x = ht.array(A.copy(), split=0)
+    x **= 2.0
+    np.testing.assert_allclose(x.numpy(), A**2, rtol=1e-6)
+    m = ht.array(np.eye(3, dtype=np.float32) * 2.0, split=0)
+    m @= ht.array(np.eye(3, dtype=np.float32) * 3.0)
+    np.testing.assert_allclose(m.numpy(), np.eye(3) * 6.0, rtol=1e-6)
+
+
+@pytest.mark.parametrize("split", [None, 0])
+def test_out_buffer_dtype_and_shape_contracts(split):
+    x = ht.array(A, split=split)
+    y = ht.array(B, split=split)
+    bad_shape = ht.zeros((4, 3), dtype=ht.float32, split=split)
+    with pytest.raises((ValueError, TypeError)):
+        ht.add(x, y, bad_shape)
+    with pytest.raises(TypeError):
+        ht.add(x, y, np.zeros((3, 4), np.float32))
+
+
+def test_reduction_out_buffers():
+    x = ht.array(A, split=0)
+    out = ht.zeros(4, dtype=ht.float32)
+    r = ht.min(x, axis=0, out=out)
+    assert r is out
+    np.testing.assert_array_equal(out.numpy(), A.min(axis=0))
+    out2 = ht.zeros(3, dtype=ht.float32)
+    ht.max(x, axis=1, out=out2)
+    np.testing.assert_array_equal(out2.numpy(), A.max(axis=1))

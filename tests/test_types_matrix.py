@@ -61,6 +61,26 @@ def test_heat_type_of_forms():
     assert T.heat_type_of(ht.ones(2, dtype=ht.int16)) is ht.int16
 
 
+def test_heat_type_of_value_range_guards():
+    # the 32-bit default never truncates: values beyond int32/float32
+    # range widen the inferred type (and the data survives ht.array)
+    assert T.heat_type_of([2**40]) is ht.int64
+    assert T.heat_type_of([1, 2, -(2**35)]) is ht.int64
+    assert T.heat_type_of([1e300]) is ht.float64
+    assert T.heat_type_of([[1, 2], [3, 2**40]]) is ht.int64
+    assert int(ht.array([2**40]).numpy()[0]) == 2**40
+    # inf stays float32 (inf is representable; only finite overflow widens)
+    assert T.heat_type_of([float("inf"), 1.0]) is ht.float32
+
+
+def test_heat_type_of_explicit_numpy_leaves_keep_dtype():
+    # explicitly-typed numpy data is never downgraded by the 32-bit rule
+    assert T.heat_type_of([np.arange(3, dtype=np.int64)]) is ht.int64
+    assert T.heat_type_of([np.float64(2.0), np.float64(3.0)]) is ht.float64
+    assert T.heat_type_of([np.arange(2, dtype=np.float64)]) is ht.float64
+    assert T.heat_type_of([np.int8(1), np.int8(2)]) is ht.int8
+
+
 def test_promote_types_algebra():
     # symmetric, idempotent, bool-neutral — the lattice laws the
     # reference's table implies (types.py:542-574)
@@ -108,8 +128,11 @@ def test_intuitive_rule_definition():
     assert ht.can_cast(ht.uint8, ht.float32)
     assert not ht.can_cast(ht.float32, ht.int64)  # never float->int
     assert not ht.can_cast(ht.float64, ht.float32)  # not a widening
-    assert ht.can_cast(ht.int64, ht.float32, casting="intuitive") or True  # pinned below
-    # the reference rejects int64->float32 under intuitive; pin ours
+    # deliberate divergence from the reference's table (types.py:420
+    # rejects int64->float32): this lattice follows jax/numpy weak
+    # promotion — promote(int64, float32) is float32 here (pinned in
+    # test_conformance), so intuitive casting admits it for closure
+    assert ht.can_cast(ht.int64, ht.float32, casting="intuitive") is True
     assert not ht.can_cast(ht.int64, ht.float32, casting="safe")
 
 
