@@ -175,7 +175,7 @@ def save_estimator(est: BaseEstimator, path: str) -> None:
         raise TypeError(f"path must be str, not {type(path)}")
     import os
 
-    if os.path.splitext(path)[-1].strip().lower() not in (".h5", ".hdf5"):
+    if os.path.splitext(path)[-1].strip().lower() not in _io.HDF5_EXTENSIONS:
         # guard EVERY entry point (est.save, ht.save, save_estimator):
         # HDF5 bytes under a .nc/.csv name would misdirect the loader
         raise ValueError("estimator checkpoints are HDF5: use a .h5/.hdf5 path")

@@ -130,19 +130,15 @@ def array(
         if not isinstance(obj, (DNDarray, jnp.ndarray, jax.Array, np.ndarray)):
             # python scalars/lists default to 32-bit (TPU-first; matches
             # the jax convention and the reference's float32 default) —
-            # unless the VALUES need 64 bits: [2**40] must not truncate.
-            # The range probe runs on the HOST copy: an accelerator with
+            # unless the VALUES need 64 bits or leaves carry an explicit
+            # numpy dtype.  One rule shared with types.heat_type_of; the
+            # probe runs on the HOST copy because an accelerator with
             # emulated f64 may already have clobbered wide values
-            if npdt == np.float64:
-                finite = host[np.isfinite(host)] if host.size else host
-                mx = float(np.abs(finite).max()) if finite.size else 0.0
-                if mx <= float(np.finfo(np.float32).max):
-                    garr = garr.astype(jnp.float32)
-            elif npdt == np.int64:
-                if host.size == 0 or (
-                    int(host.min()) >= -(2**31) and int(host.max()) < 2**31
-                ):
-                    garr = garr.astype(jnp.int32)
+            if npdt in (np.int64, np.float64):
+                seq = obj if isinstance(obj, (list, tuple)) else [obj]
+                inferred = types._infer_list_type(seq, np.atleast_1d(host))
+                if inferred is not types.canonical_heat_type(npdt):
+                    garr = garr.astype(inferred.jax_type())
         dtype = types.canonical_heat_type(garr.dtype)
 
     if copy and isinstance(obj, (jnp.ndarray, jax.Array, DNDarray)):

@@ -61,6 +61,8 @@ __all__ = [
 ]
 
 __HDF5_EXTENSIONS = frozenset([".h5", ".hdf5"])
+#: public alias — estimator checkpointing shares the routing table
+HDF5_EXTENSIONS = __HDF5_EXTENSIONS
 __NETCDF_EXTENSIONS = frozenset([".nc", ".nc4", ".netcdf"])
 __CSV_EXTENSIONS = frozenset([".csv", ".txt"])
 

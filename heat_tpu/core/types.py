@@ -286,36 +286,89 @@ def heat_type_of(obj: Any) -> type:
     if isinstance(obj, numbers.Real):
         return float32
     if isinstance(obj, (list, tuple)):
-        # python-scalar leaves keep the package's 32-bit default (the
-        # reference scans element TYPES, types.py:343-441; np.asarray
-        # would widen [1, 2, 3] to int64) — but only when the VALUES fit:
-        # a list holding 2**40 must still type int64, not truncate.  All
-        # probing is C-speed (np.asarray + min/max); leaves that carry an
-        # explicit numpy dtype keep it verbatim.
         if len(obj) == 0:
             return float32
-        leaf = obj
-        while isinstance(leaf, (list, tuple)) and len(leaf):
-            leaf = leaf[0]
         arr = np.asarray(obj)
         if arr.dtype == object:
             raise TypeError(f"cannot determine heat type of ragged/object {type(obj)}")
-        explicit = isinstance(leaf, (np.generic, np.ndarray)) or hasattr(leaf, "dtype")
-        if not explicit and arr.size:
-            if arr.dtype == np.int64:
-                lo, hi = builtins.int(arr.min()), builtins.int(arr.max())
-                return int64 if lo < -(2**31) or hi >= 2**31 else int32
-            if arr.dtype == np.float64:
-                finite = arr[np.isfinite(arr)]
-                mx = builtins.float(np.abs(finite).max()) if finite.size else 0.0
-                return float64 if mx > builtins.float(np.finfo(np.float32).max) else float32
-        elif not explicit:
-            if arr.dtype == np.int64:
-                return int32
-            if arr.dtype == np.float64:
-                return float32
-        return canonical_heat_type(arr.dtype)
+        return _infer_list_type(obj, arr)
     raise TypeError(f"cannot determine heat type of {type(obj)}")
+
+
+def _float32_fits(arr: np.ndarray) -> builtins.bool:
+    """True when every finite value of float64 ``arr`` survives a float32
+    cast: no finite overflow to inf AND no nonzero flush to zero."""
+    finite = arr[np.isfinite(arr)]
+    if not finite.size:
+        return True
+    mags = np.abs(finite)
+    if builtins.float(mags.max()) > builtins.float(np.finfo(np.float32).max):
+        return False
+    nonzero = mags[mags > 0]
+    if nonzero.size and builtins.float(nonzero.min()) < builtins.float(
+        np.finfo(np.float32).smallest_subnormal
+    ):
+        return False
+    return True
+
+
+def _infer_list_type(obj, arr: np.ndarray) -> type:
+    """Heat type of a list/tuple whose numpy image is ``arr``.
+
+    Python-scalar leaves keep the package's 32-bit default (the reference
+    scans element TYPES, types.py:343-441; np.asarray would widen
+    [1, 2, 3] to int64) — but only when the VALUES fit: a list holding
+    2**40 must still type int64, and 1e-300 must not flush to zero.
+    Explicitly-typed numpy leaves keep their dtype; mixed lists promote
+    per distinct element type.  Value probes are C-speed (min/max on
+    ``arr``); the element-type walk only runs for the ambiguous
+    int64/float64 dtypes and builds one representative per distinct type.
+    """
+    if arr.dtype not in (np.int64, np.float64):
+        return canonical_heat_type(arr.dtype)  # unambiguous: numpy's probe
+    reps: dict = {}
+    nested = False
+    for el in obj:
+        if isinstance(el, (list, tuple)):
+            nested = True
+            break
+        # arrays of different dtypes share type(el) — key on dtype too
+        reps.setdefault((type(el), getattr(el, "dtype", None)), el)
+    if nested:
+        # n-D input: walk to the first leaf only (a full recursive scan
+        # would be O(total elements) python-speed); python-scalar leaves
+        # get the value-guarded 32-bit default
+        leaf = obj
+        while isinstance(leaf, (list, tuple)) and len(leaf):
+            leaf = leaf[0]
+        explicit = isinstance(leaf, (np.generic, np.ndarray)) or hasattr(leaf, "dtype")
+        if explicit:
+            return canonical_heat_type(arr.dtype)
+    else:
+        explicit_types = [
+            v for v in reps.values()
+            if isinstance(v, (np.generic, np.ndarray)) or hasattr(v, "dtype")
+        ]
+        if explicit_types:
+            # promote one representative per distinct type: python
+            # scalars contribute their 32-bit default, explicit numpy
+            # leaves their verbatim dtype
+            result = None
+            for v in reps.values():
+                t = (
+                    canonical_heat_type(v.dtype)
+                    if isinstance(v, (np.generic, np.ndarray)) or hasattr(v, "dtype")
+                    else heat_type_of(v)
+                )
+                result = t if result is None else promote_types(result, t)
+            return result
+    # pure python-scalar leaves: 32-bit default, value-range guarded
+    if not arr.size:
+        return int32 if arr.dtype == np.int64 else float32
+    if arr.dtype == np.int64:
+        lo, hi = builtins.int(arr.min()), builtins.int(arr.max())
+        return int64 if lo < -(2**31) or hi >= 2**31 else int32
+    return float32 if _float32_fits(arr) else float64
 
 
 def heat_type_is_exact(ht_dtype: Any) -> builtins.bool:

@@ -81,6 +81,44 @@ def test_heat_type_of_explicit_numpy_leaves_keep_dtype():
     assert T.heat_type_of([np.int8(1), np.int8(2)]) is ht.int8
 
 
+def test_heat_type_of_mixed_element_lists_promote():
+    # mixed python/numpy elements promote per distinct element type:
+    # the explicit leaf keeps its dtype, the python leaf its 32-bit default
+    assert T.heat_type_of([2.0, np.float64(3.0)]) is ht.float64
+    assert T.heat_type_of([np.float32(1.0), 2.0]) is ht.float32
+    assert T.heat_type_of([1, np.int64(2)]) is ht.int64
+    assert T.heat_type_of([np.int16(1), 2]) is ht.int32
+    # two arrays of different dtypes promote, not first-wins
+    assert T.heat_type_of(
+        [np.arange(2, dtype=np.int32), np.arange(2, dtype=np.float64)]
+    ) is ht.float64
+
+
+def test_value_guard_covers_subnormal_flush():
+    # 1e-300 survives: a float32 downcast would flush it to zero
+    assert T.heat_type_of([1e-300]) is ht.float64
+    assert float(ht.array([1e-300]).numpy()[0]) == 1e-300
+    # plain zero stays in the 32-bit default
+    assert T.heat_type_of([0.0, 1.0]) is ht.float32
+
+
+def test_array_factory_matches_heat_type_of_on_lists():
+    # one inference rule across the factory and the type query
+    cases = [
+        [2**40],
+        [1, 2, 3],
+        [1e-300],
+        [1.5, 2.5],
+        [np.arange(3, dtype=np.int64)],
+        [2.0, np.float64(3.0)],
+        [np.float32(1.0), 2.0],
+    ]
+    for obj in cases:
+        assert ht.array(obj).dtype is T.heat_type_of(obj), obj
+    # scalars preserve wide values too
+    assert int(ht.array(2**40).numpy()) == 2**40
+
+
 def test_promote_types_algebra():
     # symmetric, idempotent, bool-neutral — the lattice laws the
     # reference's table implies (types.py:542-574)
