@@ -336,6 +336,11 @@ def percentile(x: DNDarray, q, axis=None, out=None, interpolation: str = "linear
     axis = sanitize_axis(x.shape, axis)
     method = {"linear": "linear", "lower": "lower", "higher": "higher", "midpoint": "midpoint", "nearest": "nearest"}[interpolation]
     qa = jnp.asarray(q, dtype=jnp.float64)
+    reduced_empty = (
+        x.size == 0 if axis is None else any(x.shape[a] == 0 for a in (
+            (axis,) if isinstance(axis, int) else axis
+        ))
+    )
     # interpolation dtype only — materializing the (possibly ragged) true
     # view or an f64 copy up front would defeat the padded fast paths below
     idt = jnp.float64 if types.heat_type_is_exact(x.dtype) else x._buffer.dtype
@@ -346,7 +351,22 @@ def percentile(x: DNDarray, q, axis=None, out=None, interpolation: str = "linear
 
     from ..parallel import sort as _parallel_sort  # lazy: parallel imports core
 
-    if (
+    if reduced_empty:
+        # numpy: percentile of an empty region is nan (np.median([]) is
+        # nan; numpy 2.x percentile IndexErrors — we take the nan
+        # contract), never a backend gather error.  res flows into the
+        # common wrap/out tail like every other branch
+        if axis is None:
+            tail = (1,) * x.ndim if keepdims else ()
+        else:
+            axes = (axis,) if isinstance(axis, int) else tuple(axis)
+            tail = tuple(
+                (1 if d in axes else s) if keepdims or d not in axes else None
+                for d, s in enumerate(x.shape)
+            )
+            tail = tuple(s for s in tail if s is not None)
+        res = jnp.full(tuple(qa.shape) + tail, jnp.nan, dtype=idt)
+    elif (
         axis is None
         and x.split is not None
         and _parallel_sort.supports(x._buffer.dtype, x.size, x.comm)

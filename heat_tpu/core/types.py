@@ -352,7 +352,7 @@ def _infer_list_type(obj, arr: np.ndarray) -> type:
         if explicit_types:
             # promote one representative per distinct type: python
             # scalars contribute their 32-bit default, explicit numpy
-            # leaves their verbatim dtype
+            # leaves their verbatim dtype...
             result = None
             for v in reps.values():
                 t = (
@@ -361,6 +361,17 @@ def _infer_list_type(obj, arr: np.ndarray) -> type:
                     else heat_type_of(v)
                 )
                 result = t if result is None else promote_types(result, t)
+            # ...then re-apply the VALUE guard over the whole list (arr
+            # covers every element): [np.int32(1), 2**40] must widen to
+            # int64, not truncate through the promoted int32
+            if issubclass(result, integer) and arr.dtype == np.int64 and arr.size:
+                info = iinfo(result)
+                lo, hi = builtins.int(arr.min()), builtins.int(arr.max())
+                if lo < info.min or hi > info.max:
+                    result = promote_types(result, int64)
+            elif result is float32 and arr.dtype == np.float64:
+                if not _float32_fits(arr):
+                    result = float64
             return result
     # pure python-scalar leaves: 32-bit default, value-range guarded
     if not arr.size:

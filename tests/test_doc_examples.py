@@ -37,8 +37,8 @@ def _blocks(path):
     return re.findall(r"```python\n(.*?)```", text, flags=re.DOTALL)
 
 
-def _run_doc(path, tmp_path):
-    os.chdir(tmp_path)
+def _run_doc(path, tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)  # auto-restored; a bare chdir would leak
     # fixtures the examples reference
     feats = ht.array(
         np.random.default_rng(0).normal(size=(300, 8)).astype(np.float32), split=0
@@ -64,9 +64,9 @@ def _run_doc(path, tmp_path):
     return ran
 
 
-def test_readme_blocks(tmp_path):
-    _run_doc(os.path.join(REPO, "README.md"), tmp_path)
+def test_readme_blocks(tmp_path, monkeypatch):
+    _run_doc(os.path.join(REPO, "README.md"), tmp_path, monkeypatch)
 
 
-def test_tutorial_blocks(tmp_path):
-    _run_doc(os.path.join(REPO, "docs", "tutorial.md"), tmp_path)
+def test_tutorial_blocks(tmp_path, monkeypatch):
+    _run_doc(os.path.join(REPO, "docs", "tutorial.md"), tmp_path, monkeypatch)

@@ -94,6 +94,18 @@ def test_heat_type_of_mixed_element_lists_promote():
     ) is ht.float64
 
 
+def test_mixed_list_value_guard_still_widens():
+    # the value guard survives the mixed promote: an np.int32 leaf plus a
+    # wide python int must widen, not truncate through the promoted int32
+    assert T.heat_type_of([np.int32(1), 2**40]) is ht.int64
+    assert int(ht.array([np.int32(1), 2**40]).numpy()[1]) == 2**40
+    assert T.heat_type_of([np.float32(1.0), 1e300]) is ht.float64
+    assert np.isfinite(ht.array([np.float32(1.0), 1e300]).numpy()[1])
+    # small mixed values keep the narrow promote
+    assert T.heat_type_of([np.int32(1), 5]) is ht.int32
+    assert T.heat_type_of([np.int16(1), np.int16(2)]) is ht.int16
+
+
 def test_value_guard_covers_subnormal_flush():
     # 1e-300 survives: a float32 downcast would flush it to zero
     assert T.heat_type_of([1e-300]) is ht.float64

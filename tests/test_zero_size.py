@@ -1,0 +1,121 @@
+"""Zero-size array semantics — the reference's empty-chunk discipline
+(_operations.py:391-404 neutral-element fills) generalized to globally
+empty arrays: every op either follows the numpy oracle or fails with
+numpy's error type, never a backend internals error."""
+
+from __future__ import annotations
+
+import warnings
+
+import numpy as np
+import pytest
+
+import heat_tpu as ht
+
+SPLITS = [None, 0]
+
+
+@pytest.mark.parametrize("split", SPLITS)
+def test_empty_factories_and_metadata(split):
+    x = ht.zeros((0, 5), split=split)
+    assert x.shape == (0, 5) and x.size == 0 and len(x) == 0
+    assert x.numpy().shape == (0, 5)
+    e = ht.arange(0)
+    assert e.shape == (0,)
+    f = ht.full((0,), 7.0)
+    assert f.size == 0
+
+
+@pytest.mark.parametrize("split", SPLITS)
+def test_empty_reductions_neutral_elements(split):
+    x = ht.zeros((0, 5), split=split)
+    # sum/prod have neutral elements; all/any follow their identities
+    np.testing.assert_array_equal(ht.sum(x, axis=0).numpy(), np.zeros(5))
+    np.testing.assert_array_equal(ht.prod(x, axis=0).numpy(), np.ones(5))
+    assert float(ht.sum(ht.zeros((0,), split=split))) == 0.0
+    assert bool(ht.all(ht.zeros((0,), split=split))) is True
+    assert bool(ht.any(ht.zeros((0,), split=split))) is False
+    # min/max of an empty region: numpy's ValueError, not a crash
+    with pytest.raises(ValueError):
+        ht.max(ht.zeros((0,), split=split))
+    with pytest.raises(ValueError):
+        ht.min(ht.zeros((0,), split=split))
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        assert np.isnan(float(ht.mean(ht.zeros((0,)))))
+
+
+@pytest.mark.parametrize("split", SPLITS)
+def test_empty_percentile_median_nan(split):
+    # kinder than numpy 2.x (which IndexErrors): empty region -> nan,
+    # consistent with np.median([]) == nan
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        assert np.isnan(float(ht.percentile(ht.zeros((0,), split=split), 50.0)))
+        assert np.isnan(float(ht.median(ht.zeros((0,), split=split))))
+        q = ht.percentile(ht.zeros((0, 4), split=split), [25.0, 75.0], axis=0)
+        assert q.shape == (2, 4) and np.all(np.isnan(q.numpy()))
+        k = ht.percentile(ht.zeros((0, 4), split=split), 50.0, axis=0, keepdims=True)
+        assert k.shape == (1, 4)
+    # empty NON-reduced dims flow through with empty results
+    assert ht.percentile(ht.zeros((0, 4), split=split), 50.0, axis=1).shape == (0,)
+    # dtype follows the non-empty convention: float32 in -> float32 out
+    assert (
+        ht.percentile(ht.zeros((2, 0), dtype=ht.float32, split=split), 50.0, axis=1).dtype
+        is ht.float32
+    )
+    # out= buffers are honored on the empty path too
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        buf = ht.full(4, 7.0, dtype=ht.float32)
+        r = ht.percentile(
+            ht.zeros((0, 4), dtype=ht.float32, split=split), 50.0, axis=0, out=buf
+        )
+        assert r is buf
+        assert np.all(np.isnan(buf.numpy()))
+
+
+@pytest.mark.parametrize("split", SPLITS)
+def test_empty_manipulations(split):
+    x = ht.zeros((0, 3), split=split)
+    y = ht.ones((2, 3), split=split)
+    np.testing.assert_array_equal(
+        ht.concatenate([x, y], axis=0).numpy(), np.ones((2, 3))
+    )
+    v, i = ht.sort(ht.zeros((0,), split=split))
+    assert v.shape == (0,) and i.shape == (0,)
+    assert ht.unique(ht.zeros((0,), split=split)).shape == (0,)
+    assert ht.flip(x, 0).shape == (0, 3)
+    assert ht.reshape(x, (0,)).shape == (0,)
+    assert ht.flatten(x).shape == (0,)
+    assert ht.repeat(ht.zeros((0,)), 3).shape == (0,)
+
+
+@pytest.mark.parametrize("split", SPLITS)
+def test_empty_indexing_and_linalg(split):
+    x = ht.arange(5, dtype=ht.float32, split=split)
+    assert x[3:3].shape == (0,)
+    assert x[np.array([], dtype=np.int32)].shape == (0,)
+    assert x[x > 99].shape == (0,)
+    m = ht.matmul(ht.zeros((0, 4), split=split), ht.ones((4, 3)))
+    assert m.shape == (0, 3)
+    # nonzero: 1-D input keeps the flat (nnz,) convention
+    assert ht.nonzero(ht.zeros((0,), split=split)).shape == (0,)
+    assert ht.nonzero(ht.zeros((0, 2), split=split)).shape == (0, 2)
+    assert ht.cumsum(ht.zeros((0,), split=split), axis=0).shape == (0,)
+
+
+def test_empty_elementwise_and_binary():
+    x = ht.zeros((0, 4), split=0)
+    assert ht.exp(x).shape == (0, 4)
+    assert (x + x).shape == (0, 4)
+    assert (x * 2.0).shape == (0, 4)
+    assert ht.where(x > 0, x, -x).shape == (0, 4)
+
+
+def test_empty_io_roundtrip(tmp_path):
+    p = str(tmp_path / "empty.h5")
+    x = ht.zeros((0, 4), split=0)
+    ht.save(x, p, "data")
+    back = ht.load(p, "data", split=0)
+    assert back.shape == (0, 4)
