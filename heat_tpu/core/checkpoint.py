@@ -92,37 +92,54 @@ def _encode(value, key: str, ctx: _SaveContext) -> Dict[str, Any]:
         return {"kind": "estimator", "manifest": _manifest(value, key + "/", ctx)}
     import jax
 
+    ident = None
     if isinstance(value, jax.Array):
+        # dedup keys on the ORIGINAL device array: np.asarray makes a
+        # fresh host copy per attribute, so two attributes aliasing one
+        # jax.Array would otherwise write two datasets
+        ident = value
         value = np.asarray(value)
         if value.ndim == 0:
             value = value.item()
     if isinstance(value, np.generic):
         value = value.item()
-    if isinstance(value, np.ndarray):
+    is_bf16 = isinstance(value, np.ndarray) and value.dtype == np.dtype("bfloat16")
+    if isinstance(value, np.ndarray) and (value.dtype.kind in "biuf" or is_bf16):
+        # non-numeric dtypes (datetime64, structured, object) fall
+        # through to the descriptive TypeError below: neither json
+        # inlining nor the heat dataset spill can round-trip them.
+        # bfloat16 (numpy kind 'V' via ml_dtypes) IS numeric: its dtype
+        # is recorded by NAME (its .str is a lossy '<V2') and its HDF5
+        # spill widens exactly to f32 (h5py has no bf16)
+        obj = ident if ident is not None else value
         if value.size > _NPARRAY_INLINE_MAX:
             # library-managed host state (e.g. GaussianNB theta_ on many
             # features) must not fail the save — spill it to a dataset.
-            # Dedup keys on the ORIGINAL numpy object: two attributes
-            # aliasing one array write one dataset
-            existing = ctx._by_id.get(id(value))
+            # Dedup keys on the original object: two attributes aliasing
+            # one array write one dataset
+            existing = ctx._by_id.get(id(obj))
             if existing is not None:
                 arr = ctx.datasets[existing]
                 used = existing
             else:
                 from . import factories
 
-                arr = factories.array(np.ascontiguousarray(value))
-                used = ctx.add(arr, key, ident=value)
+                host = np.ascontiguousarray(value)
+                if is_bf16:
+                    host = host.astype(np.float32)  # exact widening
+                arr = factories.array(host)
+                used = ctx.add(arr, key, ident=obj)
             return {
                 "kind": "nparray_dataset",
                 "key": used,
-                "dtype": value.dtype.str,
+                "dtype": value.dtype.name,
                 "heat_dtype": arr.dtype.__name__,
             }
         return {
             "kind": "nparray",
-            "dtype": value.dtype.str,
+            "dtype": value.dtype.name,
             "shape": list(value.shape),
+            # bf16 tolist() yields exact python floats — json-safe
             "data": value.ravel().tolist(),
         }
     if value is None or isinstance(value, (bool, int, float, str)):
@@ -138,13 +155,29 @@ def _encode(value, key: str, ctx: _SaveContext) -> Dict[str, Any]:
             }
     raise TypeError(
         f"cannot checkpoint {key!r} of type {type(value).__name__}: {value!r} "
-        "(supported: DNDarray, estimators, scalars, strings, host numpy "
-        "arrays, flat scalar lists)"
+        "(supported: DNDarray, estimators, scalars, strings, numeric "
+        "bool/int/uint/float host numpy arrays, flat scalar lists)"
     )
+
+
+def _is_heat_tpu_module(mod_name: str) -> bool:
+    """One allowlist predicate for BOTH the save-time guard (_manifest)
+    and the load-time import guard (_resolve_class), so the two can
+    never drift apart."""
+    return mod_name == "heat_tpu" or mod_name.startswith("heat_tpu.")
 
 
 def _manifest(est: BaseEstimator, prefix: str, ctx: _SaveContext):
     cls = type(est)
+    mod = cls.__module__
+    if not _is_heat_tpu_module(mod):
+        # _resolve_class refuses non-heat_tpu imports on load; failing
+        # only there would let the save "succeed" and error much later
+        # with a confusing message — reject at save time instead
+        raise TypeError(
+            f"cannot checkpoint {mod}.{cls.__qualname__}: only heat_tpu "
+            "estimator classes are re-importable at load time"
+        )
     out: Dict[str, Any] = {
         "class": f"{cls.__module__}:{cls.__qualname__}",
         "params": {},
@@ -194,7 +227,7 @@ def save_estimator(est: BaseEstimator, path: str) -> None:
 
 def _resolve_class(class_path: str):
     mod_name, _, qual = class_path.partition(":")
-    if mod_name != "heat_tpu" and not mod_name.startswith("heat_tpu."):
+    if not _is_heat_tpu_module(mod_name):
         raise ValueError(
             f"refusing to import estimator class from {mod_name!r} "
             "(only heat_tpu estimators are loadable)"

@@ -561,13 +561,34 @@ class DNDarray:
     def redistribute_(self, lshape_map=None, target_map=None) -> None:
         """Arbitrary per-rank shard sizes are not representable in XLA's
         sharding model; the canonical equal layout is maintained by the
-        compiler (reference dndarray.py:2560-2720 implements a pairwise
-        Isend/Recv shuffle).  Accepted and ignored for API parity."""
-        if target_map is not None:
-            warnings.warn(
-                "heat_tpu maintains the canonical GSPMD layout; redistribute_ is a no-op",
-                stacklevel=2,
+        compiler (reference dndarray.py:2560-2746 implements a pairwise
+        Isend/Recv shuffle).
+
+        A ``target_map`` equal to the canonical layout is accepted as the
+        no-op it is; any *other* map asks for a layout this framework
+        cannot represent, and raises rather than silently returning the
+        wrong distribution (see docs/migration.md)."""
+        if target_map is None:
+            return
+        target = np.asarray(target_map)
+        canonical = self.create_lshape_map()
+        if target.size != canonical.size:
+            raise ValueError(
+                f"target_map must have shape {canonical.shape} "
+                f"(one lshape row per shard), got {target.shape}"
             )
+        # a flat (size,) map for a 1-D array is the natural spelling of
+        # the same (size, 1) canonical table — normalize before comparing
+        target = target.reshape(canonical.shape)
+        if np.array_equal(target, canonical):
+            return  # already the layout we maintain
+        raise NotImplementedError(
+            "redistribute_: non-canonical per-rank shard sizes are not "
+            "representable in XLA's GSPMD sharding model; heat_tpu always "
+            "maintains the canonical equal-chunk layout "
+            f"({canonical.tolist()}). Requested {target.tolist()}. "
+            "See docs/migration.md for the layout contract."
+        )
 
     def resplit_(self, axis: Optional[int] = None) -> "DNDarray":
         """In-place re-shard along ``axis`` (reference dndarray.py:2801-2921:

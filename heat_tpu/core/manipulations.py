@@ -744,7 +744,11 @@ def _unique_axis_hashed(
 def topk(a: DNDarray, k: int, dim: int = -1, largest: bool = True, sorted: bool = True, out=None):
     """k largest/smallest elements and their indices
     (reference manipulations.py:3201-3345 + the custom MPI_TOPK reduction op
-    :3346-3386; here jax.lax.top_k — a native TPU sort network)."""
+    :3346-3386; here jax.lax.top_k — a native TPU sort network).
+
+    ``sorted=False`` relaxes the ordering contract; ``lax.top_k`` always
+    returns sorted results, which satisfies the relaxed contract too, so
+    both values produce sorted output."""
     sanitize_in(a)
     dim = sanitize_axis(a.shape, dim)
     if dim is None:
@@ -754,8 +758,13 @@ def topk(a: DNDarray, k: int, dim: int = -1, largest: bool = True, sorted: bool 
     if largest:
         vals, idx = lax.top_k(moved, k)
     else:
-        vals, idx = lax.top_k(-moved, k)
-        vals = -vals
+        # order-inverting key: -x for floats, ~x for ints/bool (negation
+        # wraps INT_MIN and garbles unsigned; ~x inverts exactly) — same
+        # key as parallel/sort._descending_key
+        from ..parallel.sort import _descending_key
+
+        vals, idx = lax.top_k(_descending_key(moved), k)
+        vals = _descending_key(vals)
     vals = jnp.moveaxis(vals, -1, dim)
     idx = jnp.moveaxis(idx, -1, dim)
     values = _rewrap(a, vals, a.split if a.split != dim else None, a.dtype)

@@ -295,21 +295,28 @@ def heat_type_of(obj: Any) -> type:
     raise TypeError(f"cannot determine heat type of {type(obj)}")
 
 
-def _float32_fits(arr: np.ndarray) -> builtins.bool:
-    """True when every finite value of float64 ``arr`` survives a float32
-    cast: no finite overflow to inf AND no nonzero flush to zero."""
+def _float_fits(arr: np.ndarray, ht_type: type) -> builtins.bool:
+    """True when every finite value of float64 ``arr`` survives a cast to
+    float ``ht_type``: no finite overflow to inf AND no nonzero flush to
+    zero (finfo works for float16/bfloat16/float32 alike — bfloat16's is
+    ml_dtypes-backed)."""
+    info = np.finfo(ht_type._np_type)
     finite = arr[np.isfinite(arr)]
     if not finite.size:
         return True
     mags = np.abs(finite)
-    if builtins.float(mags.max()) > builtins.float(np.finfo(np.float32).max):
+    if builtins.float(mags.max()) > builtins.float(info.max):
         return False
     nonzero = mags[mags > 0]
     if nonzero.size and builtins.float(nonzero.min()) < builtins.float(
-        np.finfo(np.float32).smallest_subnormal
+        info.smallest_subnormal
     ):
         return False
     return True
+
+
+def _float32_fits(arr: np.ndarray) -> builtins.bool:
+    return _float_fits(arr, float32)
 
 
 def _infer_list_type(obj, arr: np.ndarray) -> type:
@@ -326,53 +333,61 @@ def _infer_list_type(obj, arr: np.ndarray) -> type:
     """
     if arr.dtype not in (np.int64, np.float64):
         return canonical_heat_type(arr.dtype)  # unambiguous: numpy's probe
+    # one representative per distinct leaf (type, dtype), any nesting
+    # depth, so flat and nested infer alike (the reference's recursive
+    # scan, types.py:343-441, has the same property and the same cost).
+    # This walk is Python-speed over every leaf — several times the
+    # C-speed np.asarray pass — but it only runs for the ambiguous
+    # int64/float64 images, and Python-list ingestion is already the
+    # slow path: bulk data should arrive as numpy/jax arrays
     reps: dict = {}
-    nested = False
-    for el in obj:
-        if isinstance(el, (list, tuple)):
-            nested = True
-            break
-        # arrays of different dtypes share type(el) — key on dtype too
-        reps.setdefault((type(el), getattr(el, "dtype", None)), el)
-    if nested:
-        # n-D input: walk to the first leaf only (a full recursive scan
-        # would be O(total elements) python-speed); python-scalar leaves
-        # get the value-guarded 32-bit default
-        leaf = obj
-        while isinstance(leaf, (list, tuple)) and len(leaf):
-            leaf = leaf[0]
-        explicit = isinstance(leaf, (np.generic, np.ndarray)) or hasattr(leaf, "dtype")
-        if explicit:
-            return canonical_heat_type(arr.dtype)
-    else:
-        explicit_types = [
-            v for v in reps.values()
-            if isinstance(v, (np.generic, np.ndarray)) or hasattr(v, "dtype")
-        ]
-        if explicit_types:
-            # promote one representative per distinct type: python
-            # scalars contribute their 32-bit default, explicit numpy
-            # leaves their verbatim dtype...
-            result = None
-            for v in reps.values():
-                t = (
-                    canonical_heat_type(v.dtype)
-                    if isinstance(v, (np.generic, np.ndarray)) or hasattr(v, "dtype")
-                    else heat_type_of(v)
-                )
-                result = t if result is None else promote_types(result, t)
-            # ...then re-apply the VALUE guard over the whole list (arr
-            # covers every element): [np.int32(1), 2**40] must widen to
-            # int64, not truncate through the promoted int32
-            if issubclass(result, integer) and arr.dtype == np.int64 and arr.size:
-                info = iinfo(result)
-                lo, hi = builtins.int(arr.min()), builtins.int(arr.max())
-                if lo < info.min or hi > info.max:
-                    result = promote_types(result, int64)
-            elif result is float32 and arr.dtype == np.float64:
-                if not _float32_fits(arr):
-                    result = float64
-            return result
+    stack = [obj]
+    while stack:
+        for el in stack.pop():
+            if isinstance(el, (list, tuple)):
+                stack.append(el)
+            else:
+                # arrays of different dtypes share type(el) — key on dtype too
+                reps.setdefault((type(el), getattr(el, "dtype", None)), el)
+    explicit_types = [
+        v for v in reps.values()
+        if isinstance(v, (np.generic, np.ndarray)) or hasattr(v, "dtype")
+    ]
+    if explicit_types:
+        # promote one representative per distinct type: python
+        # scalars contribute their 32-bit default, explicit numpy
+        # leaves their verbatim dtype...
+        result = None
+        for v in reps.values():
+            t = (
+                canonical_heat_type(v.dtype)
+                if isinstance(v, (np.generic, np.ndarray)) or hasattr(v, "dtype")
+                else heat_type_of(v)
+            )
+            result = t if result is None else promote_types(result, t)
+        # ...then re-apply the VALUE guard over the whole list (arr
+        # covers every element): [np.int32(1), 2**40] must widen to
+        # int64, not truncate through the promoted int32
+        if issubclass(result, integer) and arr.dtype == np.int64 and arr.size:
+            info = iinfo(result)
+            lo, hi = builtins.int(arr.min()), builtins.int(arr.max())
+            if lo < info.min or hi > info.max:
+                result = promote_types(result, int64)
+        elif (
+            issubclass(result, floating)
+            and result is not float64
+            and arr.dtype == np.float64
+            and arr.size
+            and not _float_fits(arr, result)
+        ):
+            # generic over the narrow floats: float16/bfloat16 promotes
+            # widen minimally (next type that holds every value)
+            result = (
+                float32
+                if result is not float32 and _float_fits(arr, float32)
+                else float64
+            )
+        return result
     # pure python-scalar leaves: 32-bit default, value-range guarded
     if not arr.size:
         return int32 if arr.dtype == np.int64 else float32

@@ -257,6 +257,84 @@ def test_aliased_numpy_attrs_spill_once(tmp_path, X):
     assert nb2.theta_ is nb2.sigma_  # aliasing restored
 
 
+def test_user_subclass_rejected_at_save_time(tmp_path):
+    # a user-defined estimator subclass can never be re-imported by the
+    # heat_tpu-only loader; the failure must happen at SAVE time with a
+    # clear message, not later at load
+    class MyEstimator(ht.core.base.BaseEstimator):
+        def __init__(self, alpha=1.0):
+            self.alpha = alpha
+
+    est = MyEstimator()
+    p = str(tmp_path / "user.h5")
+    with pytest.raises(TypeError, match="re-importable"):
+        ht.save_estimator(est, p)
+    assert not os.path.exists(p)  # nothing half-written
+
+
+def test_aliased_jax_array_attrs_spill_once(tmp_path, X):
+    # two attributes referencing ONE large device array -> one dataset
+    # (dedup must key on the jax.Array's identity, not the per-attribute
+    # host copy np.asarray creates)
+    import h5py
+    import jax.numpy as jnp
+
+    labels = (RNG.random(67) > 0.5).astype(np.int32)
+    nb = ht.naive_bayes.GaussianNB()
+    nb.fit(X, ht.array(labels))
+    big = jnp.asarray(RNG.normal(size=(300, 80)).astype(np.float32))
+    nb.theta_ = big
+    nb.sigma_ = big  # alias
+    p = str(tmp_path / "jalias.h5")
+    nb.save(p)
+    with h5py.File(p, "r") as f:
+        keys = []
+        f.visit(keys.append)
+        spilled = [k for k in keys if k.startswith("fitted/") and
+                   isinstance(f[k], h5py.Dataset) and f[k].size == big.size]
+    assert len(spilled) == 1, spilled
+    nb2 = ht.load_estimator(p)
+    np.testing.assert_allclose(np.asarray(nb2.theta_), np.asarray(big), rtol=1e-6)
+
+
+def test_bfloat16_host_arrays_roundtrip(tmp_path, X):
+    # bf16 is numpy kind 'V' (ml_dtypes) but IS numeric: inline entries
+    # record the dtype by name, large ones spill via an exact f32
+    # widening — both must restore as bf16 with identical values
+    import jax.numpy as jnp
+
+    labels = (RNG.random(67) > 0.5).astype(np.int32)
+    nb = ht.naive_bayes.GaussianNB()
+    nb.fit(X, ht.array(labels))
+    small = np.asarray(jnp.asarray(RNG.normal(size=(8,)).astype(np.float32), jnp.bfloat16))
+    big = np.asarray(jnp.asarray(RNG.normal(size=(300, 80)).astype(np.float32), jnp.bfloat16))
+    nb.theta_ = small
+    nb.sigma_ = big
+    p = str(tmp_path / "bf16.h5")
+    nb.save(p)
+    nb2 = ht.load_estimator(p)
+    assert nb2.theta_.dtype == np.dtype("bfloat16")
+    assert nb2.sigma_.dtype == np.dtype("bfloat16")
+    np.testing.assert_array_equal(
+        nb2.theta_.astype(np.float32), small.astype(np.float32)
+    )
+    np.testing.assert_array_equal(
+        nb2.sigma_.astype(np.float32), big.astype(np.float32)
+    )
+
+
+def test_non_numeric_host_array_rejected_descriptively(tmp_path, X):
+    # datetime64 (and any non-bool/int/uint/float dtype) cannot round-trip
+    # through either the json inline path or the dataset spill; the save
+    # must raise the module's descriptive TypeError, not a raw json error
+    labels = (RNG.random(67) > 0.5).astype(np.int32)
+    nb = ht.naive_bayes.GaussianNB()
+    nb.fit(X, ht.array(labels))
+    nb.theta_ = np.array(["2026-01-01", "2026-01-02"], dtype="datetime64[D]")
+    with pytest.raises(TypeError, match="cannot checkpoint"):
+        nb.save(str(tmp_path / "dt.h5"))
+
+
 def test_typosquat_module_rejected():
     # heat_tpu_evil must NOT pass the heat_tpu-only import guard
     from heat_tpu.core.checkpoint import _resolve_class

@@ -121,16 +121,22 @@ def test_balance_after_ragged_getitem():
 
 def test_redistribute_contract():
     # design decision (vs reference dndarray.py:2560): heat_tpu keeps the
-    # canonical equal-block GSPMD layout, so redistribute_ warns and keeps
-    # the value/metadata intact instead of moving shards around
+    # canonical equal-block GSPMD layout. A target_map equal to that
+    # layout is the no-op it asks for; any other map raises instead of
+    # silently returning the wrong distribution.
     X = ht.array(np.arange(16, dtype=np.float32), split=0)
-    nshards = int(X.lshape_map.shape[0])
-    target = np.zeros(nshards, dtype=int)
-    target[0] = 16              # everything to shard 0
-    with pytest.warns(UserWarning):
-        X.redistribute_(target_map=target)
+    X.redistribute_(target_map=X.create_lshape_map())  # canonical: accepted
     assert X.split == 0
     assert_array_equal(X, np.arange(16, dtype=np.float32))
+    nshards = int(X.lshape_map.shape[0])
+    # a flat (size,) spelling of the canonical 1-D map is the same no-op
+    X.redistribute_(target_map=X.create_lshape_map().ravel())
+    target = np.zeros((nshards, 1), dtype=int)
+    target[0] = 16              # everything to shard 0: unrepresentable
+    with pytest.raises(NotImplementedError, match="canonical"):
+        X.redistribute_(target_map=target)
+    with pytest.raises(ValueError, match="shape"):
+        X.redistribute_(target_map=np.zeros((nshards + 1, 1), dtype=int))
     X.balance_()
     assert X.is_balanced()
     assert_array_equal(X, np.arange(16, dtype=np.float32))

@@ -172,6 +172,33 @@ def test_topk_matrix(split, largest, dim):
     )
 
 
+@pytest.mark.parametrize("split", [None, 0])
+@pytest.mark.parametrize(
+    "npdtype,vals",
+    [
+        # INT_MIN must survive largest=False (negation would wrap it to
+        # itself and rank it LARGEST; the ~x key ranks it smallest)
+        (np.int32, [5, np.iinfo(np.int32).min, -1, np.iinfo(np.int32).max, 0, 7, -3, 2]),
+        (np.int64, [np.iinfo(np.int64).min, 0, np.iinfo(np.int64).max, -2, 9, 1, -7, 4]),
+        # unsigned: negation garbles the order entirely; ~x inverts exactly
+        (np.uint8, [0, 255, 128, 1, 254, 127, 3, 200]),
+    ],
+)
+def test_topk_smallest_integer_extremes(split, npdtype, vals):
+    data = np.asarray(vals, dtype=npdtype)
+    x = ht.array(data, split=split)
+    v, i = ht.topk(x, 3, largest=False)
+    expect = np.sort(data)[:3]
+    np.testing.assert_array_equal(np.asarray(v.larray), expect)
+    np.testing.assert_array_equal(data[np.asarray(i.larray)], expect)
+    # largest=True sanity on the same extremes
+    v2, _ = ht.topk(x, 3, largest=True)
+    np.testing.assert_array_equal(np.asarray(v2.larray), np.sort(data)[::-1][:3])
+    # sorted=False relaxes the contract; output may still be sorted
+    v3, _ = ht.topk(x, 3, largest=False, sorted=False)
+    np.testing.assert_array_equal(np.sort(np.asarray(v3.larray)), expect)
+
+
 def test_split_error_paths(data):
     x = ht.array(data, split=0)
     with pytest.raises(ValueError):

@@ -94,6 +94,36 @@ def test_heat_type_of_mixed_element_lists_promote():
     ) is ht.float64
 
 
+def test_nested_lists_infer_like_flat():
+    # the leaf-representative walk recurses: nesting a mixed list one
+    # level deeper must not change the inferred type (the reference's
+    # recursive scan, types.py:343-441, treats both alike)
+    assert T.heat_type_of([[np.float32(1.0), 2.5]]) is ht.float32
+    assert T.heat_type_of([[1, 2], [np.int64(2), 3]]) is ht.int64
+    assert T.heat_type_of([[np.int16(1), 2], [3, 4]]) is ht.int32
+    assert T.heat_type_of([[2.0], [np.float64(3.0)]]) is ht.float64
+    # value guard still applies through nesting
+    assert T.heat_type_of([[np.int32(1)], [2**40]]) is ht.int64
+    assert T.heat_type_of([[np.float32(1.0)], [1e300]]) is ht.float64
+
+
+def test_float16_value_guard_widens_minimally():
+    # the float value guard is generic over the narrow floats: a value
+    # past float16's max (65504) widens to float32 when it fits there,
+    # and all the way to float64 only when it must
+    assert T.heat_type_of([np.float16(1.0), 100000]) is ht.float32
+    assert float(ht.array([np.float16(1.0), 100000.0]).numpy()[1]) == 100000.0
+    assert T.heat_type_of([np.float16(1.0), 1e300]) is ht.float64
+    assert T.heat_type_of([[np.float16(1.0)], [100000]]) is ht.float32
+    # in-range all-explicit values keep the narrow dtype (a python float
+    # leaf contributes its float32 default, same as the int16+int case)
+    assert T.heat_type_of([np.float16(1.0), np.float16(2.5)]) is ht.float16
+    assert T.heat_type_of([np.float16(1.0), 2.5]) is ht.float32
+    # and the factory agrees with the query on nested input
+    for obj in ([[np.float32(1.0), 2.5]], [[1, 2], [np.int64(2), 3]]):
+        assert ht.array(obj).dtype is T.heat_type_of(obj), obj
+
+
 def test_mixed_list_value_guard_still_widens():
     # the value guard survives the mixed promote: an np.int32 leaf plus a
     # wide python int must widen, not truncate through the promoted int32
