@@ -17,6 +17,9 @@ axis re-split Alltoall         :func:`all_to_all_resplit`
 —                              :func:`ring_attention` — blockwise ring
                                attention built on the same ppermute ring,
                                the long-context flagship
+—                              :func:`flash_attention` — the fused
+                               Pallas single-chip/local kernel (never
+                               materializes the S×S score tensor)
 =============================  ==========================================
 
 All primitives are ``shard_map`` programs over the communicator's 1-D mesh
@@ -24,6 +27,7 @@ with :func:`jax.lax.ppermute` / sharding-transformations doing the
 communication over ICI.
 """
 
+from .flash_attention import flash_attention
 from .primitives import (
     all_to_all_resplit,
     halo_exchange,
@@ -39,6 +43,7 @@ from .ulysses import ulysses_attention
 
 __all__ = [
     "all_to_all_resplit",
+    "flash_attention",
     "halo_exchange",
     "prefix_scan",
     "prefix_sum",

@@ -31,8 +31,21 @@ __all__ = ["ring_attention", "ring_self_attention"]
 
 
 def _blockwise_update(q, k, v, m, num, den, scale, mask=None):
-    """One streaming-softmax accumulation step (flash-attention algebra)."""
-    scores = jnp.einsum("...qd,...kd->...qk", q, k) * scale
+    """One streaming-softmax accumulation step (flash-attention algebra).
+
+    Scores and accumulators stay in the accumulator dtype (``num.dtype``,
+    f32 for f32/bf16 inputs): the einsums pin it via
+    ``preferred_element_type`` so neither a bf16 input nor a wide scalar
+    can move the softmax off f32 — under x64 an unpinned
+    ``np.float64`` scale silently promoted the whole S×S score tensor to
+    software-emulated f64 (measured 0.3 TFLOP/s vs MXU-native f32)."""
+    from .flash_attention import _matmul_precision
+
+    acc = num.dtype
+    prec = _matmul_precision(q.dtype)
+    scores = jnp.einsum(
+        "...qd,...kd->...qk", q, k, preferred_element_type=acc, precision=prec
+    ) * scale
     if mask is not None:
         scores = jnp.where(mask, scores, -jnp.inf)
     m_blk = jnp.max(scores, axis=-1)
@@ -43,7 +56,9 @@ def _blockwise_update(q, k, v, m, num, den, scale, mask=None):
     if mask is not None:
         p = jnp.where(mask, p, 0.0)
     correction = jnp.where(jnp.isfinite(m), jnp.exp(m - safe_m), 0.0)
-    num = num * correction[..., None] + jnp.einsum("...qk,...kd->...qd", p, v)
+    num = num * correction[..., None] + jnp.einsum(
+        "...qk,...kd->...qd", p, v, preferred_element_type=acc, precision=prec
+    )
     den = den * correction + jnp.sum(p, axis=-1)
     return m_new, num, den
 
@@ -73,20 +88,20 @@ def ring_attention(
     if not batched:
         q, k, v = q[None], k[None], v[None]  # (1, S, H, D)
     B, S, H, D = q.shape
-    scale = 1.0 / np.sqrt(D)
+    # accumulator dtype: f32 for f32/bf16 inputs (flash convention).  The
+    # scale is CAST rather than left as np.sqrt's np.float64 scalar —
+    # under x64 that scalar is strong-typed and promoted every score
+    # tensor to f64, which the TPU emulates in software
+    acc_dt = jnp.promote_types(q.dtype, jnp.float32)
+    scale = jnp.asarray(1.0 / np.sqrt(D), acc_dt)
 
     if size == 1 or S % size != 0:
-        # single block: plain exact attention (also the non-divisible
-        # fallback — XLA still shards the matmuls)
-        qt = jnp.moveaxis(q, 2, 1)  # (B, H, S, D)
-        kt = jnp.moveaxis(k, 2, 1)
-        vt = jnp.moveaxis(v, 2, 1)
-        scores = jnp.einsum("bhqd,bhkd->bhqk", qt, kt) * scale
-        if causal:
-            mask = jnp.tril(jnp.ones((S, S), bool))
-            scores = jnp.where(mask, scores, -jnp.inf)
-        out = jnp.einsum("bhqk,bhkd->bhqd", jax.nn.softmax(scores, axis=-1), vt)
-        out = jnp.moveaxis(out, 1, 2)
+        # single block: the fused Pallas kernel (flash_attention decides
+        # itself when to fall back to the XLA-fused plain path — off-TPU,
+        # non-conforming shapes, or K/V too large for VMEM residency)
+        from .flash_attention import flash_attention
+
+        out = flash_attention(q, k, v, causal=causal)
         return out if batched else out[0]
 
     mesh, name = comm.mesh, comm.axis_name
@@ -99,9 +114,12 @@ def ring_attention(
         my = jax.lax.axis_index(name)
         q_pos = my * L + jnp.arange(L)
 
-        m0 = jax.lax.pcast(jnp.full((B, H, L), -jnp.inf), (name,), to="varying")
-        num0 = jax.lax.pcast(jnp.zeros((B, H, L, D)), (name,), to="varying")
-        den0 = jax.lax.pcast(jnp.zeros((B, H, L)), (name,), to="varying")
+        # accumulators explicitly acc_dt: under x64, default-dtype
+        # zeros/full are f64 and would drag the whole streaming softmax
+        # into emulated double precision
+        m0 = jax.lax.pcast(jnp.full((B, H, L), -jnp.inf, acc_dt), (name,), to="varying")
+        num0 = jax.lax.pcast(jnp.zeros((B, H, L, D), acc_dt), (name,), to="varying")
+        den0 = jax.lax.pcast(jnp.zeros((B, H, L), acc_dt), (name,), to="varying")
 
         def body(r, carry):
             kb, vb, m, num, den = carry
@@ -120,7 +138,7 @@ def ring_attention(
 
         _, _, m, num, den = jax.lax.fori_loop(0, size, body, (k_blk, v_blk, m0, num0, den0))
         out = num / jnp.maximum(den, 1e-30)[..., None]  # (B, H, L, D)
-        return jnp.moveaxis(out, 1, 2)  # (B, L, H, D)
+        return jnp.moveaxis(out, 1, 2).astype(q_blk.dtype)  # (B, L, H, D)
 
     spec = PartitionSpec(None, name, None, None)
     out = jax.jit(

@@ -30,15 +30,12 @@ __all__ = ["ulysses_attention"]
 
 
 def _attention(q, k, v, causal: bool):
-    """Plain exact attention on (B, S, H, D) with full sequence visible."""
-    scale = 1.0 / np.sqrt(q.shape[-1])
-    qt, kt, vt = (jnp.moveaxis(t, 2, 1) for t in (q, k, v))  # (B, H, S, D)
-    scores = jnp.einsum("bhqd,bhkd->bhqk", qt, kt) * scale
-    if causal:
-        S = q.shape[1]
-        scores = jnp.where(jnp.tril(jnp.ones((S, S), bool)), scores, -jnp.inf)
-    out = jnp.einsum("bhqk,bhkd->bhqd", jax.nn.softmax(scores, axis=-1), vt)
-    return jnp.moveaxis(out, 1, 2)  # (B, S, H, D)
+    """Plain exact attention on (B, S, H, D) with full sequence visible —
+    one shared implementation (flash_attention's XLA path) carrying the
+    f32-accumulator and matmul-precision conventions."""
+    from .flash_attention import _jnp_fallback
+
+    return _jnp_fallback(q, k, v, causal)
 
 
 def ulysses_attention(
