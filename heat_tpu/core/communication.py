@@ -324,6 +324,19 @@ class XlaCommunication(Communication):
         sh = self.sharding(array.ndim, split)
         if split is None or array.shape[split] % self.size == 0:
             return _reshard(array, sh)
+        if os.environ.get("HEAT_DEBUG_RAGGED_COMMIT") == "1":
+            # the memory-hazard tripwire: THIS branch (and only this
+            # branch) commits replicated — _constrained_copy is also the
+            # multi-process reshard path for perfectly divisible arrays,
+            # so the warning lives at the ragged call site
+            warnings.warn(
+                f"ragged-axis commit replicates: axis {split} of shape "
+                f"{tuple(array.shape)} does not divide over {self.size} "
+                "devices, so every device stores a full copy (use a "
+                "divisible split axis, pre-pad with pad_to_shards, or keep "
+                "the array inside one jit region)",
+                stacklevel=3,
+            )
         return _constrained_copy(array, sh)
 
     # ------------------------------------------------------------------ #
@@ -625,7 +638,9 @@ def _constrained_copy(array: jax.Array, sh: NamedSharding) -> jax.Array:
     Pipelines built for scale must therefore pre-pad with
     :meth:`XlaCommunication.pad_to_shards` — the padded array is
     divisible and commits genuinely sharded (the ring sort, TSQR, and
-    prefix scan all do)."""
+    prefix scan all do).  ``HEAT_DEBUG_RAGGED_COMMIT=1`` warns at the
+    ragged ``apply_sharding`` call site (not here: this helper is also
+    the multi-process reshard path for divisible arrays)."""
 
     def _f(x):
         return jax.lax.with_sharding_constraint(x, sh)

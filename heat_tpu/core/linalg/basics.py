@@ -2,12 +2,17 @@
 
 Reference: heat/core/linalg/basics.py:16-1269.  The centerpiece there is a
 780-line hand-written block-distributed SUMMA ``matmul`` covering all four
-split combinations with Isend/Irecv block exchanges (:285-787).  On TPU the
-same computation is ``jnp.matmul`` on sharded global arrays: GSPMD's SPMD
-partitioner emits the SUMMA-equivalent collective schedule (all-gather or
-reduce-scatter per block) tuned for the MXU and ICI topology — beating a
-hand-rolled schedule is exactly what the compiler is for.  What this module
-keeps from the reference is the *semantics*: dtype promotion, the
+split combinations with Isend/Irecv block exchanges (:285-787), whose point
+is an O(n²/p) per-rank memory guarantee.  GSPMD does NOT honor that
+guarantee: measured on an 8-device mesh, its plan for splits 00/01/11
+all-gathers one full operand per device (f32[1024,1024] at m=k=n=1024) —
+fine at laptop scale, an OOM at pod scale.  So 2-D matmuls on those combos
+run an explicit ring SUMMA (``_summa``: shard_map + ppermute, p rounds,
+one visiting shard at a time — the reference's schedule re-expressed as an
+ICI ring program), pinned by HLO assertions in tests/test_hlo_matmul.py.
+Split 10 and everything else (vectors, batched) keep the compiler plan:
+there GSPMD's single result all-reduce IS the right schedule.  The module
+keeps the reference's *semantics* throughout: dtype promotion, the
 vector/matrix edge cases, and the result-split rules for every split
 combination (basics.py:168-283).
 """
@@ -86,12 +91,153 @@ def _result_split_matmul(a: DNDarray, b: DNDarray, out_ndim: int) -> Optional[in
     return None
 
 
+def _summa_fn(sa: int, sb: int, comm, precision, chunk: int):
+    """The jitted shard_map ring-matmul program for one split combo —
+    cached per (combo, comm, precision, chunk), and exposed so the HLO
+    tests lower the EXACT production program (tests/test_hlo_matmul.py).
+    ``chunk`` is the rotating operand's shard width along its split axis;
+    the padded global widths are ``chunk * comm.size``."""
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    key = (sa, sb, comm, precision, chunk)
+    cached = _SUMMA_CACHE.get(key)
+    if cached is not None:
+        return cached
+
+    p, mesh, axis = comm.size, comm.mesh, comm.axis_name
+    perm = [(i, (i + 1) % p) for i in range(p)]
+
+    if (sa, sb) == (0, 0):
+        # A (Mp/p, Kp) stationary; B's k-shards (Kp/p, N) rotate — chunk
+        # r of A's columns multiplies the shard that originated at r
+        def kern(a_loc, b_blk):
+            my = jax.lax.axis_index(axis)
+
+            def body(r, carry):
+                b_blk, acc = carry
+                origin = (my - r) % p
+                a_chunk = jax.lax.dynamic_slice_in_dim(
+                    a_loc, origin * chunk, chunk, 1
+                )
+                acc = acc + jnp.matmul(a_chunk, b_blk, precision=precision)
+                return jax.lax.ppermute(b_blk, axis, perm), acc
+
+            acc0 = jax.lax.pcast(
+                jnp.zeros((a_loc.shape[0], b_blk.shape[1]), a_loc.dtype),
+                (axis,), to="varying",
+            )
+            _, acc = jax.lax.fori_loop(0, p, body, (b_blk, acc0))
+            return acc
+
+        ins, outs = (P(axis, None), P(axis, None)), P(axis, None)
+    elif (sa, sb) == (0, 1):
+        # A (Mp/p, K) stationary; B's column shards (K, Np/p) rotate,
+        # each landing in its own slice of the (Mp/p, Np) result columns
+        def kern(a_loc, b_blk):
+            my = jax.lax.axis_index(axis)
+
+            def body(r, carry):
+                b_blk, acc = carry
+                origin = (my - r) % p
+                prod = jnp.matmul(a_loc, b_blk, precision=precision)
+                col = origin * chunk  # axis_index dtype; zero must match
+                acc = jax.lax.dynamic_update_slice(
+                    acc, prod, (jnp.zeros((), col.dtype), col)
+                )
+                return jax.lax.ppermute(b_blk, axis, perm), acc
+
+            acc0 = jax.lax.pcast(
+                jnp.zeros((a_loc.shape[0], chunk * p), a_loc.dtype),
+                (axis,), to="varying",
+            )
+            _, acc = jax.lax.fori_loop(0, p, body, (b_blk, acc0))
+            return acc
+
+        ins, outs = (P(axis, None), P(None, axis)), P(axis, None)
+    else:
+        # (1, 1): B (Kp, Np/p) stationary; A's k-shards (M, Kp/p) rotate,
+        # each contracting against its slice of B's rows
+        def kern(a_blk, b_loc):
+            my = jax.lax.axis_index(axis)
+
+            def body(r, carry):
+                a_blk, acc = carry
+                origin = (my - r) % p
+                b_chunk = jax.lax.dynamic_slice_in_dim(
+                    b_loc, origin * chunk, chunk, 0
+                )
+                acc = acc + jnp.matmul(a_blk, b_chunk, precision=precision)
+                return jax.lax.ppermute(a_blk, axis, perm), acc
+
+            acc0 = jax.lax.pcast(
+                jnp.zeros((a_blk.shape[0], b_loc.shape[1]), a_blk.dtype),
+                (axis,), to="varying",
+            )
+            _, acc = jax.lax.fori_loop(0, p, body, (a_blk, acc0))
+            return acc
+
+        ins, outs = (P(None, axis), P(None, axis)), P(None, axis)
+
+    fn = jax.jit(jax.shard_map(kern, mesh=mesh, in_specs=ins, out_specs=outs))
+    _SUMMA_CACHE[key] = fn
+    return fn
+
+
+#: (sa, sb, comm, precision, chunk) -> jitted program; comm objects are
+#: long-lived singletons, so this never grows past a handful of entries
+_SUMMA_CACHE: dict = {}
+
+
+def _summa(aa, ba, sa: int, sb: int, comm, precision):
+    """Ring (SUMMA-style) matmul for the split combinations where GSPMD
+    chooses to ALL-GATHER a full operand — split 00, 01 and 11 (verified
+    in HLO: a `f32[m,k]`/`f32[k,n]` all-gather per device, i.e. O(n²)
+    per-device memory; the reference's hand-written SUMMA,
+    basics.py:285-787, guarantees O(n²/p)).
+
+    One operand stays stationary; the other's shards rotate around the
+    mesh ring with ``ppermute`` (p rounds), each round contributing one
+    block product.  Per-device memory: own shards + one visiting shard +
+    the local result block — the reference's guarantee, on ICI.
+
+    ``aa``/``ba`` are the PADDED buffers (split axes at canonical width);
+    non-split contraction axes are zero-padded here when ragged, and the
+    pad region always multiplies those zeros, so the at-rest buffers'
+    unspecified pad values never reach the result.  Returns the padded
+    sharded result and its split.
+    """
+    p = comm.size
+    if (sa, sb) == (0, 0):
+        Kp = comm.padded_size(aa.shape[1])
+        if Kp != aa.shape[1]:
+            aa = jnp.pad(aa, ((0, 0), (0, Kp - aa.shape[1])))
+            aa = comm.apply_sharding(aa, 0)
+        chunk = Kp // p
+        out_split = 0
+    elif (sa, sb) == (0, 1):
+        chunk = ba.shape[1] // p  # ba padded on its split axis already
+        out_split = 0
+    else:  # (1, 1)
+        Kp = aa.shape[1]
+        if ba.shape[0] != Kp:
+            ba = jnp.pad(ba, ((0, Kp - ba.shape[0]), (0, 0)))
+            ba = comm.apply_sharding(ba, 1)
+        chunk = Kp // p
+        out_split = 1
+    out = _summa_fn(sa, sb, comm, precision, chunk)(aa, ba)
+    return out, out_split
+
+
 def matmul(a: DNDarray, b: DNDarray, allow_resplit: bool = False) -> DNDarray:
     """Matrix product of two DNDarrays (reference basics.py:71-787).
 
-    All four split combinations are supported; the compiler plans the block
-    exchanges that basics.py:420-745 performs manually.  Vector operands
-    follow numpy semantics (reference fast paths :168-283).
+    All four split combinations are supported.  For 2-D operands with
+    splits 00/01/11 a ring SUMMA (shard_map + ppermute) keeps per-device
+    memory at O(1/p) — GSPMD's plan for those combos all-gathers a full
+    operand (see _summa).  Split 10 contracts the shared axis: GSPMD's
+    single result all-reduce IS the right schedule there, and the other
+    cases (vectors, batched) keep the compiler plan too.
     """
     sanitize_in(a)
     sanitize_in(b)
@@ -118,11 +264,33 @@ def matmul(a: DNDarray, b: DNDarray, allow_resplit: bool = False) -> DNDarray:
                     f"{a.shape} @ {b.shape} ({da} vs {db})"
                 )
     promoted = types.promote_types(a.dtype, b.dtype)
+    comm = a.comm
+    if (
+        a.ndim == 2
+        and b.ndim == 2
+        and comm.size > 1
+        and (a.split, b.split) in ((0, 0), (0, 1), (1, 1))
+    ):
+        # ring SUMMA: O(1/p) per-device memory where GSPMD would
+        # all-gather a full operand (tests/test_hlo_matmul.py pins this)
+        # the operand whose SPLIT axis is the contraction axis ships the
+        # ZEROED buffer: at-rest pad values are unspecified and can be
+        # non-finite (ht.log leaves -inf pad rows), and 0 * inf = NaN
+        # would poison every real output element through the k-sum
+        zero_a = (a.split, b.split) == (1, 1)  # a's axis 1 == k
+        zero_b = (a.split, b.split) == (0, 0)  # b's axis 0 == k
+        aa = (a._zeroed_buffer() if zero_a else a._buffer).astype(promoted.jax_type())
+        ba = (b._zeroed_buffer() if zero_b else b._buffer).astype(promoted.jax_type())
+        out, split = _summa(aa, ba, a.split, b.split, comm, _precision())
+        if (a.split, b.split) == (0, 1):
+            out = out[:, : b.shape[1]]  # drop B's column padding
+        return DNDarray(
+            out, (a.shape[0], b.shape[1]), promoted, split, a.device, comm, True
+        )
     aa = a.larray.astype(promoted.jax_type())
     ba = b.larray.astype(promoted.jax_type())
     garr = jnp.matmul(aa, ba, precision=_precision())
     split = _result_split_matmul(a, b, garr.ndim)
-    comm = a.comm
     garr = comm.apply_sharding(garr, split)
     return DNDarray(
         garr, tuple(garr.shape), promoted, split, a.device, comm, True

@@ -47,6 +47,11 @@ from .qr import qr as _qr
 
 __all__ = ["svd"]
 
+#: element cap for the silent wide-shard pre-resplit below — 1M elements
+#: (4 MB f32) replicates harmlessly; anything larger keeps qr's gather
+#: warning as the memory signal
+_SMALL_RESPLIT_MAX = 1 << 20
+
 SVD = collections.namedtuple("SVD", "U, S, V")
 
 
@@ -107,6 +112,23 @@ def svd(a: DNDarray, full_matrices: bool = False, compute_uv: bool = True):
         res = svd(a.T, compute_uv=True)
         return SVD(res.V, res.S, res.U)
 
+    osplit = a.split
+    if (
+        a.split == 0
+        and comm.size > 1
+        and comm.shard_width(m) < n
+        and m * n <= _SMALL_RESPLIT_MAX
+    ):
+        # small-intermediate rule (ML callers: spectral embeddings, tiny
+        # covariance factors): shards would be wider than tall, so TSQR
+        # would gather behind a warning per fit.  Make the layout call
+        # HERE, once and silently — but ONLY for genuinely small matrices
+        # (the element cap): replication is the plan either way, and a
+        # LARGE wide-shard matrix must keep qr's gather warning as the
+        # memory signal.  U is re-sharded to the caller's split below, so
+        # the public contract is unchanged
+        a = a.resplit(None)
+
     if not compute_uv:
         _, r = _qr(a if a.dtype is dtype else a.astype(dtype))
         s_arr = _small_singvals(r.larray).astype(dtype.jax_type())
@@ -117,8 +139,9 @@ def svd(a: DNDarray, full_matrices: bool = False, compute_uv: bool = True):
     from .basics import _precision
 
     u = jnp.matmul(q.larray, ur.astype(dtype.jax_type()), precision=_precision())
-    u = comm.apply_sharding(u, a.split if a.split == 0 else None)
-    U = DNDarray(u, (m, n), dtype, a.split if a.split == 0 else None, device, comm, True)
+    u_split = osplit if osplit == 0 else None  # caller's layout, even after
+    u = comm.apply_sharding(u, u_split)        # the small-matrix resplit
+    U = DNDarray(u, (m, n), dtype, u_split, device, comm, True)
     s_arr = s.astype(dtype.jax_type())
     S = DNDarray(s_arr, (n,), dtype, None, device, comm, True)
     v = jnp.transpose(vt).astype(dtype.jax_type())

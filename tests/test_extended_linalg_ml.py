@@ -32,8 +32,11 @@ def test_matmul_result_dtype_promotion():
     assert ht.matmul(A, C).dtype == ht.int64
 
 
+@pytest.mark.filterwarnings("ignore:qr.*fewer rows:UserWarning")
 @pytest.mark.parametrize("split", [None, 0, 1])
 def test_qr_reconstruction_and_orthogonality(split):
+    # small split-0 shapes deliberately exercise the wide-shard gather
+    # fallback; the warning contract is pinned in test_linalg.py
     for shape in [(30, 10), (16, 16), (13, 7)]:
         A = RNG.normal(size=shape).astype(np.float32)
         q, r = ht.linalg.qr(ht.array(A, split=split))

@@ -225,3 +225,24 @@ def test_ragged_resplit_values_exact():
     np.testing.assert_array_equal(X.resplit(None).numpy(), a)
     eye = ht.array(np.eye(k, dtype=np.float32))
     np.testing.assert_array_equal((X.resplit(1) @ eye).numpy(), a)
+
+
+def test_ragged_commit_debug_flag(monkeypatch):
+    # HEAT_DEBUG_RAGGED_COMMIT=1 surfaces every replicated commit — the
+    # memory hazard of touching .larray of a ragged split array at a
+    # program boundary; silent by default (the sanctioned paths never land
+    # in _constrained_copy at all)
+    comm = _comm()
+    if comm.size == 1:
+        pytest.skip("needs a mesh")
+    m, k = _ragged_rows()
+    arr = jnp.ones((m, k), jnp.float32)
+    monkeypatch.setenv("HEAT_DEBUG_RAGGED_COMMIT", "1")
+    with pytest.warns(UserWarning, match="replicates"):
+        comm.apply_sharding(arr, 0)
+    monkeypatch.delenv("HEAT_DEBUG_RAGGED_COMMIT")
+    import warnings as _w
+
+    with _w.catch_warnings():
+        _w.simplefilter("error")
+        comm.apply_sharding(arr, 0)  # default: silent

@@ -89,8 +89,11 @@ def test_tril_triu(split):
     assert_array_equal(ht.tril(x, k=-1), np.tril(data, -1))
 
 
+@pytest.mark.filterwarnings("ignore:qr.*fewer rows:UserWarning")
 @pytest.mark.parametrize("split", [None, 0, 1])
 def test_qr(split):
+    # 32x8 over an 8-device mesh deliberately exercises the wide-shard
+    # gather fallback; its warning contract has its own test below
     rng = np.random.default_rng(2)
     a = rng.normal(size=(32, 8)).astype(np.float32)
     x = ht.array(a, split=split)
@@ -129,6 +132,38 @@ def test_svd_wide():
     a = rng.normal(size=(6, 30)).astype(np.float32)
     u, s, v = ht.linalg.svd(ht.array(a, split=1))
     np.testing.assert_allclose(u.numpy() @ np.diag(s.numpy()) @ v.numpy().T, a, atol=1e-4)
+
+
+def test_svd_small_split_resplits_silently():
+    # the small-intermediate rule (VERDICT r4 #8): svd of a matrix whose
+    # shards would be wider than tall pre-resplits instead of tripping
+    # qr's gather warning, and still honors the caller's U layout
+    comm = ht.get_comm()
+    if comm.size == 1:
+        pytest.skip("needs a mesh")
+    rng = np.random.default_rng(9)
+    a = rng.normal(size=(30, 30)).astype(np.float32)
+    x = ht.array(a, split=0)
+    import warnings as _w
+
+    with _w.catch_warnings():
+        _w.simplefilter("error")  # any warning fails the test
+        u, s, v = ht.linalg.svd(x)
+    assert u.split == 0  # caller's layout survives the internal resplit
+    np.testing.assert_allclose(
+        u.numpy() @ np.diag(s.numpy()) @ v.numpy().T, a, atol=1e-3
+    )
+
+
+def test_qr_wide_shards_warns_for_direct_callers():
+    # the warning stays meaningful when a USER hands qr the bad layout
+    comm = ht.get_comm()
+    if comm.size == 1:
+        pytest.skip("needs a mesh")
+    rng = np.random.default_rng(10)
+    x = ht.array(rng.normal(size=(30, 30)).astype(np.float32), split=0)
+    with pytest.warns(UserWarning, match="fewer rows"):
+        ht.linalg.qr(x)
 
 
 def test_cg():
@@ -174,6 +209,7 @@ def test_cg_dtype_promotion_and_nan():
     assert np.isnan(sol_nan.numpy()).any()
 
 
+@pytest.mark.filterwarnings("ignore:qr.*fewer rows:UserWarning")
 @pytest.mark.parametrize("shape", [(21, 7), (7, 21), (14, 14), (40, 3)])
 @pytest.mark.parametrize("split", [None, 0, 1])
 def test_qr_sweep(shape, split):
