@@ -54,6 +54,11 @@ def main():
             max_iter=args.iterations, tol=-1.0, random_state=1,
         )
         km.fit(data)
+        # fit is fully async (device scalars stay lazy): without this
+        # readback fence the 1-device timing measures DISPATCH ONLY
+        # (~150 us) and fabricates a 30x "scaling cliff" vs meshes whose
+        # label resharding happens to synchronize (r4 scaling record)
+        np.asarray(km.cluster_centers_.larray)
         times.append(time.perf_counter() - t0)
     best = min(times)
     print(f"kmeans: n={data.shape[0]} f={data.shape[1]} k={args.clusters} "
