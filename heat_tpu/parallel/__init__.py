@@ -27,7 +27,7 @@ with :func:`jax.lax.ppermute` / sharding-transformations doing the
 communication over ICI.
 """
 
-from .flash_attention import flash_attention
+from .flash_attention import flash_attention, flash_attention_partial
 from .primitives import (
     all_to_all_resplit,
     halo_exchange,
@@ -44,6 +44,7 @@ from .ulysses import ulysses_attention
 __all__ = [
     "all_to_all_resplit",
     "flash_attention",
+    "flash_attention_partial",
     "halo_exchange",
     "prefix_scan",
     "prefix_sum",

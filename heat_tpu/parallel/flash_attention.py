@@ -40,29 +40,23 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-__all__ = ["flash_attention"]
+__all__ = ["flash_attention", "flash_attention_partial"]
 
 #: per-kernel VMEM budget (bytes) the compiler may use; the guard below
 #: keeps K/V residency + score tiles + double buffering under it
 _VMEM_LIMIT = 100 * 1024 * 1024
 
 
-def _kernel(q_ref, k_ref, v_ref, o_ref, *, scale, causal, q_base, block_k):
-    """One q block: stream the VMEM-resident K/V through the running
-    softmax in ``block_k`` chunks, (m, l, acc) carried in registers."""
-    qi = pl.program_id(1)
-    bq, d = q_ref.shape[1], q_ref.shape[2]
+def _stream_kv(q, k_ref, v_ref, m0, l0, acc0, *, scale, causal, prec,
+               q_lo, k_lo, block_k):
+    """Shared streaming-softmax core: fold every ``block_k`` chunk of the
+    VMEM-resident K/V into the running (m, l, acc), carried in registers.
+    ``q_lo``/``k_lo`` are the GLOBAL positions of q row 0 / k row 0 (i32
+    scalars — traced in the partial form, where ring round offsets are
+    runtime values)."""
+    bq = q.shape[0]
     nk = k_ref.shape[1] // block_k
-    # np.sqrt hands back a STRONG np.float64 scalar; unpinned it drags
-    # every accumulator to f64 under x64 (see ring_attention)
-    scale = jnp.float32(scale)
-    # framework convention: see _matmul_precision — this backend's
-    # DEFAULT is the bf16 MXU path (fine for bf16 inputs, a 1e-1-scale
-    # score error for f32 ones).  bf16 operands feed the MXU untouched;
-    # softmax/accumulation are f32.
-    prec = _matmul_precision(q_ref.dtype)
-    q = q_ref[0]  # (BQ, D), input dtype
-    last_q = q_base + (qi + 1) * bq - 1
+    last_q = q_lo + bq - 1
 
     def body(j, carry):
         start = j * block_k
@@ -76,10 +70,10 @@ def _kernel(q_ref, k_ref, v_ref, o_ref, *, scale, causal, q_base, block_k):
                 preferred_element_type=jnp.float32, precision=prec,
             ) * scale  # (BQ, BK) f32
             if causal:
-                q_pos = q_base + qi * bq + jax.lax.broadcasted_iota(
+                q_pos = q_lo + jax.lax.broadcasted_iota(
                     jnp.int32, (bq, block_k), 0
                 )
-                k_pos = start + jax.lax.broadcasted_iota(
+                k_pos = k_lo + start + jax.lax.broadcasted_iota(
                     jnp.int32, (bq, block_k), 1
                 )
                 keep = q_pos >= k_pos
@@ -103,14 +97,62 @@ def _kernel(q_ref, k_ref, v_ref, o_ref, *, scale, causal, q_base, block_k):
             # chunks wholly past this q block's diagonal contribute
             # nothing (the cond is select-both on Mosaic — see module
             # docstring — but costs nothing to keep)
-            return jax.lax.cond(start <= last_q, update, lambda c: c, carry)
+            return jax.lax.cond(
+                k_lo + start <= last_q, update, lambda c: c, carry
+            )
         return update(carry)
 
+    return jax.lax.fori_loop(0, nk, body, (m0, l0, acc0))
+
+
+def _kernel(q_ref, k_ref, v_ref, o_ref, *, scale, causal, q_base, block_k):
+    """One q block, full softmax: stream K/V via _stream_kv and write the
+    normalized output."""
+    qi = pl.program_id(1)
+    bq, d = q_ref.shape[1], q_ref.shape[2]
+    # np.sqrt hands back a STRONG np.float64 scalar; unpinned it drags
+    # every accumulator to f64 under x64 (see ring_attention)
+    scale = jnp.float32(scale)
+    # framework convention: see _matmul_precision — this backend's
+    # DEFAULT is the bf16 MXU path (fine for bf16 inputs, a 1e-1-scale
+    # score error for f32 ones).  bf16 operands feed the MXU untouched;
+    # softmax/accumulation are f32.
+    prec = _matmul_precision(q_ref.dtype)
     m0 = jnp.full((bq,), -jnp.inf, jnp.float32)
     l0 = jnp.zeros((bq,), jnp.float32)
     acc0 = jnp.zeros((bq, d), jnp.float32)
-    m, l, acc = jax.lax.fori_loop(0, nk, body, (m0, l0, acc0))
+    m, l, acc = _stream_kv(
+        q_ref[0], k_ref, v_ref, m0, l0, acc0,
+        scale=scale, causal=causal, prec=prec,
+        q_lo=q_base + qi * bq, k_lo=0, block_k=block_k,
+    )
     o_ref[0] = (acc / jnp.maximum(l, 1e-30)[:, None]).astype(o_ref.dtype)
+
+
+def _kernel_partial(
+    bases_ref, q_ref, k_ref, v_ref, m_in, l_in, acc_in,
+    m_out, l_out, acc_out, *, scale, causal, block_k,
+):
+    """One q block, PARTIAL softmax: fold this K/V segment into the
+    caller's running (m, l, acc) state.  ``bases_ref`` (SMEM, i32[2]) is
+    the global position of q row 0 / k row 0 — runtime values, because
+    under ring sequence-parallelism they are per-device, per-round ring
+    offsets.  The caller normalizes (acc / l) after the last segment."""
+    qi = pl.program_id(1)
+    bq = q_ref.shape[1]
+    scale = jnp.float32(scale)
+    prec = _matmul_precision(q_ref.dtype)
+    # m/l travel as (BH, Lq, 1): Mosaic requires the last two block dims
+    # divisible by (8, 128) OR equal to the array dims — a (1, bq) block
+    # of a (BH, Lq) array is neither, a (1, bq, 1) block passes
+    m, l, acc = _stream_kv(
+        q_ref[0], k_ref, v_ref, m_in[0, :, 0], l_in[0, :, 0], acc_in[0],
+        scale=scale, causal=causal, prec=prec,
+        q_lo=bases_ref[0] + qi * bq, k_lo=bases_ref[1], block_k=block_k,
+    )
+    m_out[0] = m[:, None]
+    l_out[0] = l[:, None]
+    acc_out[0] = acc
 
 
 def _pick_block(s: int, target: int) -> int:
@@ -231,3 +273,78 @@ def flash_attention(
         )(qt, kt, vt)
     out = jnp.moveaxis(out.reshape(B, H, S, D), 1, 2)
     return out if batched else out[0]
+
+
+def flash_attention_partial(
+    q, k, v, m, l, acc,
+    q_base, k_base,
+    causal: bool = False,
+    interpret: bool = False,
+    block_q: int = 512,
+    block_k: int = 2048,
+    vma_axes: tuple = (),
+):
+    """One fused PARTIAL attention update: fold the K/V segment into the
+    running streaming-softmax state and return it un-normalized.
+
+    This is the local block engine for ring sequence parallelism: each
+    ring round hands the visiting K/V segment plus its global offset
+    (``k_base``, a traced per-device value) to this kernel instead of
+    materializing an L×L score tile in HBM.  Shapes: ``q`` (BH, Lq, D)
+    in the input dtype; ``k``/``v`` (BH, Lk, D); state ``m``/``l``
+    (BH, Lq) f32 and ``acc`` (BH, Lq, D) f32.  Initialize with
+    ``m = -inf``, ``l = 0``, ``acc = 0``; after the final segment the
+    caller computes ``acc / max(l, eps)``.
+
+    Plain traceable function (no jit wrapper): it is designed to be
+    called INSIDE shard_map/fori_loop bodies.  ``interpret`` runs the
+    Pallas interpreter (CPU test suite); callers gate conformance
+    (Lq/Lk multiples of 128, not f64, K/V within the VMEM budget) and
+    fall back to the jnp algebra themselves — see ring_attention.
+    ``vma_axes`` names the shard_map mesh axes the outputs vary over
+    (required when check_vma validation is on around this call).
+    """
+    BH, Lq, D = q.shape
+    Lk = k.shape[1]
+    bq = _pick_block(Lq, block_q)
+    bk = _pick_block(Lk, block_k)
+    scale = 1.0 / np.sqrt(D)
+    bases = jnp.stack(
+        [jnp.asarray(q_base, jnp.int32), jnp.asarray(k_base, jnp.int32)]
+    )
+
+    kern = functools.partial(
+        _kernel_partial, scale=scale, causal=causal, block_k=bk
+    )
+    state_q = lambda bh, qi: (bh, qi, 0)
+    whole_k = lambda bh, qi: (bh, 0, 0)
+    with jax.enable_x64(False):
+        m_o, l_o, acc = pl.pallas_call(
+            kern,
+            grid=(BH, Lq // bq),
+            in_specs=[
+                pl.BlockSpec(memory_space=pltpu.SMEM),
+                pl.BlockSpec((1, bq, D), state_q),
+                pl.BlockSpec((1, Lk, D), whole_k),
+                pl.BlockSpec((1, Lk, D), whole_k),
+                pl.BlockSpec((1, bq, 1), state_q),
+                pl.BlockSpec((1, bq, 1), state_q),
+                pl.BlockSpec((1, bq, D), state_q),
+            ],
+            out_specs=[
+                pl.BlockSpec((1, bq, 1), state_q),
+                pl.BlockSpec((1, bq, 1), state_q),
+                pl.BlockSpec((1, bq, D), state_q),
+            ],
+            out_shape=[
+                jax.ShapeDtypeStruct((BH, Lq, 1), jnp.float32, vma=frozenset(vma_axes)),
+                jax.ShapeDtypeStruct((BH, Lq, 1), jnp.float32, vma=frozenset(vma_axes)),
+                jax.ShapeDtypeStruct((BH, Lq, D), jnp.float32, vma=frozenset(vma_axes)),
+            ],
+            compiler_params=pltpu.CompilerParams(
+                dimension_semantics=("parallel", "parallel"),
+                vmem_limit_bytes=_VMEM_LIMIT,
+            ),
+            interpret=interpret,
+        )(bases, q, k, v, m[..., None], l[..., None], acc)
+    return m_o[..., 0], l_o[..., 0], acc

@@ -69,6 +69,7 @@ def ring_attention(
     v,
     causal: bool = False,
     comm: Optional[XlaCommunication] = None,
+    local_kernel: str = "auto",
 ) -> jax.Array:
     """Exact attention over a sequence-sharded (seq, heads, dim) — or
     (batch, seq, heads, dim) — input.
@@ -77,7 +78,19 @@ def ring_attention(
     the mesh size; each round rotates the K/V blocks one hop and folds them
     into the running softmax.  ``causal=True`` applies the global causal
     mask using each block's ring-origin offset.
+
+    ``local_kernel`` picks the per-round block engine:
+    - ``"auto"``: the fused Pallas partial kernel
+      (flash_attention_partial) on TPU when the local block conforms
+      (L a multiple of 128, not f64) — it never materializes the L×L
+      score tile in HBM, which at long context is the difference between
+      ~60 and ~15 TFLOP/s per device — else the XLA blockwise update;
+    - ``"flash"``: force the Pallas engine (interpreted off-TPU — the
+      CPU test suite's path for exercising the real ring+flash program);
+    - ``"xla"``: force the jnp blockwise update.
     """
+    if local_kernel not in ("auto", "flash", "xla"):
+        raise ValueError(f"local_kernel must be auto|flash|xla, got {local_kernel!r}")
     if isinstance(q, DNDarray):
         comm = comm or q.comm
         q, k, v = q.larray, k.larray, v.larray
@@ -107,6 +120,89 @@ def ring_attention(
     mesh, name = comm.mesh, comm.axis_name
     L = S // size
     perm = [(i, (i + 1) % size) for i in range(size)]
+
+    on_tpu = jax.default_backend() == "tpu"
+    from .flash_attention import _VMEM_LIMIT
+
+    # same residency bound flash_attention itself enforces: the partial
+    # kernel pins the whole visiting K/V block in VMEM
+    kv_fits = 4 * L * D * q.dtype.itemsize <= _VMEM_LIMIT // 2
+    conforming = (
+        L % 128 == 0
+        and q.dtype != jnp.float64
+        and acc_dt == jnp.float32
+        and kv_fits
+    )
+    if local_kernel == "flash" and not conforming:
+        raise ValueError(
+            f"local_kernel='flash' needs a conforming local block (L={L} "
+            "must be a multiple of 128, dtype f32/bf16, K/V within the "
+            "VMEM budget); use 'auto' for the silent fallback"
+        )
+    use_flash = local_kernel == "flash" or (
+        local_kernel == "auto" and on_tpu and conforming
+    )
+
+    if use_flash:
+        from .flash_attention import flash_attention_partial
+
+        interp = not on_tpu  # CPU test suite: Pallas interpreter
+
+        def kernel(q_blk, k_blk, v_blk):
+            # (B, L, H, D) → (B*H, L, D) once, OUTSIDE the ring loop —
+            # the flattened layout rotates directly (same bytes over ICI)
+            qf = jnp.moveaxis(q_blk, 2, 1).reshape(B * H, L, D)
+            kf = jnp.moveaxis(k_blk, 2, 1).reshape(B * H, L, D)
+            vf = jnp.moveaxis(v_blk, 2, 1).reshape(B * H, L, D)
+            my = jax.lax.axis_index(name)
+            # carries pcast to varying (like the XLA kernel's m0/num0/
+            # den0) so shard_map vma validation stays ON for the
+            # compiled TPU path
+            m0 = jax.lax.pcast(
+                jnp.full((B * H, L), -jnp.inf, jnp.float32), (name,), to="varying"
+            )
+            l0 = jax.lax.pcast(
+                jnp.zeros((B * H, L), jnp.float32), (name,), to="varying"
+            )
+            acc0 = jax.lax.pcast(
+                jnp.zeros((B * H, L, D), jnp.float32), (name,), to="varying"
+            )
+
+            def body(r, carry):
+                kb, vb, m, l, acc = carry
+                origin = (my - r) % size
+                m, l, acc = flash_attention_partial(
+                    qf, kb, vb, m, l, acc,
+                    q_base=my * L, k_base=origin * L,
+                    causal=causal, interpret=interp,
+                    vma_axes=() if interp else (name,),
+                )
+                kb = jax.lax.ppermute(kb, name, perm)
+                vb = jax.lax.ppermute(vb, name, perm)
+                return kb, vb, m, l, acc
+
+            _, _, m, l, acc = jax.lax.fori_loop(
+                0, size, body, (kf, vf, m0, l0, acc0)
+            )
+            out = acc / jnp.maximum(l, 1e-30)[..., None]  # (B*H, L, D)
+            out = jnp.moveaxis(out.reshape(B, H, L, D), 1, 2)
+            return out.astype(q_blk.dtype)  # (B, L, H, D)
+
+        spec = PartitionSpec(None, name, None, None)
+        # check_vma must be OFF around pallas_call in this jax version —
+        # verified both ways: the interpreter traces the kernel body as
+        # jax ops whose internal constants are unvarying, and the Mosaic
+        # path rejects the kernel's lax.cond under branch-vma matching.
+        # The program is per-device-pure (carries are pcast varying, all
+        # collectives are the explicit ppermutes); the XLA local-kernel
+        # path below keeps validation on.
+        out = jax.jit(
+            jax.shard_map(
+                kernel, mesh=mesh, in_specs=(spec, spec, spec),
+                out_specs=spec, check_vma=False,
+            )
+        )(q, k, v)
+        return out if batched else out[0]
 
     def kernel(q_blk, k_blk, v_blk):
         # local blocks: (B, L, H, D) → (B, H, L, D)

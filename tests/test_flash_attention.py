@@ -97,6 +97,77 @@ def test_flash_fallback_shapes_and_dtypes():
     np.testing.assert_allclose(np.asarray(out64), _reference(q, k, v, False), atol=1e-9)
 
 
+@pytest.mark.parametrize("causal", [False, True])
+def test_partial_chain_matches_full(causal):
+    # chaining flash_attention_partial over K/V segments must reproduce
+    # the full fused softmax exactly (same algebra, same order)
+    from heat_tpu.parallel import flash_attention_partial
+
+    BH, S, D = 4, 256, 32
+    q, k, v = (
+        jnp.asarray(RNG.normal(size=(BH, S, D)).astype(np.float32))
+        for _ in range(3)
+    )
+    qs = jnp.moveaxis(q, 0, 1)[None]
+    ks = jnp.moveaxis(k, 0, 1)[None]
+    vs = jnp.moveaxis(v, 0, 1)[None]
+    ref = jnp.moveaxis(
+        flash_attention(qs, ks, vs, causal=causal, interpret=True,
+                        block_q=128, block_k=128)[0], 0, 1,
+    )
+    m = jnp.full((BH, S), -jnp.inf, jnp.float32)
+    l = jnp.zeros((BH, S), jnp.float32)
+    acc = jnp.zeros((BH, S, D), jnp.float32)
+    seg = S // 2
+    for r in range(2):
+        m, l, acc = flash_attention_partial(
+            q, k[:, r * seg:(r + 1) * seg], v[:, r * seg:(r + 1) * seg],
+            m, l, acc, q_base=0, k_base=r * seg,
+            causal=causal, interpret=True, block_q=128, block_k=128,
+        )
+    out = acc / jnp.maximum(l, 1e-30)[..., None]
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-6)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_flash_local_kernel_matches_xla(causal):
+    # the REAL ring program with the Pallas partial kernel as its local
+    # engine (interpreted on the CPU mesh) must agree with the XLA
+    # blockwise path — this is the long-context flagship configuration
+    comm = ht.get_comm()
+    if comm.size == 1:
+        pytest.skip("needs a mesh")
+    S, H, D = 128 * comm.size, 2, 16
+    q, k, v = (RNG.normal(size=(S, H, D)).astype(np.float32) for _ in range(3))
+    qs, ks, vs = (comm.apply_sharding(jnp.asarray(x), 0) for x in (q, k, v))
+    a_flash = ht.parallel.ring_attention(
+        qs, ks, vs, causal=causal, comm=comm, local_kernel="flash"
+    )
+    a_xla = ht.parallel.ring_attention(
+        qs, ks, vs, causal=causal, comm=comm, local_kernel="xla"
+    )
+    np.testing.assert_allclose(
+        np.asarray(a_flash), np.asarray(a_xla), atol=2e-6
+    )
+    np.testing.assert_allclose(
+        np.asarray(a_flash), _reference(q, k, v, causal), atol=2e-5
+    )
+
+
+def test_ring_flash_rejects_nonconforming():
+    comm = ht.get_comm()
+    if comm.size == 1:
+        pytest.skip("needs a mesh")
+    S = 8 * comm.size  # L=8: not a 128 multiple
+    q = jnp.asarray(RNG.normal(size=(S, 2, 8)).astype(np.float32))
+    qs = comm.apply_sharding(q, 0)
+    with pytest.raises(ValueError, match="conforming"):
+        ht.parallel.ring_attention(qs, qs, qs, comm=comm, local_kernel="flash")
+    # and 'auto' silently uses the XLA path for the same shapes
+    out = ht.parallel.ring_attention(qs, qs, qs, comm=comm, local_kernel="auto")
+    assert np.isfinite(np.asarray(out)).all()
+
+
 def test_ring_single_block_path_uses_flash_semantics():
     # on the CPU mesh flash falls back to the jnp path; the ring
     # single-block branch must stay exact through the indirection
