@@ -795,9 +795,11 @@ def qr_svd_ms():
         float(acc.sum())  # single fence for the whole region
         return time.perf_counter() - t0
 
-    # ~2.5-3.3 ms/rep: 60-rep regions (~0.2 s) keep the slope above the
-    # ~100 ms tunnel round-trip noise (5-rep regions measured 71% spread)
-    slopes, fallback = _pair_samples(region, 5, 60, pairs=5)
+    # ~2.5-3.3 ms/rep device + ~6 eager dispatches/rep: 60-rep regions
+    # (~0.2-0.5 s) keep the slope above the ~100 ms tunnel round-trip
+    # noise, and 9 pairs tighten the median of this dispatch-bound,
+    # host-state-sensitive metric (see its disposition)
+    slopes, fallback = _pair_samples(region, 5, 60, pairs=9)
     if not slopes:
         slopes = [fallback]
     return _summary([d * 1e3 for d in slopes])

@@ -1,0 +1,148 @@
+"""bench.py harness logic — the pure functions behind the perf-evidence
+layers (golden normalization, roofline models, slope summaries, the
+best-round regression guard).  No device work: these tests pin the MATH
+so a harness edit cannot silently change what the recorded numbers mean."""
+
+from __future__ import annotations
+
+import os
+import sys
+
+import pytest
+
+# same import pattern as test_core_utils.py: ONE shared bench module
+# instance across the suite (a second importlib spec would re-execute
+# bench.py's top level and split monkeypatch targets)
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import bench  # noqa: E402
+
+
+def test_metric_value_headline_vs_aux():
+    rec = {"metric": "kmeans_iter_per_sec", "value": 9500.0, "cdist_gb_per_sec": 1000.0}
+    assert bench._metric_value(rec, "kmeans_iter_per_sec") == 9500.0
+    assert bench._metric_value(rec, "cdist_gb_per_sec") == 1000.0
+    assert bench._metric_value(rec, "missing_metric") is None
+
+
+def test_vs_golden_div_and_mul():
+    results = {
+        "metric": "kmeans_iter_per_sec",
+        "value": 9000.0,
+        "eager_ops_per_sec": 1000.0,
+        "qr_svd_tall_skinny_ms": 4.0,
+    }
+    golden = {
+        "kmeans_iter_per_sec": {"reduce_gb_per_sec": 750.0},
+        "eager_ops_per_sec": {"roundtrip_ms": 100.0},
+        "qr_svd_tall_skinny_ms": {"roundtrip_ms": 100.0},
+    }
+    out = bench._vs_golden(results, golden)
+    assert out["kmeans_iter_per_sec"] == pytest.approx(12.0)      # div
+    assert out["eager_ops_per_sec"] == pytest.approx(100000.0)    # mul
+    assert out["qr_svd_tall_skinny_ms"] == pytest.approx(0.04)    # div (ms/ms)
+    # a missing golden never fabricates a ratio
+    assert "cdist_gb_per_sec" not in out
+
+
+def test_vs_golden_stable_under_uniform_slowdown():
+    # the design property: a machine slowdown moves metric and golden
+    # together, so vs_golden is unchanged; a code regression moves only
+    # the metric
+    fast = bench._vs_golden(
+        {"metric": "kmeans_iter_per_sec", "value": 10000.0},
+        {"kmeans_iter_per_sec": {"reduce_gb_per_sec": 800.0}},
+    )
+    slow = bench._vs_golden(
+        {"metric": "kmeans_iter_per_sec", "value": 8000.0},
+        {"kmeans_iter_per_sec": {"reduce_gb_per_sec": 640.0}},
+    )
+    assert fast["kmeans_iter_per_sec"] == pytest.approx(
+        slow["kmeans_iter_per_sec"]
+    )
+    regressed = bench._vs_golden(
+        {"metric": "kmeans_iter_per_sec", "value": 8000.0},
+        {"kmeans_iter_per_sec": {"reduce_gb_per_sec": 800.0}},
+    )
+    assert regressed["kmeans_iter_per_sec"] < fast["kmeans_iter_per_sec"]
+
+
+def test_roofline_rates_and_bounds():
+    results = {
+        "metric": "kmeans_iter_per_sec",
+        "value": 9500.0,
+        "attention_tokens_per_sec": 3.4e6,
+        "cdist_gb_per_sec": 1000.0,
+        "global_sum_gb_per_sec": 750.0,
+    }
+    roof = bench._roofline(results)
+    km = roof["kmeans_iter_per_sec"]
+    flops, bytes_, _, _ = bench._work_models()["kmeans_iter_per_sec"]
+    assert km["achieved_tflops"] == pytest.approx(flops * 9500.0 / 1e12, rel=1e-2)
+    assert km["achieved_gb_per_sec"] == pytest.approx(bytes_ * 9500.0 / 1e9, rel=1e-2)
+    assert km["bound"] == "hbm"
+    # attention: tokens/s -> forwards/s through ATTN_S
+    at = roof["attention_tokens_per_sec"]
+    aflops = bench._work_models()["attention_tokens_per_sec"][0]
+    assert at["achieved_tflops"] == pytest.approx(
+        aflops * 3.4e6 / bench.ATTN_S / 1e12, rel=1e-2
+    )
+    assert at["bound"] == "compute"
+    # GB/s metrics back out reps/s through their measurement bytes
+    gs = roof["global_sum_gb_per_sec"]
+    assert gs["achieved_gb_per_sec"] == pytest.approx(750.0, rel=1e-2)
+    # the hbm percentage always refers to the declared peak
+    assert gs["pct_hbm_roofline"] == pytest.approx(
+        100 * 750.0 / bench._PEAKS["hbm_gb_per_sec"], rel=1e-2
+    )
+    # irregular metrics stay out, with reasons
+    assert "kmedoids_iter_per_sec" in roof["not_modeled"]
+
+
+def test_summary_median_and_spread_semantics():
+    med, spread = bench._summary([10.0, 11.0, 9.0, 10.5, 9.5])
+    assert med == 10.0
+    assert spread is not None and spread > 0
+    # fewer than 3 estimates: spread must be UNKNOWN (None), never 0.0
+    med2, spread2 = bench._summary([10.0, 12.0])
+    assert spread2 is None
+
+
+def test_every_headline_has_group_and_disposition_coverage():
+    # structural invariants the JSON consumers rely on
+    for key in bench._HEADLINE:
+        assert key in bench._METRIC_GROUP, key
+        assert key in bench._GOLDEN_MAP, key
+    models = bench._work_models()
+    for key in bench._HEADLINE:
+        assert key in models or key in bench._NOT_MODELED, (
+            f"{key} neither roofline-modeled nor excluded-with-reason"
+        )
+
+
+def test_regression_guard_uses_best_round(tmp_path, monkeypatch):
+    import json
+
+    d = tmp_path
+    (d / "BENCH_r01.json").write_text(json.dumps(
+        {"metric": "kmeans_iter_per_sec", "value": 9000.0,
+         "cdist_gb_per_sec": 1300.0}
+    ))
+    (d / "BENCH_r02.json").write_text(json.dumps(
+        {"metric": "kmeans_iter_per_sec", "value": 9500.0,
+         "cdist_gb_per_sec": 1000.0}
+    ))
+    # patch glob on the bench instance (test_core_utils.py convention):
+    # zero process-global footprint, unlike patching os.path.dirname
+    import glob as _glob
+
+    real = sorted(_glob.glob(os.path.join(str(d), "BENCH_r*.json")))
+    monkeypatch.setattr(bench.glob, "glob", lambda pat: real)
+    flagged = bench.regression_check(
+        {"metric": "kmeans_iter_per_sec", "value": 9400.0,
+         "cdist_gb_per_sec": 900.0}
+    )
+    # kmeans 9400 vs best 9500 is within 10% -> not flagged
+    assert "kmeans_iter_per_sec" not in flagged
+    # cdist 900 vs BEST round (1300, r1 — not the latest round) -> flagged
+    assert flagged["cdist_gb_per_sec"]["best"] == 1300.0
+    assert flagged["cdist_gb_per_sec"]["best_round"] == 1
