@@ -164,6 +164,21 @@ def _pick_block(s: int, target: int) -> int:
     return b if s % b == 0 else 128
 
 
+def conforms(seq_len: int, d: int, dtype) -> bool:
+    """True when the fused kernel accepts a local block of this shape:
+    128-aligned sequence, f32/bf16 (f32 accumulator), K/V within the
+    VMEM residency budget.  THE one conformance predicate — ring and
+    ulysses gate their ``local_kernel`` dispatch on it, so it can never
+    drift from the kernel's own fallback rule."""
+    dt = jnp.dtype(dtype)
+    return (
+        seq_len % 128 == 0
+        and dt != jnp.float64
+        and jnp.promote_types(dt, jnp.float32) == jnp.float32
+        and 4 * seq_len * d * dt.itemsize <= _VMEM_LIMIT // 2
+    )
+
+
 def _matmul_precision(dtype):
     """The framework matmul convention (linalg.basics): true-f32/f64
     passes for float inputs, the native bf16 MXU path for bf16 — shared

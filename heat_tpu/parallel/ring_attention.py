@@ -82,8 +82,9 @@ def ring_attention(
     ``local_kernel`` picks the per-round block engine:
     - ``"auto"``: the fused Pallas partial kernel
       (flash_attention_partial) on TPU when the local block conforms
-      (L a multiple of 128, not f64) — it never materializes the L×L
-      score tile in HBM, which at long context is the difference between
+      (flash_attention.conforms: L a multiple of 128, f32/bf16, K/V
+      within the VMEM budget) — it never materializes the L×L score
+      tile in HBM, which at long context is the difference between
       ~60 and ~15 TFLOP/s per device — else the XLA blockwise update;
     - ``"flash"``: force the Pallas engine (interpreted off-TPU — the
       CPU test suite's path for exercising the real ring+flash program);
@@ -122,17 +123,11 @@ def ring_attention(
     perm = [(i, (i + 1) % size) for i in range(size)]
 
     on_tpu = jax.default_backend() == "tpu"
-    from .flash_attention import _VMEM_LIMIT
+    from .flash_attention import conforms
 
-    # same residency bound flash_attention itself enforces: the partial
-    # kernel pins the whole visiting K/V block in VMEM
-    kv_fits = 4 * L * D * q.dtype.itemsize <= _VMEM_LIMIT // 2
-    conforming = (
-        L % 128 == 0
-        and q.dtype != jnp.float64
-        and acc_dt == jnp.float32
-        and kv_fits
-    )
+    # the ONE conformance predicate (flash_attention.conforms): 128-aligned
+    # local block, f32/bf16, visiting K/V within the VMEM residency budget
+    conforming = conforms(L, D, q.dtype)
     if local_kernel == "flash" and not conforming:
         raise ValueError(
             f"local_kernel='flash' needs a conforming local block (L={L} "

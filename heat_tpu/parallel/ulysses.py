@@ -44,6 +44,7 @@ def ulysses_attention(
     v,
     causal: bool = False,
     comm: Optional[XlaCommunication] = None,
+    local_kernel: str = "auto",
 ) -> jax.Array:
     """Exact attention over sequence-sharded (seq, heads, dim) — or
     (batch, seq, heads, dim) — inputs via the head↔sequence all-to-all.
@@ -52,7 +53,20 @@ def ulysses_attention(
     falls back to plain attention (GSPMD-planned) otherwise.  The sequence
     axis need not be divisible — the all-to-all path additionally needs it
     to be, else the fallback also applies.
+
+    ``local_kernel`` picks the comm-free full-sequence engine each device
+    runs after the head swap (mirrors ring_attention):
+    - ``"auto"``: the fused Pallas flash kernel on TPU when the full
+      sequence conforms (S a multiple of 128, f32/bf16, K/V within the
+      VMEM budget) — via an explicit shard_map whose two
+      ``lax.all_to_all``s do the head↔sequence swap; else the GSPMD
+      two-constraint formulation with the XLA attention;
+    - ``"flash"``: force the shard_map+Pallas program (interpreted
+      off-TPU — the CPU suite's path);
+    - ``"xla"``: force the GSPMD formulation.
     """
+    if local_kernel not in ("auto", "flash", "xla"):
+        raise ValueError(f"local_kernel must be auto|flash|xla, got {local_kernel!r}")
     if isinstance(q, DNDarray):
         comm = comm or q.comm
         q, k, v = q.larray, k.larray, v.larray
@@ -68,8 +82,69 @@ def ulysses_attention(
     seq_sh = NamedSharding(mesh, PartitionSpec(None, name, None, None))
     head_sh = NamedSharding(mesh, PartitionSpec(None, None, name, None))
 
+    from .flash_attention import conforms, flash_attention
+
     if size == 1 or H % size != 0 or S % size != 0:
-        out = jax.jit(_attention, static_argnames="causal")(q, k, v, causal=causal)
+        # single device or non-Ulysses shapes.  The local_kernel contract
+        # holds here too: 'flash' may not silently become XLA
+        if local_kernel == "flash" and (
+            size > 1 or not conforms(S, D, q.dtype)
+        ):
+            raise ValueError(
+                "local_kernel='flash' needs heads and sequence divisible "
+                f"by the mesh (H={H}, S={S}, {size} devices) and a "
+                "conforming sequence (128-multiple, f32/bf16, within the "
+                "VMEM budget); use 'auto' for the silent fallback"
+            )
+        if size == 1 and local_kernel != "xla":
+            # flash gates its own off-TPU/VMEM fallback; only engage it
+            # when nothing is sharded (a Pallas call on a GSPMD-sharded
+            # global would force a gather)
+            out = flash_attention(q, k, v, causal=causal)
+        else:
+            out = jax.jit(_attention, static_argnames="causal")(
+                q, k, v, causal=causal
+            )
+        return out if batched else out[0]
+
+    on_tpu = jax.default_backend() == "tpu"
+
+    conforming = conforms(S, D, q.dtype)
+    if local_kernel == "flash" and not conforming:
+        raise ValueError(
+            f"local_kernel='flash' needs a conforming sequence (S={S} must "
+            "be a multiple of 128, dtype f32/bf16, K/V within the VMEM "
+            "budget); use 'auto' for the silent fallback"
+        )
+    use_flash = local_kernel == "flash" or (
+        local_kernel == "auto" and on_tpu and conforming
+    )
+
+    if use_flash:
+        interp = not on_tpu  # CPU test suite: Pallas interpreter
+        spec = PartitionSpec(None, name, None, None)
+
+        def kern(qb, kb, vb):  # local (B, L, H, D)
+            # seq→head swap as ONE explicit all-to-all per operand (the
+            # same collective GSPMD emits for the two-constraint form)
+            qh, kh, vh = (
+                jax.lax.all_to_all(t, name, split_axis=2, concat_axis=1, tiled=True)
+                for t in (qb, kb, vb)
+            )  # (B, S, H/p, D): full sequence per device
+            out = flash_attention(qh, kh, vh, causal=causal, interpret=interp)
+            # head→seq swap back to the caller's layout
+            return jax.lax.all_to_all(
+                out, name, split_axis=1, concat_axis=2, tiled=True
+            )
+
+        # check_vma=False: pallas_call under shard_map — see the
+        # identical note in ring_attention
+        out = jax.jit(
+            jax.shard_map(
+                kern, mesh=mesh, in_specs=(spec, spec, spec),
+                out_specs=spec, check_vma=False,
+            )
+        )(*(jax.device_put(t, seq_sh) for t in (q, k, v)))
         return out if batched else out[0]
 
     @jax.jit

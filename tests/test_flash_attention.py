@@ -154,6 +154,43 @@ def test_ring_flash_local_kernel_matches_xla(causal):
     )
 
 
+@pytest.mark.parametrize("causal", [False, True])
+def test_ulysses_flash_local_kernel_matches_xla(causal):
+    # the shard_map + lax.all_to_all + Pallas formulation must agree with
+    # the GSPMD two-constraint + XLA attention formulation
+    comm = ht.get_comm()
+    if comm.size == 1:
+        pytest.skip("needs a mesh")
+    S, H, D = 128 * comm.size, 2 * comm.size, 16
+    q, k, v = (RNG.normal(size=(S, H, D)).astype(np.float32) for _ in range(3))
+    qs, ks, vs = (comm.apply_sharding(jnp.asarray(x), 0) for x in (q, k, v))
+    a_flash = ht.parallel.ulysses_attention(
+        qs, ks, vs, causal=causal, comm=comm, local_kernel="flash"
+    )
+    a_xla = ht.parallel.ulysses_attention(
+        qs, ks, vs, causal=causal, comm=comm, local_kernel="xla"
+    )
+    np.testing.assert_allclose(
+        np.asarray(a_flash), np.asarray(a_xla), atol=2e-6
+    )
+    np.testing.assert_allclose(
+        np.asarray(a_flash), _reference(q, k, v, causal), atol=2e-5
+    )
+
+
+def test_ulysses_flash_rejects_nonconforming():
+    comm = ht.get_comm()
+    if comm.size == 1:
+        pytest.skip("needs a mesh")
+    S, H = 8 * comm.size, 2 * comm.size  # S not a 128 multiple
+    q = jnp.asarray(RNG.normal(size=(S, H, 8)).astype(np.float32))
+    qs = comm.apply_sharding(q, 0)
+    with pytest.raises(ValueError, match="conforming"):
+        ht.parallel.ulysses_attention(qs, qs, qs, comm=comm, local_kernel="flash")
+    out = ht.parallel.ulysses_attention(qs, qs, qs, comm=comm, local_kernel="auto")
+    assert np.isfinite(np.asarray(out)).all()
+
+
 def test_ring_flash_rejects_nonconforming():
     comm = ht.get_comm()
     if comm.size == 1:
