@@ -45,6 +45,12 @@ SUITES = {
         r"→ ([\d.]+) sweeps/s",
         "sweeps/s",
     ),
+    "attention": (
+        "benchmarks/attention/heat_tpu_bench.py",
+        ["--seq", "1024", "--heads", "4", "--dim", "16", "--trials", "2"],
+        r"→ ([\d.]+) tokens/s",
+        "tokens/s",
+    ),
     "statistical_moments": (
         "benchmarks/statistical_moments/heat_tpu_bench.py",
         ["--n", "2000000", "--trials", "2"],

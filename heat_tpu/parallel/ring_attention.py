@@ -24,6 +24,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec
 
+from ..core._compile import jitted
 from ..core.communication import XlaCommunication, get_comm
 from ..core.dndarray import DNDarray
 
@@ -89,6 +90,10 @@ def ring_attention(
     - ``"flash"``: force the Pallas engine (interpreted off-TPU — the
       CPU test suite's path for exercising the real ring+flash program);
     - ``"xla"``: force the jnp blockwise update.
+
+    The compiled ring program is cached per (comm, config) through the
+    op engine's keyed-jit cache: building a fresh ``jax.jit`` object per
+    call would recompile the whole program on every invocation.
     """
     if local_kernel not in ("auto", "flash", "xla"):
         raise ValueError(f"local_kernel must be auto|flash|xla, got {local_kernel!r}")
@@ -110,17 +115,38 @@ def ring_attention(
     scale = jnp.asarray(1.0 / np.sqrt(D), acc_dt)
 
     if size == 1 or S % size != 0:
-        # single block: the fused Pallas kernel (flash_attention decides
-        # itself when to fall back to the XLA-fused plain path — off-TPU,
-        # non-conforming shapes, or K/V too large for VMEM residency)
-        from .flash_attention import flash_attention
+        # single block.  The local_kernel contract holds here too:
+        # 'flash' may not silently become XLA and vice versa
+        from .flash_attention import _jnp_fallback, conforms, flash_attention
 
-        out = flash_attention(q, k, v, causal=causal)
+        if local_kernel == "flash" and (size > 1 or not conforms(S, D, q.dtype)):
+            raise ValueError(
+                "local_kernel='flash' needs a mesh-divisible sequence "
+                f"(S={S}, {size} devices) and a conforming shape "
+                "(128-multiple, f32/bf16, within the VMEM budget); use "
+                "'auto' for the silent fallback"
+            )
+        if local_kernel == "xla":
+            key = ("ring_attention.single_xla", causal, B, S, H, D, str(q.dtype))
+            out = jitted(
+                key, lambda: (lambda a, b, c: _jnp_fallback(a, b, c, causal))
+            )(q, k, v)
+        else:
+            # 'auto' lets flash gate its own fallback; 'flash' forces the
+            # Pallas kernel (interpreted off-TPU)
+            out = flash_attention(
+                q, k, v, causal=causal,
+                interpret=(
+                    local_kernel == "flash"
+                    and jax.default_backend() != "tpu"
+                ),
+            )
         return out if batched else out[0]
 
     mesh, name = comm.mesh, comm.axis_name
     L = S // size
     perm = [(i, (i + 1) % size) for i in range(size)]
+    spec = PartitionSpec(None, name, None, None)
 
     on_tpu = jax.default_backend() == "tpu"
     from .flash_attention import conforms
@@ -143,98 +169,105 @@ def ring_attention(
 
         interp = not on_tpu  # CPU test suite: Pallas interpreter
 
-        def kernel(q_blk, k_blk, v_blk):
-            # (B, L, H, D) → (B*H, L, D) once, OUTSIDE the ring loop —
-            # the flattened layout rotates directly (same bytes over ICI)
-            qf = jnp.moveaxis(q_blk, 2, 1).reshape(B * H, L, D)
-            kf = jnp.moveaxis(k_blk, 2, 1).reshape(B * H, L, D)
-            vf = jnp.moveaxis(v_blk, 2, 1).reshape(B * H, L, D)
-            my = jax.lax.axis_index(name)
-            # carries pcast to varying (like the XLA kernel's m0/num0/
-            # den0) so shard_map vma validation stays ON for the
-            # compiled TPU path
-            m0 = jax.lax.pcast(
-                jnp.full((B * H, L), -jnp.inf, jnp.float32), (name,), to="varying"
-            )
-            l0 = jax.lax.pcast(
-                jnp.zeros((B * H, L), jnp.float32), (name,), to="varying"
-            )
-            acc0 = jax.lax.pcast(
-                jnp.zeros((B * H, L, D), jnp.float32), (name,), to="varying"
-            )
-
-            def body(r, carry):
-                kb, vb, m, l, acc = carry
-                origin = (my - r) % size
-                m, l, acc = flash_attention_partial(
-                    qf, kb, vb, m, l, acc,
-                    q_base=my * L, k_base=origin * L,
-                    causal=causal, interpret=interp,
-                    vma_axes=() if interp else (name,),
+        def make_flash():
+            def kernel(q_blk, k_blk, v_blk):
+                # (B, L, H, D) → (B*H, L, D) once, OUTSIDE the ring loop
+                # — the flattened layout rotates directly (same bytes
+                # over ICI)
+                qf = jnp.moveaxis(q_blk, 2, 1).reshape(B * H, L, D)
+                kf = jnp.moveaxis(k_blk, 2, 1).reshape(B * H, L, D)
+                vf = jnp.moveaxis(v_blk, 2, 1).reshape(B * H, L, D)
+                my = jax.lax.axis_index(name)
+                # carries pcast to varying (like the XLA kernel's
+                # m0/num0/den0 below)
+                m0 = jax.lax.pcast(
+                    jnp.full((B * H, L), -jnp.inf, jnp.float32), (name,), to="varying"
                 )
-                kb = jax.lax.ppermute(kb, name, perm)
-                vb = jax.lax.ppermute(vb, name, perm)
-                return kb, vb, m, l, acc
+                l0 = jax.lax.pcast(
+                    jnp.zeros((B * H, L), jnp.float32), (name,), to="varying"
+                )
+                acc0 = jax.lax.pcast(
+                    jnp.zeros((B * H, L, D), jnp.float32), (name,), to="varying"
+                )
 
-            _, _, m, l, acc = jax.lax.fori_loop(
-                0, size, body, (kf, vf, m0, l0, acc0)
-            )
-            out = acc / jnp.maximum(l, 1e-30)[..., None]  # (B*H, L, D)
-            out = jnp.moveaxis(out.reshape(B, H, L, D), 1, 2)
-            return out.astype(q_blk.dtype)  # (B, L, H, D)
+                def body(r, carry):
+                    kb, vb, m, l, acc = carry
+                    origin = (my - r) % size
+                    m, l, acc = flash_attention_partial(
+                        qf, kb, vb, m, l, acc,
+                        q_base=my * L, k_base=origin * L,
+                        causal=causal, interpret=interp,
+                        vma_axes=() if interp else (name,),
+                    )
+                    kb = jax.lax.ppermute(kb, name, perm)
+                    vb = jax.lax.ppermute(vb, name, perm)
+                    return kb, vb, m, l, acc
 
-        spec = PartitionSpec(None, name, None, None)
-        # check_vma must be OFF around pallas_call in this jax version —
-        # verified both ways: the interpreter traces the kernel body as
-        # jax ops whose internal constants are unvarying, and the Mosaic
-        # path rejects the kernel's lax.cond under branch-vma matching.
-        # The program is per-device-pure (carries are pcast varying, all
-        # collectives are the explicit ppermutes); the XLA local-kernel
-        # path below keeps validation on.
-        out = jax.jit(
-            jax.shard_map(
+                _, _, m, l, acc = jax.lax.fori_loop(
+                    0, size, body, (kf, vf, m0, l0, acc0)
+                )
+                out = acc / jnp.maximum(l, 1e-30)[..., None]  # (B*H, L, D)
+                out = jnp.moveaxis(out.reshape(B, H, L, D), 1, 2)
+                return out.astype(q_blk.dtype)  # (B, L, H, D)
+
+            # check_vma must be OFF around pallas_call in this jax
+            # version — verified both ways: the interpreter traces the
+            # kernel body as jax ops whose internal constants are
+            # unvarying, and the Mosaic path rejects the kernel's
+            # lax.cond under branch-vma matching.  The program is
+            # per-device-pure (carries are pcast varying, all
+            # collectives are the explicit ppermutes); the XLA
+            # local-kernel path below keeps validation on.
+            return jax.shard_map(
                 kernel, mesh=mesh, in_specs=(spec, spec, spec),
                 out_specs=spec, check_vma=False,
             )
-        )(q, k, v)
+
+        key = ("ring_attention.flash", comm, causal, B, S, H, D, str(q.dtype))
+        out = jitted(key, make_flash)(q, k, v)
         return out if batched else out[0]
 
-    def kernel(q_blk, k_blk, v_blk):
-        # local blocks: (B, L, H, D) → (B, H, L, D)
-        qb = jnp.moveaxis(q_blk, 2, 1)
-        my = jax.lax.axis_index(name)
-        q_pos = my * L + jnp.arange(L)
+    def make_xla():
+        def kernel(q_blk, k_blk, v_blk):
+            # local blocks: (B, L, H, D) → (B, H, L, D)
+            qb = jnp.moveaxis(q_blk, 2, 1)
+            my = jax.lax.axis_index(name)
+            q_pos = my * L + jnp.arange(L)
 
-        # accumulators explicitly acc_dt: under x64, default-dtype
-        # zeros/full are f64 and would drag the whole streaming softmax
-        # into emulated double precision
-        m0 = jax.lax.pcast(jnp.full((B, H, L), -jnp.inf, acc_dt), (name,), to="varying")
-        num0 = jax.lax.pcast(jnp.zeros((B, H, L, D), acc_dt), (name,), to="varying")
-        den0 = jax.lax.pcast(jnp.zeros((B, H, L), acc_dt), (name,), to="varying")
+            # accumulators explicitly acc_dt: under x64, default-dtype
+            # zeros/full are f64 and would drag the whole streaming
+            # softmax into emulated double precision
+            m0 = jax.lax.pcast(jnp.full((B, H, L), -jnp.inf, acc_dt), (name,), to="varying")
+            num0 = jax.lax.pcast(jnp.zeros((B, H, L, D), acc_dt), (name,), to="varying")
+            den0 = jax.lax.pcast(jnp.zeros((B, H, L), acc_dt), (name,), to="varying")
 
-        def body(r, carry):
-            kb, vb, m, num, den = carry
-            origin = (my - r) % size  # which shard this kv block came from
-            k_pos = origin * L + jnp.arange(L)
-            kbt = jnp.moveaxis(kb, 2, 1)
-            vbt = jnp.moveaxis(vb, 2, 1)
-            mask = (q_pos[:, None] >= k_pos[None, :]) if causal else None
-            m, num, den = _blockwise_update(
-                qb, kbt, vbt, m, num, den, scale,
-                mask=None if mask is None else mask[None, None],
+            def body(r, carry):
+                kb, vb, m, num, den = carry
+                origin = (my - r) % size  # this kv block's home shard
+                k_pos = origin * L + jnp.arange(L)
+                kbt = jnp.moveaxis(kb, 2, 1)
+                vbt = jnp.moveaxis(vb, 2, 1)
+                mask = (q_pos[:, None] >= k_pos[None, :]) if causal else None
+                m, num, den = _blockwise_update(
+                    qb, kbt, vbt, m, num, den, scale,
+                    mask=None if mask is None else mask[None, None],
+                )
+                kb = jax.lax.ppermute(kb, name, perm)
+                vb = jax.lax.ppermute(vb, name, perm)
+                return kb, vb, m, num, den
+
+            _, _, m, num, den = jax.lax.fori_loop(
+                0, size, body, (k_blk, v_blk, m0, num0, den0)
             )
-            kb = jax.lax.ppermute(kb, name, perm)
-            vb = jax.lax.ppermute(vb, name, perm)
-            return kb, vb, m, num, den
+            out = num / jnp.maximum(den, 1e-30)[..., None]  # (B, H, L, D)
+            return jnp.moveaxis(out, 1, 2).astype(q_blk.dtype)  # (B, L, H, D)
 
-        _, _, m, num, den = jax.lax.fori_loop(0, size, body, (k_blk, v_blk, m0, num0, den0))
-        out = num / jnp.maximum(den, 1e-30)[..., None]  # (B, H, L, D)
-        return jnp.moveaxis(out, 1, 2).astype(q_blk.dtype)  # (B, L, H, D)
+        return jax.shard_map(
+            kernel, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec
+        )
 
-    spec = PartitionSpec(None, name, None, None)
-    out = jax.jit(
-        jax.shard_map(kernel, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
-    )(q, k, v)
+    key = ("ring_attention.xla", comm, causal, B, S, H, D, str(q.dtype))
+    out = jitted(key, make_xla)(q, k, v)
     return out if batched else out[0]
 
 

@@ -23,6 +23,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec
 
+from ..core._compile import jitted
 from ..core.communication import XlaCommunication, get_comm
 from ..core.dndarray import DNDarray
 
@@ -99,12 +100,21 @@ def ulysses_attention(
         if size == 1 and local_kernel != "xla":
             # flash gates its own off-TPU/VMEM fallback; only engage it
             # when nothing is sharded (a Pallas call on a GSPMD-sharded
-            # global would force a gather)
-            out = flash_attention(q, k, v, causal=causal)
-        else:
-            out = jax.jit(_attention, static_argnames="causal")(
-                q, k, v, causal=causal
+            # global would force a gather).  'flash' forces the Pallas
+            # kernel (interpreted off-TPU) per the documented contract
+            out = flash_attention(
+                q, k, v, causal=causal,
+                interpret=(
+                    local_kernel == "flash"
+                    and jax.default_backend() != "tpu"
+                ),
             )
+        else:
+            # cached: a fresh jax.jit object per call would recompile
+            key = ("ulysses.fallback", causal, B, S, H, D, str(q.dtype))
+            out = jitted(
+                key, lambda: (lambda a, b, c: _attention(a, b, c, causal))
+            )(q, k, v)
         return out if batched else out[0]
 
     on_tpu = jax.default_backend() == "tpu"
@@ -124,37 +134,52 @@ def ulysses_attention(
         interp = not on_tpu  # CPU test suite: Pallas interpreter
         spec = PartitionSpec(None, name, None, None)
 
-        def kern(qb, kb, vb):  # local (B, L, H, D)
-            # seq→head swap as ONE explicit all-to-all per operand (the
-            # same collective GSPMD emits for the two-constraint form)
-            qh, kh, vh = (
-                jax.lax.all_to_all(t, name, split_axis=2, concat_axis=1, tiled=True)
-                for t in (qb, kb, vb)
-            )  # (B, S, H/p, D): full sequence per device
-            out = flash_attention(qh, kh, vh, causal=causal, interpret=interp)
-            # head→seq swap back to the caller's layout
-            return jax.lax.all_to_all(
-                out, name, split_axis=1, concat_axis=2, tiled=True
-            )
+        def make_flash():
+            def kern(qb, kb, vb):  # local (B, L, H, D)
+                # seq→head swap as ONE explicit all-to-all per operand
+                # (the same collective GSPMD emits for the
+                # two-constraint form)
+                qh, kh, vh = (
+                    jax.lax.all_to_all(
+                        t, name, split_axis=2, concat_axis=1, tiled=True
+                    )
+                    for t in (qb, kb, vb)
+                )  # (B, S, H/p, D): full sequence per device
+                out = flash_attention(qh, kh, vh, causal=causal, interpret=interp)
+                # head→seq swap back to the caller's layout
+                return jax.lax.all_to_all(
+                    out, name, split_axis=1, concat_axis=2, tiled=True
+                )
 
-        # check_vma=False: pallas_call under shard_map — see the
-        # identical note in ring_attention
-        out = jax.jit(
-            jax.shard_map(
+            # check_vma=False: pallas_call under shard_map — see the
+            # identical note in ring_attention
+            return jax.shard_map(
                 kern, mesh=mesh, in_specs=(spec, spec, spec),
                 out_specs=spec, check_vma=False,
             )
-        )(*(jax.device_put(t, seq_sh) for t in (q, k, v)))
+
+        # cached per config (a fresh jax.jit object per call would
+        # recompile the whole program on every invocation)
+        key = ("ulysses.flash", comm, causal, B, S, H, D, str(q.dtype))
+        out = jitted(key, make_flash)(
+            *(jax.device_put(t, seq_sh) for t in (q, k, v))
+        )
         return out if batched else out[0]
 
-    @jax.jit
-    def kernel(q, k, v):
-        # seq-sharded → head-sharded: GSPMD emits one all-to-all per operand
-        q_h, k_h, v_h = (jax.lax.with_sharding_constraint(t, head_sh) for t in (q, k, v))
-        out = _attention(q_h, k_h, v_h, causal)  # comm-free: full seq per head
-        # back to the caller's sequence sharding
-        return jax.lax.with_sharding_constraint(out, seq_sh)
+    def make_xla():
+        def kernel(q, k, v):
+            # seq-sharded → head-sharded: GSPMD emits one all-to-all
+            # per operand
+            q_h, k_h, v_h = (
+                jax.lax.with_sharding_constraint(t, head_sh) for t in (q, k, v)
+            )
+            out = _attention(q_h, k_h, v_h, causal)  # full seq per head
+            # back to the caller's sequence sharding
+            return jax.lax.with_sharding_constraint(out, seq_sh)
 
+        return kernel
+
+    key = ("ulysses.xla", comm, causal, B, S, H, D, str(q.dtype))
     q, k, v = (jax.device_put(t, seq_sh) for t in (q, k, v))
-    out = kernel(q, k, v)
+    out = jitted(key, make_xla)(q, k, v)
     return out if batched else out[0]

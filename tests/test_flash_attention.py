@@ -182,7 +182,9 @@ def test_ulysses_flash_rejects_nonconforming():
     comm = ht.get_comm()
     if comm.size == 1:
         pytest.skip("needs a mesh")
-    S, H = 8 * comm.size, 2 * comm.size  # S not a 128 multiple
+    # 25*size is mesh-divisible but never a 128-multiple for any mesh
+    # smaller than 128 devices (25 is odd, 128 = 2^7)
+    S, H = 25 * comm.size, 2 * comm.size
     q = jnp.asarray(RNG.normal(size=(S, H, 8)).astype(np.float32))
     qs = comm.apply_sharding(q, 0)
     with pytest.raises(ValueError, match="conforming"):
@@ -195,7 +197,7 @@ def test_ring_flash_rejects_nonconforming():
     comm = ht.get_comm()
     if comm.size == 1:
         pytest.skip("needs a mesh")
-    S = 8 * comm.size  # L=8: not a 128 multiple
+    S = 25 * comm.size  # L=25: never a 128 multiple, any mesh size
     q = jnp.asarray(RNG.normal(size=(S, 2, 8)).astype(np.float32))
     qs = comm.apply_sharding(q, 0)
     with pytest.raises(ValueError, match="conforming"):
