@@ -642,10 +642,17 @@ def _constrained_copy(array: jax.Array, sh: NamedSharding) -> jax.Array:
     ragged ``apply_sharding`` call site (not here: this helper is also
     the multi-process reshard path for divisible arrays)."""
 
-    def _f(x):
-        return jax.lax.with_sharding_constraint(x, sh)
+    from ._compile import jitted
 
-    return jax.jit(_f)(array)
+    def make():
+        def _f(x):
+            return jax.lax.with_sharding_constraint(x, sh)
+
+        return _f
+
+    # cached per target sharding: a fresh jax.jit object per call would
+    # recompile on every boundary commit
+    return jitted(("constrained_copy", sh), make)(array)
 
 
 def _reshard(array, sh: NamedSharding):

@@ -16,6 +16,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec
 
+from ..core._compile import jitted
 from ..core.communication import XlaCommunication, get_comm
 from ..core.dndarray import DNDarray
 
@@ -104,14 +105,27 @@ def ring_map(
             acc = acc[:, None]
         return acc
 
-    out = jax.jit(
-        jax.shard_map(
-            kernel,
-            mesh=mesh,
-            in_specs=PartitionSpec(name),
-            out_specs=PartitionSpec(None, name),
-        )
-    )(arr)
+    program = jax.shard_map(
+        kernel,
+        mesh=mesh,
+        in_specs=PartitionSpec(name),
+        out_specs=PartitionSpec(None, name),
+    )
+    # cached per (comm, fn) — but only for cache-STABLE fns: a
+    # module-level fn repeats its identity across calls, so the compiled
+    # ring program is reused.  Per-call lambdas/closures (anything
+    # defined inside a function — "<locals>" in the qualname — or
+    # carrying closure cells) get a transient jit (the old behavior):
+    # keying them would grow the global cache by one dead entry per call
+    # without ever hitting
+    if (
+        getattr(fn, "__closure__", None) is None
+        and "<locals>" not in getattr(fn, "__qualname__", "<locals>")
+        and getattr(fn, "__name__", "<lambda>") != "<lambda>"
+    ):
+        out = jitted(("ring_map", comm, fn), lambda: program)(arr)
+    else:
+        out = jax.jit(program)(arr)
     return out
 
 
@@ -161,13 +175,14 @@ def halo_exchange(
         next_halo = jax.lax.ppermute(head, name, bwd)  # zeros at last position
         return prev_halo, next_halo
 
-    prev, nxt = jax.jit(
-        jax.shard_map(
+    prev, nxt = jitted(
+        ("halo_exchange", comm, halo_size),
+        lambda: jax.shard_map(
             kernel,
             mesh=mesh,
             in_specs=PartitionSpec(name),
             out_specs=(PartitionSpec(name), PartitionSpec(name)),
-        )
+        ),
     )(arr)
     return prev, nxt
 
