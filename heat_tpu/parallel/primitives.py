@@ -105,27 +105,31 @@ def ring_map(
             acc = acc[:, None]
         return acc
 
-    program = jax.shard_map(
-        kernel,
-        mesh=mesh,
-        in_specs=PartitionSpec(name),
-        out_specs=PartitionSpec(None, name),
-    )
+    def make():
+        return jax.shard_map(
+            kernel,
+            mesh=mesh,
+            in_specs=PartitionSpec(name),
+            out_specs=PartitionSpec(None, name),
+        )
+
     # cached per (comm, fn) — but only for cache-STABLE fns: a
-    # module-level fn repeats its identity across calls, so the compiled
-    # ring program is reused.  Per-call lambdas/closures (anything
-    # defined inside a function — "<locals>" in the qualname — or
-    # carrying closure cells) get a transient jit (the old behavior):
-    # keying them would grow the global cache by one dead entry per call
-    # without ever hitting
+    # module-level plain function repeats its identity across calls, so
+    # the compiled ring program is reused.  Everything else — lambdas,
+    # closures (anything defined inside a function: "<locals>" in the
+    # qualname), bound methods (per-instance identity, possibly
+    # unhashable receiver) — gets a transient jit (the old behavior):
+    # keying on per-call identities would grow the global cache by one
+    # dead entry per call without ever hitting
     if (
         getattr(fn, "__closure__", None) is None
         and "<locals>" not in getattr(fn, "__qualname__", "<locals>")
         and getattr(fn, "__name__", "<lambda>") != "<lambda>"
+        and getattr(fn, "__self__", None) is None
     ):
-        out = jitted(("ring_map", comm, fn), lambda: program)(arr)
+        out = jitted(("ring_map", comm, fn), make)(arr)
     else:
-        out = jax.jit(program)(arr)
+        out = jax.jit(make())(arr)
     return out
 
 

@@ -161,7 +161,9 @@ def test_is_distributed():
 def test_absolute_and_numdims():
     x = ht.array([-1.0, 2.0, -3.0], split=0)
     np.testing.assert_array_equal(x.absolute().numpy(), [1.0, 2.0, 3.0])
-    assert x.numdims == x.ndim == 1
+    # numdims is the reference's deprecated alias: it must WARN and agree
+    with pytest.deprecated_call():
+        assert x.numdims == x.ndim == 1
 
 
 def test_save_method(tmp_path):
