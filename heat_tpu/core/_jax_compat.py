@@ -1,0 +1,100 @@
+"""Version portability for the handful of jax APIs that moved between the
+0.4.x line and current jax.
+
+The package is written against the current surface (``jax.shard_map``,
+``jax.enable_x64``, ``jax.lax.pcast``, ``ShapeDtypeStruct(vma=...)``,
+``pltpu.CompilerParams``); jax 0.4.x ships the same capabilities under
+older names (``jax.experimental.shard_map``, ``jax.experimental.enable_x64``,
+``check_rep`` instead of ``check_vma``, ``TPUCompilerParams``) and predates
+the varying-manual-axes type system entirely — there ``pcast`` is the
+identity and ``vma`` is dropped.  Every module imports these five names
+instead of reaching into jax directly, so the whole surface is patched in
+one place when the installed jax moves again.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+
+__all__ = [
+    "distributed_is_initialized",
+    "enable_x64",
+    "pcast",
+    "shape_dtype_struct",
+    "shard_map",
+    "tpu_compiler_params",
+]
+
+_HAS_VMA = hasattr(jax, "shard_map")  # the vma type system landed with it
+
+
+if _HAS_VMA:
+    _shard_map = jax.shard_map
+else:
+    from jax.experimental.shard_map import shard_map as _old_shard_map
+
+    def _shard_map(f, *, mesh, in_specs, out_specs, check_vma=None):
+        kw = {} if check_vma is None else {"check_rep": check_vma}
+        return _old_shard_map(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kw
+        )
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma=None):
+    """``jax.shard_map`` with the ``check_vma`` knob mapped to 0.4.x's
+    ``check_rep`` (same meaning: per-device output-type validation)."""
+    if _HAS_VMA:
+        kw = {} if check_vma is None else {"check_vma": check_vma}
+        return jax.shard_map(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kw
+        )
+    return _shard_map(
+        f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_vma=check_vma
+    )
+
+
+if hasattr(jax, "enable_x64"):
+    enable_x64 = jax.enable_x64
+else:
+    from jax.experimental import enable_x64  # noqa: F401  (0.4.x home)
+
+
+def pcast(x, axes, to="varying"):
+    """``jax.lax.pcast`` — aligns a fresh (axis-invariant) carry with the
+    varying loop values it will join.  Identity on 0.4.x, which has no
+    varying-type system (its ``check_rep`` infers replication per-op)."""
+    if hasattr(jax.lax, "pcast"):
+        return jax.lax.pcast(x, axes, to=to)
+    return x
+
+
+def shape_dtype_struct(shape, dtype, vma=()):
+    """``jax.ShapeDtypeStruct`` carrying ``vma`` only where jax knows the
+    kwarg (pallas_call out_shape under shard_map on current jax)."""
+    if _HAS_VMA:
+        return jax.ShapeDtypeStruct(shape, dtype, vma=frozenset(vma))
+    return jax.ShapeDtypeStruct(shape, dtype)
+
+
+def distributed_is_initialized():
+    """``jax.distributed.is_initialized()`` — on 0.4.x, read the client off
+    the distributed global state directly (same check, pre-public name)."""
+    if hasattr(jax.distributed, "is_initialized"):
+        return jax.distributed.is_initialized()
+    from jax._src import distributed
+
+    return distributed.global_state.client is not None
+
+
+@functools.lru_cache(maxsize=None)
+def _compiler_params_cls():
+    from jax.experimental.pallas import tpu as pltpu
+
+    return getattr(pltpu, "CompilerParams", None) or pltpu.TPUCompilerParams
+
+
+def tpu_compiler_params(**kwargs):
+    """``pltpu.CompilerParams`` (``TPUCompilerParams`` on 0.4.x)."""
+    return _compiler_params_cls()(**kwargs)

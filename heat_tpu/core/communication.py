@@ -56,6 +56,7 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 from ._compile import jitted
+from ._jax_compat import distributed_is_initialized, shard_map
 
 __all__ = [
     "Communication",
@@ -445,7 +446,7 @@ class XlaCommunication(Communication):
                 return jnp.prod(jax.lax.psum(stack, name), axis=0)
 
             def _f(x):
-                return jax.shard_map(
+                return shard_map(
                     kernel,
                     mesh=mesh,
                     in_specs=PartitionSpec(self.axis_name),
@@ -493,7 +494,7 @@ class XlaCommunication(Communication):
 
         def make():
             def _p(x):
-                return jax.shard_map(
+                return shard_map(
                     lambda s: jax.lax.ppermute(s, axis, perm),
                     mesh=mesh,
                     in_specs=PartitionSpec(axis),
@@ -608,7 +609,7 @@ class XlaCommunication(Communication):
                 return jax.lax.dynamic_slice_in_dim(_cum(stack), own, 1, axis=0)
 
             def _f(x):
-                return jax.shard_map(
+                return shard_map(
                     kernel,
                     mesh=mesh,
                     in_specs=PartitionSpec(name),
@@ -744,7 +745,7 @@ def init_multihost(
     Safe to call when the distributed runtime is already up — it then just
     (re)installs the all-devices communicator.
     """
-    if not jax.distributed.is_initialized():
+    if not distributed_is_initialized():
         try:
             jax.distributed.initialize(
                 coordinator_address=coordinator_address,

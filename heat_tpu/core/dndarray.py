@@ -53,6 +53,7 @@ import jax.numpy as jnp
 
 from . import types
 from ._compile import jitted
+from ._jax_compat import shard_map
 from .communication import Communication, sanitize_comm
 from .devices import Device
 from .stride_tricks import sanitize_axis
@@ -703,7 +704,7 @@ class DNDarray:
                 return jnp.concatenate([p, b, nx], axis=0)
 
             def _f(p, b, nx):
-                return jax.shard_map(
+                return shard_map(
                     kernel,
                     mesh=comm.mesh,
                     in_specs=(spec, spec, spec),

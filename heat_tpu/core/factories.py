@@ -295,12 +295,13 @@ def linspace(
     device, comm = _setup(device, comm)
     start_f, stop_f = float(start), float(stop)
     step = (stop_f - start_f) / max((num - (1 if endpoint else 0)), 1)
-    garr = jnp.linspace(start_f, stop_f, num, endpoint=endpoint, dtype=jnp.float32)
-    if dtype is not None:
-        dtype = types.canonical_heat_type(dtype)
-        garr = garr.astype(dtype.jax_type())
-    else:
-        dtype = types.float32
+    # build the grid in f64 and round ONCE into the target dtype: a grid
+    # computed directly in f32 (start + i*step per element) carries
+    # accumulated half-ulp errors that exceed rtol=1e-6 near zero
+    # crossings (x64 is on at import, so f64 is available)
+    garr = jnp.linspace(start_f, stop_f, num, endpoint=endpoint, dtype=jnp.float64)
+    dtype = types.canonical_heat_type(dtype) if dtype is not None else types.float32
+    garr = garr.astype(dtype.jax_type())
     split = sanitize_axis(garr.shape, split)
     ht = _wrap(garr, dtype, split, device, comm)
     if retstep:

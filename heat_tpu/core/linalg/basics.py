@@ -26,6 +26,7 @@ import numpy as np
 import jax.numpy as jnp
 
 from .. import factories, types
+from .._jax_compat import pcast, shard_map
 from ..communication import sanitize_comm
 from ..dndarray import DNDarray
 from ..sanitation import sanitize_in
@@ -123,7 +124,7 @@ def _summa_fn(sa: int, sb: int, comm, precision, chunk: int):
                 acc = acc + jnp.matmul(a_chunk, b_blk, precision=precision)
                 return jax.lax.ppermute(b_blk, axis, perm), acc
 
-            acc0 = jax.lax.pcast(
+            acc0 = pcast(
                 jnp.zeros((a_loc.shape[0], b_blk.shape[1]), a_loc.dtype),
                 (axis,), to="varying",
             )
@@ -147,7 +148,7 @@ def _summa_fn(sa: int, sb: int, comm, precision, chunk: int):
                 )
                 return jax.lax.ppermute(b_blk, axis, perm), acc
 
-            acc0 = jax.lax.pcast(
+            acc0 = pcast(
                 jnp.zeros((a_loc.shape[0], chunk * p), a_loc.dtype),
                 (axis,), to="varying",
             )
@@ -170,7 +171,7 @@ def _summa_fn(sa: int, sb: int, comm, precision, chunk: int):
                 acc = acc + jnp.matmul(a_blk, b_chunk, precision=precision)
                 return jax.lax.ppermute(a_blk, axis, perm), acc
 
-            acc0 = jax.lax.pcast(
+            acc0 = pcast(
                 jnp.zeros((a_blk.shape[0], b_loc.shape[1]), a_blk.dtype),
                 (axis,), to="varying",
             )
@@ -179,7 +180,7 @@ def _summa_fn(sa: int, sb: int, comm, precision, chunk: int):
 
         ins, outs = (P(None, axis), P(None, axis)), P(None, axis)
 
-    fn = jax.jit(jax.shard_map(kern, mesh=mesh, in_specs=ins, out_specs=outs))
+    fn = jax.jit(shard_map(kern, mesh=mesh, in_specs=ins, out_specs=outs))
     _SUMMA_CACHE[key] = fn
     return fn
 

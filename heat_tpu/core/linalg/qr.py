@@ -45,6 +45,7 @@ from jax.sharding import PartitionSpec
 
 from .. import factories, types
 from .._compile import jitted
+from .._jax_compat import shard_map
 from ..dndarray import DNDarray
 from ..sanitation import sanitize_in
 
@@ -53,17 +54,63 @@ __all__ = ["qr"]
 QR = collections.namedtuple("QR", "Q, R")
 
 
+def _tsqr_program(comm):
+    """The two-stage TSQR pipeline as a traceable ``f(x) -> (q, r)`` over
+    a shard-padded row-split operand: per-shard local QR inside shard_map,
+    a second QR of the small (size·n, n) R stack, and the Q-correction
+    matmul.  Module-level so bench.py can embed the EXACT production
+    compute graph inside its single-dispatch timing region; :func:`_tsqr`
+    wraps it in the keyed-jit cache.  A single-device mesh degenerates to
+    one on-device QR (what :func:`qr` dispatches there)."""
+    if comm.size == 1:
+        return jnp.linalg.qr
+
+    mesh = comm.mesh
+    axis = comm.axis_name
+
+    from .basics import _precision
+
+    def _local_qr(block):
+        q, r = jnp.linalg.qr(block)
+        return q, r  # plain tuple: QRResult confuses shard_map out_specs
+
+    local_qr = shard_map(
+        _local_qr,
+        mesh=mesh,
+        in_specs=PartitionSpec(axis, None),
+        out_specs=(PartitionSpec(axis, None), PartitionSpec(axis, None)),
+    )
+
+    def _combine(q1_blk, q2_blk):
+        return jnp.matmul(q1_blk, q2_blk, precision=_precision())
+
+    combine = shard_map(
+        _combine,
+        mesh=mesh,
+        in_specs=(PartitionSpec(axis, None), PartitionSpec(axis, None)),
+        out_specs=PartitionSpec(axis, None),
+    )
+
+    def _f(x):
+        q1, r1 = local_qr(x)  # q1: (padded_m, n) row-split; r1: (size*n, n)
+        # stage 2 on the R stack (size*n × n — small, replicated)
+        r1_full = jax.lax.with_sharding_constraint(r1, comm.sharding(2, None))
+        q2, r = jnp.linalg.qr(r1_full)  # q2: (size*n, n)
+        q = combine(q1, q2)
+        return q, r
+
+    return _f
+
+
 def _tsqr(a: DNDarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Two-stage TSQR on the mesh (replaces reference qr.py:303-816).
 
     Stage 1: per-shard local QR inside shard_map (runs on every device in
     parallel).  Stage 2: the (size·n, n) stack of R factors — tiny — is
-    QR'd once, and local Qs are corrected by the matching R-block.
+    QR'd again, and local Qs are corrected by the matching R-block.
     Handles any row count via canonical zero-padding.
     """
     comm = a.comm
-    mesh = comm.mesh
-    axis = comm.axis_name
     m, n = a.shape
     size = comm.size
     arr = a.larray
@@ -83,42 +130,7 @@ def _tsqr(a: DNDarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
         return jnp.linalg.qr(arr)
 
     arr_p = comm.pad_to_shards(arr, axis=0)
-
-    from .basics import _precision
-
-    def make():
-        def _local_qr(block):
-            q, r = jnp.linalg.qr(block)
-            return q, r  # plain tuple: QRResult confuses shard_map out_specs
-
-        local_qr = jax.shard_map(
-            _local_qr,
-            mesh=mesh,
-            in_specs=PartitionSpec(axis, None),
-            out_specs=(PartitionSpec(axis, None), PartitionSpec(axis, None)),
-        )
-
-        def _combine(q1_blk, q2_blk):
-            return jnp.matmul(q1_blk, q2_blk, precision=_precision())
-
-        combine = jax.shard_map(
-            _combine,
-            mesh=mesh,
-            in_specs=(PartitionSpec(axis, None), PartitionSpec(axis, None)),
-            out_specs=PartitionSpec(axis, None),
-        )
-
-        def _f(x):
-            q1, r1 = local_qr(x)  # q1: (padded_m, n) row-split; r1: (size*n, n)
-            # stage 2 on the R stack (size*n × n — small, replicated)
-            r1_full = jax.lax.with_sharding_constraint(r1, comm.sharding(2, None))
-            q2, r = jnp.linalg.qr(r1_full)  # q2: (size*n, n)
-            q = combine(q1, q2)
-            return q, r
-
-        return _f
-
-    q, r = jitted(("qr.tsqr", comm), make)(arr_p)
+    q, r = jitted(("qr.tsqr", comm), lambda: _tsqr_program(comm))(arr_p)
     return comm.unpad(q, m, 0), r
 
 

@@ -26,6 +26,9 @@ import os
 
 import numpy as np
 import jax as _jax
+from functools import partial as _partial
+from .._jax_compat import enable_x64 as _enable_x64
+_x64_off = _partial(_enable_x64, False)
 import jax.numpy as jnp
 
 
@@ -75,7 +78,7 @@ def _small_svd(r: jnp.ndarray):
         # on an (n, n) triangle is the right tool (one tiny transfer)
         ur, s, vt = np.linalg.svd(np.asarray(r), full_matrices=False)
         return jnp.asarray(ur, r.dtype), jnp.asarray(s, r.dtype), jnp.asarray(vt, r.dtype)
-    with _jax.enable_x64(False):
+    with _x64_off():
         return _jitted_svd(r)
 
 
@@ -85,7 +88,7 @@ def _small_singvals(r: jnp.ndarray):
     x64-on default is the documented crash combination on TPU)."""
     if _host_svd() or r.dtype == jnp.float64:
         return jnp.asarray(np.linalg.svd(np.asarray(r), compute_uv=False), r.dtype)
-    with _jax.enable_x64(False):
+    with _x64_off():
         return _jitted_singvals(r)
 
 

@@ -15,14 +15,23 @@ ever touches HBM.  Measured on v5e at S=4096 H=16 D=64 bf16:
 
 Layout: grid (batch*heads, S/BQ); each program pins its q block plus the
 full local K/V in VMEM and streams K/V through the running softmax in
-BK-sized chunks carried in registers.  Design notes from the measured
-alternatives (same shapes, v5e):
+BK-sized chunks carried in registers.  Causal attention runs on a
+TRIANGULAR schedule: the k-chunk loop bounds are per-program values from
+``_causal_chunk_bounds`` — chunks wholly below the diagonal fold with no
+mask, the one-or-two chunks straddling it fold with the element mask,
+and chunks wholly above it are never visited at all (a dynamic-bound
+``fori_loop`` lowers to a plain `while` on Mosaic, so the skipped chunks
+cost zero MXU work — unlike a value-level ``lax.cond``, which lowers to
+compute-both-select).  Causal also clamps BK to BQ: with BK=2048 a
+512-row q block's diagonal chunk is 87% masked work, while BK=BQ=512
+bounds the masked fraction of visited tiles by ~1/(2n).  Design notes
+from the measured alternatives (same shapes, v5e):
 - a third k grid dimension with scratch accumulators: 24-42 TF/s — the
   per-chunk scratch round-trips and small DMAs dominate;
 - VMEM scratch accumulators instead of loop carries: 24 TF/s;
-- causal tail skip via ``lax.cond``: Mosaic lowers the value-level cond
-  to compute-both-select, so causal saves little — kept because it is
-  free, but the real causal win would need a triangular grid.
+- causal tail skip via ``lax.cond`` (the pre-triangular scheme): Mosaic
+  lowers the value-level cond to compute-both-select, which pinned
+  causal at ~31 TF/s — the same masked half computed and discarded.
 
 Falls back to the jnp path (XLA-fused, HBM-bound but correct) off-TPU
 unless ``interpret=True`` (used by the CPU test suite), and for local
@@ -33,12 +42,15 @@ path shards the sequence before this kernel sees it).
 from __future__ import annotations
 
 import functools
+from contextlib import nullcontext as _nullcontext
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
+
+from ..core._jax_compat import enable_x64, shape_dtype_struct, tpu_compiler_params
 
 __all__ = ["flash_attention", "flash_attention_partial"]
 
@@ -47,29 +59,49 @@ __all__ = ["flash_attention", "flash_attention_partial"]
 _VMEM_LIMIT = 100 * 1024 * 1024
 
 
+def _causal_chunk_bounds(q_lo, k_lo, bq, block_k, nk):
+    """Triangular trip counts for one q block against an ``nk``-chunk K
+    span: chunk ``j`` covers k positions [k_lo + j*bk, k_lo + (j+1)*bk).
+    Returns ``(full, total)`` with chunks [0, full) wholly unmasked
+    (last k position <= q_lo, the smallest q position), [full, total)
+    straddling the diagonal (element mask needed), and [total, nk) wholly
+    masked — never visited.  ``full <= total`` always.  Accepts python
+    ints (tests, schedule planning) or traced i32 (kernel bodies, where
+    ring round offsets are runtime values); floor division keeps the
+    clamps right for negative offsets (q entirely before k: total = 0).
+
+    THE one trip-count rule — _stream_kv's loop bounds and the tile-count
+    test both read it, so the kernel cannot silently regress to n^2."""
+    full = jnp.clip((q_lo - k_lo + 1) // block_k, 0, nk)
+    total = jnp.clip((q_lo + bq - 1 - k_lo) // block_k + 1, 0, nk)
+    return full, total
+
+
 def _stream_kv(q, k_ref, v_ref, m0, l0, acc0, *, scale, causal, prec,
                q_lo, k_lo, block_k):
-    """Shared streaming-softmax core: fold every ``block_k`` chunk of the
+    """Shared streaming-softmax core: fold ``block_k`` chunks of the
     VMEM-resident K/V into the running (m, l, acc), carried in registers.
     ``q_lo``/``k_lo`` are the GLOBAL positions of q row 0 / k row 0 (i32
     scalars — traced in the partial form, where ring round offsets are
-    runtime values)."""
+    runtime values).  Causal folds run the triangular schedule: unmasked
+    chunks then diagonal chunks, with per-program dynamic loop bounds
+    from ``_causal_chunk_bounds`` (chunks past the diagonal are never
+    visited — Mosaic lowers a dynamic-bound fori_loop to a plain while,
+    NOT compute-both-select)."""
     bq = q.shape[0]
     nk = k_ref.shape[1] // block_k
-    last_q = q_lo + bq - 1
 
-    def body(j, carry):
-        start = j * block_k
-
-        def update(c):
-            m, l, acc = c
+    def make_fold(masked):
+        def fold(j, carry):
+            m, l, acc = carry
+            start = j * block_k
             k_blk = k_ref[0, pl.ds(start, block_k), :]
             v_blk = v_ref[0, pl.ds(start, block_k), :]
             scores = jax.lax.dot_general(
                 q, k_blk, (((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32, precision=prec,
             ) * scale  # (BQ, BK) f32
-            if causal:
+            if masked:
                 q_pos = q_lo + jax.lax.broadcasted_iota(
                     jnp.int32, (bq, block_k), 0
                 )
@@ -81,7 +113,7 @@ def _stream_kv(q, k_ref, v_ref, m0, l0, acc0, *, scale, causal, prec,
             m_new = jnp.maximum(m, jnp.max(scores, axis=-1))
             safe_m = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
             p = jnp.exp(scores - safe_m[:, None])
-            if causal:
+            if masked:
                 p = jnp.where(keep, p, 0.0)
             corr = jnp.where(jnp.isfinite(m), jnp.exp(m - safe_m), 0.0)
             acc = acc * corr[:, None] + jax.lax.dot_general(
@@ -93,16 +125,13 @@ def _stream_kv(q, k_ref, v_ref, m0, l0, acc0, *, scale, causal, prec,
             l = l * corr + jnp.sum(p, axis=-1)
             return m_new, l, acc
 
-        if causal:
-            # chunks wholly past this q block's diagonal contribute
-            # nothing (the cond is select-both on Mosaic — see module
-            # docstring — but costs nothing to keep)
-            return jax.lax.cond(
-                k_lo + start <= last_q, update, lambda c: c, carry
-            )
-        return update(carry)
+        return fold
 
-    return jax.lax.fori_loop(0, nk, body, (m0, l0, acc0))
+    if not causal:
+        return jax.lax.fori_loop(0, nk, make_fold(False), (m0, l0, acc0))
+    full, total = _causal_chunk_bounds(q_lo, k_lo, bq, block_k, nk)
+    carry = jax.lax.fori_loop(0, full, make_fold(False), (m0, l0, acc0))
+    return jax.lax.fori_loop(full, total, make_fold(True), carry)
 
 
 def _kernel(q_ref, k_ref, v_ref, o_ref, *, scale, causal, q_base, block_k):
@@ -174,6 +203,10 @@ def conforms(seq_len: int, d: int, dtype) -> bool:
     return (
         seq_len % 128 == 0
         and dt != jnp.float64
+        # floating REQUIRED: promote_types alone admits int/bool (they
+        # promote to f32 weakly) and the kernel's -inf/exp algebra is
+        # meaningless for them
+        and jnp.issubdtype(dt, jnp.floating)
         and jnp.promote_types(dt, jnp.float32) == jnp.float32
         and 4 * seq_len * d * dt.itemsize <= _VMEM_LIMIT // 2
     )
@@ -251,13 +284,16 @@ def flash_attention(
         or S % 128
         or Sk % 128
         or q.dtype == jnp.float64
+        or not jnp.issubdtype(q.dtype, jnp.floating)  # same gate as conforms()
         or kv_bytes > _VMEM_LIMIT // 2
     ):
         out = _jnp_fallback(q, k, v, causal, q_base=q_base)
         return out if batched else out[0]
 
     bq = _pick_block(S, block_q)
-    bk = _pick_block(Sk, block_k)
+    # causal: clamp BK to BQ so the triangular schedule's savings survive
+    # the chunking — at BK >> BQ the diagonal chunk is mostly masked work
+    bk = _pick_block(Sk, min(block_k, bq) if causal else block_k)
 
     # (B, H, S, D) so the grid can address (batch*heads, q-block)
     qt, kt, vt = (jnp.moveaxis(t, 2, 1).reshape(B * H, -1, D) for t in (q, k, v))
@@ -268,8 +304,12 @@ def flash_attention(
     # under the package's x64-on default, python-int literals in index
     # maps and grid arithmetic trace as i64, which Mosaic rejects; the
     # x64-off context makes them i32 (same guard as linalg/svd.py — the
-    # operands are already-typed tracers, so only index dtypes change)
-    with jax.enable_x64(False):
+    # operands are already-typed tracers, so only index dtypes change).
+    # NOT under interpret: the 0.4.x interpreter builds its grid loop at
+    # LOWERING time with config-current index widths, so tracing x64-off
+    # while lowering x64-on mixes i32/i64 in one op; the interpreter is
+    # happy with i64 throughout, so it just skips the flip
+    with _nullcontext() if interpret else enable_x64(False):
         out = pl.pallas_call(
             kern,
             grid=(B * H, S // bq),
@@ -280,7 +320,7 @@ def flash_attention(
             ],
             out_specs=pl.BlockSpec((1, bq, D), lambda bh, qi: (bh, qi, 0)),
             out_shape=jax.ShapeDtypeStruct((B * H, S, D), q.dtype),
-            compiler_params=pltpu.CompilerParams(
+            compiler_params=tpu_compiler_params(
                 dimension_semantics=("parallel", "parallel"),
                 vmem_limit_bytes=_VMEM_LIMIT,
             ),
@@ -333,7 +373,8 @@ def flash_attention_partial(
     )
     state_q = lambda bh, qi: (bh, qi, 0)
     whole_k = lambda bh, qi: (bh, 0, 0)
-    with jax.enable_x64(False):
+    # x64 flip only for the Mosaic path — see flash_attention
+    with _nullcontext() if interpret else enable_x64(False):
         m_o, l_o, acc = pl.pallas_call(
             kern,
             grid=(BH, Lq // bq),
@@ -352,11 +393,11 @@ def flash_attention_partial(
                 pl.BlockSpec((1, bq, D), state_q),
             ],
             out_shape=[
-                jax.ShapeDtypeStruct((BH, Lq, 1), jnp.float32, vma=frozenset(vma_axes)),
-                jax.ShapeDtypeStruct((BH, Lq, 1), jnp.float32, vma=frozenset(vma_axes)),
-                jax.ShapeDtypeStruct((BH, Lq, D), jnp.float32, vma=frozenset(vma_axes)),
+                shape_dtype_struct((BH, Lq, 1), jnp.float32, vma=vma_axes),
+                shape_dtype_struct((BH, Lq, 1), jnp.float32, vma=vma_axes),
+                shape_dtype_struct((BH, Lq, D), jnp.float32, vma=vma_axes),
             ],
-            compiler_params=pltpu.CompilerParams(
+            compiler_params=tpu_compiler_params(
                 dimension_semantics=("parallel", "parallel"),
                 vmem_limit_bytes=_VMEM_LIMIT,
             ),

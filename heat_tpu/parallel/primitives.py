@@ -17,6 +17,7 @@ import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec
 
 from ..core._compile import jitted
+from ..core._jax_compat import pcast, shard_map
 from ..core.communication import XlaCommunication, get_comm
 from ..core.dndarray import DNDarray
 
@@ -27,6 +28,11 @@ __all__ = [
     "prefix_sum",
     "ring_map",
     "ring_source",
+    "zigzag_chunk_owner",
+    "zigzag_inverse_perms",
+    "zigzag_merge",
+    "zigzag_perms",
+    "zigzag_split",
 ]
 
 
@@ -97,7 +103,7 @@ def ring_map(
         acc0 = jnp.zeros((size,) + probe.shape, probe.dtype)
         # freshly-created carries are axis-invariant; the loop makes them
         # varying over the mesh axis — align the types up front
-        acc0 = jax.lax.pcast(acc0, (name,), to="varying")
+        acc0 = pcast(acc0, (name,), to="varying")
         _, acc = jax.lax.fori_loop(0, size, body, (stationary, acc0))
         if probe.ndim == 0:
             # scalar per round: materialize the per-position axis so the
@@ -106,7 +112,7 @@ def ring_map(
         return acc
 
     def make():
-        return jax.shard_map(
+        return shard_map(
             kernel,
             mesh=mesh,
             in_specs=PartitionSpec(name),
@@ -181,7 +187,7 @@ def halo_exchange(
 
     prev, nxt = jitted(
         ("halo_exchange", comm, halo_size),
-        lambda: jax.shard_map(
+        lambda: shard_map(
             kernel,
             mesh=mesh,
             in_specs=PartitionSpec(name),
@@ -256,7 +262,7 @@ def _prefix_scan_jit(arr, op: str, comm: XlaCommunication, axis: int):
         return local * acc.astype(local.dtype)
 
     spec = comm.spec(arr.ndim, 0)
-    out = jax.shard_map(kernel, mesh=mesh, in_specs=spec, out_specs=spec)(arr)
+    out = shard_map(kernel, mesh=mesh, in_specs=spec, out_specs=spec)(arr)
     out = comm.unpad(out, n, axis=0)
     return jnp.moveaxis(out, 0, axis) if axis != 0 else out
 
@@ -286,3 +292,73 @@ def all_to_all_resplit(
     arr, comm = _unpack(x, comm)
     del from_axis  # the array's current sharding already encodes it
     return comm.apply_sharding(arr, to_axis)
+
+
+def zigzag_chunk_owner(c: int, size: int) -> int:
+    """Zig-zag home device of sequence half-chunk ``c`` (0 <= c < 2*size):
+    device ``i`` holds the mirrored pair ``(i, 2*size-1-i)``.  Under a
+    causal mask this pairing gives every device the same attention work
+    per ring round — contiguous sharding instead gives device 0 one
+    non-empty round and device size-1 all of them."""
+    return c if c < size else 2 * size - 1 - c
+
+
+def zigzag_perms(size: int):
+    """Forward resplit schedule, contiguous → zig-zag, as two ppermute
+    permutations.  Contiguous device ``i`` holds half-chunks (2i, 2i+1);
+    the first stream carries every device's first half, the second its
+    second half, each to the chunk's zig-zag home — both are bijections
+    because ``zigzag_chunk_owner`` maps evens and odds one-to-one."""
+    first = [(i, zigzag_chunk_owner(2 * i, size)) for i in range(size)]
+    second = [(i, zigzag_chunk_owner(2 * i + 1, size)) for i in range(size)]
+    return first, second
+
+
+def zigzag_inverse_perms(size: int):
+    """Inverse resplit schedule, zig-zag → contiguous.  Zig-zag device
+    ``d`` holds chunks (d, 2*size-1-d) — exactly one even, one odd.  The
+    even-chunk stream lands as its receiver's first local half (chunk 2i
+    → device i), the odd-chunk stream as the second half."""
+    even = [(d, (d if d % 2 == 0 else 2 * size - 1 - d) // 2)
+            for d in range(size)]
+    odd = [(d, ((2 * size - 1 - d) if d % 2 == 0 else d) // 2)
+           for d in range(size)]
+    return even, odd
+
+
+def zigzag_split(x, axis: int, axis_name: str, size: int):
+    """Contiguous local block → zig-zag ``(lo, hi)`` half-chunks.
+
+    Traced INSIDE shard_map: ``x`` is device ``i``'s contiguous local
+    block whose ``axis`` covers global rows [i*L, (i+1)*L); the result is
+    the device's zig-zag pair — ``lo`` = half-chunk ``i`` (global rows
+    [i*Lh, (i+1)*Lh)), ``hi`` = half-chunk ``2*size-1-i`` — moved with
+    two ppermutes (one per local half).  ``axis`` length must be even.
+    """
+    L = x.shape[axis]
+    lh = L // 2
+    first = jax.lax.slice_in_dim(x, 0, lh, axis=axis)
+    second = jax.lax.slice_in_dim(x, lh, L, axis=axis)
+    pf, ps = zigzag_perms(size)
+    a = jax.lax.ppermute(first, axis_name, pf)
+    b = jax.lax.ppermute(second, axis_name, ps)
+    # chunk i arrived on the stream matching its parity: even chunks ride
+    # the first-half stream (2i' is even), odd ones the second
+    even = jax.lax.axis_index(axis_name) % 2 == 0
+    lo = jnp.where(even, a, b)
+    hi = jnp.where(even, b, a)
+    return lo, hi
+
+
+def zigzag_merge(lo, hi, axis: int, axis_name: str, size: int):
+    """Inverse of :func:`zigzag_split`: the zig-zag pair back to the
+    contiguous local block (traced inside shard_map)."""
+    even = jax.lax.axis_index(axis_name) % 2 == 0
+    # device d's even-indexed chunk is d itself when d is even, else its
+    # mirror 2*size-1-d
+    even_chunk = jnp.where(even, lo, hi)
+    odd_chunk = jnp.where(even, hi, lo)
+    pe, po = zigzag_inverse_perms(size)
+    first = jax.lax.ppermute(even_chunk, axis_name, pe)
+    second = jax.lax.ppermute(odd_chunk, axis_name, po)
+    return jnp.concatenate([first, second], axis=axis)

@@ -25,6 +25,7 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec
 
 from ..core._compile import jitted
+from ..core._jax_compat import pcast, shard_map
 from ..core.communication import XlaCommunication, get_comm
 from ..core.dndarray import DNDarray
 
@@ -80,6 +81,20 @@ def ring_attention(
     into the running softmax.  ``causal=True`` applies the global causal
     mask using each block's ring-origin offset.
 
+    Causal masking is load-balanced with the ZIG-ZAG layout whenever
+    S is divisible by 2*size (and, for the flash engine, the half-chunk
+    S/(2*size) is a 128-multiple): the inputs are resplit in-ring
+    (primitives.zigzag_split) so device ``i`` holds sequence half-chunks
+    ``i`` and ``2*size-1-i``, which makes every device's per-round work
+    exactly two wholly-unmasked half-chunk updates — no fully-masked
+    tile is ever computed, and no device waits on a longer-diagonal
+    peer.  The output is resplit back to contiguous, so the layout is
+    invisible to callers.  When the zig-zag shape conditions fail, the
+    contiguous layout is kept: the flash engine still skips masked work
+    per-program (the triangular kernel's dynamic trip counts make
+    fully-masked rounds cost zero folds) but rounds are unbalanced; the
+    XLA engine masks and discards.
+
     ``local_kernel`` picks the per-round block engine:
     - ``"auto"``: the fused Pallas partial kernel
       (flash_attention_partial) on TPU when the local block conforms
@@ -126,14 +141,12 @@ def ring_attention(
                 "(128-multiple, f32/bf16, within the VMEM budget); use "
                 "'auto' for the silent fallback"
             )
-        if local_kernel == "xla":
-            key = ("ring_attention.single_xla", causal, B, S, H, D, str(q.dtype))
-            out = jitted(
-                key, lambda: (lambda a, b, c: _jnp_fallback(a, b, c, causal))
-            )(q, k, v)
-        else:
-            # 'auto' lets flash gate its own fallback; 'flash' forces the
-            # Pallas kernel (interpreted off-TPU)
+        if size == 1 and local_kernel != "xla":
+            # flash gates its own off-TPU/VMEM fallback; only engage it
+            # when nothing is sharded — a Pallas call on a GSPMD-sharded
+            # global (size > 1, S not mesh-divisible) would silently
+            # replicate the whole computation per device.  'flash' forces
+            # the Pallas kernel (interpreted off-TPU)
             out = flash_attention(
                 q, k, v, causal=causal,
                 interpret=(
@@ -141,12 +154,31 @@ def ring_attention(
                     and jax.default_backend() != "tpu"
                 ),
             )
+        else:
+            # sharded-but-indivisible (or forced XLA): the jitted jnp
+            # path, GSPMD-planned over the existing sharding (mirrors the
+            # ulysses fallback branch)
+            key = ("ring_attention.single_xla", causal, B, S, H, D, str(q.dtype))
+            out = jitted(
+                key, lambda: (lambda a, b, c: _jnp_fallback(a, b, c, causal))
+            )(q, k, v)
         return out if batched else out[0]
 
     mesh, name = comm.mesh, comm.axis_name
     L = S // size
+    Lh = L // 2
     perm = [(i, (i + 1) % size) for i in range(size)]
     spec = PartitionSpec(None, name, None, None)
+
+    # Causal load balancing: under contiguous sharding device 0's queries
+    # see one non-empty round while device size-1's see all of them, so
+    # the ring runs at the slowest device's pace.  The zig-zag layout
+    # (primitives.zigzag_split: device i holds sequence half-chunks i and
+    # 2*size-1-i) gives every device exactly two wholly-unmasked
+    # half-chunk attention updates per round — equal work, and no
+    # fully-masked pair is ever computed (the always-masked (low-q,
+    # high-k) pair is statically absent).  Needs S % (2*size) == 0.
+    zigzag = causal and S % (2 * size) == 0
 
     on_tpu = jax.default_backend() == "tpu"
     from .flash_attention import conforms
@@ -166,9 +198,120 @@ def ring_attention(
 
     if use_flash:
         from .flash_attention import flash_attention_partial
+        from .primitives import zigzag_merge, zigzag_split
 
         interp = not on_tpu  # CPU test suite: Pallas interpreter
 
+        def make_flash_zigzag():
+            def kernel(q_blk, k_blk, v_blk):
+                qf = jnp.moveaxis(q_blk, 2, 1).reshape(B * H, L, D)
+                kf = jnp.moveaxis(k_blk, 2, 1).reshape(B * H, L, D)
+                vf = jnp.moveaxis(v_blk, 2, 1).reshape(B * H, L, D)
+                my = jax.lax.axis_index(name)
+                q_lo, q_hi = zigzag_split(qf, 1, name, size)
+                k_lo, k_hi = zigzag_split(kf, 1, name, size)
+                v_lo, v_hi = zigzag_split(vf, 1, name, size)
+                # rotate the zig-zag pair as one buffer: rows [:Lh] are
+                # the origin's low chunk j, rows [Lh:] its high mirror
+                # 2*size-1-j
+                kz = jnp.concatenate([k_lo, k_hi], 1)
+                vz = jnp.concatenate([v_lo, v_hi], 1)
+                base_lo = my * Lh
+                base_hi = (2 * size - 1 - my) * Lh
+
+                def init():
+                    return (
+                        pcast(jnp.full((B * H, Lh), -jnp.inf, jnp.float32),
+                              (name,), to="varying"),
+                        pcast(jnp.zeros((B * H, Lh), jnp.float32),
+                              (name,), to="varying"),
+                        pcast(jnp.zeros((B * H, Lh, D), jnp.float32),
+                              (name,), to="varying"),
+                    )
+
+                def fold(qh, kseg, vseg, st, diag, q_base, k_base):
+                    # diag=False pairs are wholly unmasked by layout:
+                    # causal=False skips the kernel's bounds/mask logic
+                    # AND keeps the (effectful, axis_index-derived) bases
+                    # out of the program
+                    return flash_attention_partial(
+                        qh, kseg, vseg, *st,
+                        q_base=q_base, k_base=k_base,
+                        causal=diag, interpret=interp,
+                        vma_axes=() if interp else (name,),
+                    )
+
+                # round 0 — the origin is this device: the two diagonal
+                # Lh-tiles (the ONLY masked folds in the whole program)
+                # plus the always-full (high-q, low-k) pair
+                st_lo = fold(q_lo, kz[:, :Lh], vz[:, :Lh], init(),
+                             True, base_lo, base_lo)
+                st_hi = fold(q_hi, kz[:, :Lh], vz[:, :Lh], init(),
+                             False, 0, 0)
+                st_hi = fold(q_hi, kz[:, Lh:], vz[:, Lh:], st_hi,
+                             True, base_hi, base_hi)
+                kz = jax.lax.ppermute(kz, name, perm)
+                vz = jax.lax.ppermute(vz, name, perm)
+
+                def body(r, carry):
+                    kz, vz, m_lo, l_lo, a_lo, m_hi, l_hi, a_hi = carry
+                    j = (my - r) % size  # visiting pair's home device
+                    ks, vs = kz[:, :Lh], vz[:, :Lh]  # chunk j
+                    kh, vh = kz[:, Lh:], vz[:, Lh:]  # chunk 2*size-1-j
+                    # (q_hi, chunk j): high-q rows are past every low
+                    # chunk — always wholly unmasked
+                    m_hi, l_hi, a_hi = fold(
+                        q_hi, ks, vs, (m_hi, l_hi, a_hi), False, 0, 0
+                    )
+                    # second pair: (q_lo, chunk j) when j < my, else
+                    # (q_hi, chunk 2*size-1-j) — wholly unmasked either
+                    # way, so every round costs exactly two full tiles
+                    sel = j < my
+                    q2 = jnp.where(sel, q_lo, q_hi)
+                    k2 = jnp.where(sel, ks, kh)
+                    v2 = jnp.where(sel, vs, vh)
+                    st2 = tuple(
+                        jnp.where(sel, a, b)
+                        for a, b in zip((m_lo, l_lo, a_lo), (m_hi, l_hi, a_hi))
+                    )
+                    m2, l2, a2 = fold(q2, k2, v2, st2, False, 0, 0)
+                    m_lo, l_lo, a_lo = (
+                        jnp.where(sel, n, o)
+                        for n, o in zip((m2, l2, a2), (m_lo, l_lo, a_lo))
+                    )
+                    m_hi, l_hi, a_hi = (
+                        jnp.where(sel, o, n)
+                        for n, o in zip((m2, l2, a2), (m_hi, l_hi, a_hi))
+                    )
+                    kz = jax.lax.ppermute(kz, name, perm)
+                    vz = jax.lax.ppermute(vz, name, perm)
+                    return kz, vz, m_lo, l_lo, a_lo, m_hi, l_hi, a_hi
+
+                _, _, m_lo, l_lo, a_lo, m_hi, l_hi, a_hi = jax.lax.fori_loop(
+                    1, size, body, (kz, vz, *st_lo, *st_hi)
+                )
+                out_lo = a_lo / jnp.maximum(l_lo, 1e-30)[..., None]
+                out_hi = a_hi / jnp.maximum(l_hi, 1e-30)[..., None]
+                out = zigzag_merge(out_lo, out_hi, 1, name, size)
+                out = jnp.moveaxis(out.reshape(B, H, L, D), 1, 2)
+                return out.astype(q_blk.dtype)
+
+            # check_vma off around pallas_call — see make_flash below
+            return shard_map(
+                kernel, mesh=mesh, in_specs=(spec, spec, spec),
+                out_specs=spec, check_vma=False,
+            )
+
+        if zigzag and conforms(Lh, D, q.dtype):
+            key = ("ring_attention.flash_zz", comm, B, S, H, D, str(q.dtype))
+            out = jitted(key, make_flash_zigzag)(q, k, v)
+            return out if batched else out[0]
+
+        # contiguous layout: non-causal, or a causal shape the zig-zag
+        # halves cannot conform to (Lh not a 128-multiple).  Causal here
+        # is still triangular — the partial kernel's dynamic trip counts
+        # make fully-masked rounds cost zero folds — just not
+        # load-balanced across the ring.
         def make_flash():
             def kernel(q_blk, k_blk, v_blk):
                 # (B, L, H, D) → (B*H, L, D) once, OUTSIDE the ring loop
@@ -177,22 +320,25 @@ def ring_attention(
                 qf = jnp.moveaxis(q_blk, 2, 1).reshape(B * H, L, D)
                 kf = jnp.moveaxis(k_blk, 2, 1).reshape(B * H, L, D)
                 vf = jnp.moveaxis(v_blk, 2, 1).reshape(B * H, L, D)
-                my = jax.lax.axis_index(name)
+                # axis_index only when the mask offsets are real: it is
+                # effectful, so jax will not DCE it when unused, and an
+                # unused partition_id breaks XLA's SPMD sharding inference
+                my = jax.lax.axis_index(name) if causal else 0
                 # carries pcast to varying (like the XLA kernel's
                 # m0/num0/den0 below)
-                m0 = jax.lax.pcast(
+                m0 = pcast(
                     jnp.full((B * H, L), -jnp.inf, jnp.float32), (name,), to="varying"
                 )
-                l0 = jax.lax.pcast(
+                l0 = pcast(
                     jnp.zeros((B * H, L), jnp.float32), (name,), to="varying"
                 )
-                acc0 = jax.lax.pcast(
+                acc0 = pcast(
                     jnp.zeros((B * H, L, D), jnp.float32), (name,), to="varying"
                 )
 
                 def body(r, carry):
                     kb, vb, m, l, acc = carry
-                    origin = (my - r) % size
+                    origin = (my - r) % size if causal else 0
                     m, l, acc = flash_attention_partial(
                         qf, kb, vb, m, l, acc,
                         q_base=my * L, k_base=origin * L,
@@ -218,13 +364,99 @@ def ring_attention(
             # per-device-pure (carries are pcast varying, all
             # collectives are the explicit ppermutes); the XLA
             # local-kernel path below keeps validation on.
-            return jax.shard_map(
+            return shard_map(
                 kernel, mesh=mesh, in_specs=(spec, spec, spec),
                 out_specs=spec, check_vma=False,
             )
 
         key = ("ring_attention.flash", comm, causal, B, S, H, D, str(q.dtype))
         out = jitted(key, make_flash)(q, k, v)
+        return out if batched else out[0]
+
+    def make_xla_zigzag():
+        from .primitives import zigzag_merge, zigzag_split
+
+        def kernel(q_blk, k_blk, v_blk):
+            my = jax.lax.axis_index(name)
+            q_lo, q_hi = zigzag_split(q_blk, 1, name, size)
+            k_lo, k_hi = zigzag_split(k_blk, 1, name, size)
+            v_lo, v_hi = zigzag_split(v_blk, 1, name, size)
+            qlo = jnp.moveaxis(q_lo, 2, 1)  # (B, H, Lh, D)
+            qhi = jnp.moveaxis(q_hi, 2, 1)
+            kz = jnp.concatenate(
+                [jnp.moveaxis(k_lo, 2, 1), jnp.moveaxis(k_hi, 2, 1)], 2
+            )
+            vz = jnp.concatenate(
+                [jnp.moveaxis(v_lo, 2, 1), jnp.moveaxis(v_hi, 2, 1)], 2
+            )
+            # the only masked tiles in the whole program: the two round-0
+            # diagonal Lh-triangles (their global base offsets cancel, so
+            # one static triangular mask serves both)
+            tri = (jnp.arange(Lh)[:, None] >= jnp.arange(Lh)[None, :])[None, None]
+
+            def init():
+                return (
+                    pcast(jnp.full((B, H, Lh), -jnp.inf, acc_dt), (name,), to="varying"),
+                    pcast(jnp.zeros((B, H, Lh, D), acc_dt), (name,), to="varying"),
+                    pcast(jnp.zeros((B, H, Lh), acc_dt), (name,), to="varying"),
+                )
+
+            st_lo = _blockwise_update(
+                qlo, kz[:, :, :Lh], vz[:, :, :Lh], *init(), scale, mask=tri
+            )
+            st_hi = _blockwise_update(
+                qhi, kz[:, :, :Lh], vz[:, :, :Lh], *init(), scale
+            )
+            st_hi = _blockwise_update(
+                qhi, kz[:, :, Lh:], vz[:, :, Lh:], *st_hi, scale, mask=tri
+            )
+            kz = jax.lax.ppermute(kz, name, perm)
+            vz = jax.lax.ppermute(vz, name, perm)
+
+            def body(r, carry):
+                kz, vz, m_lo, n_lo, d_lo, m_hi, n_hi, d_hi = carry
+                j = (my - r) % size
+                ks, vs = kz[:, :, :Lh], vz[:, :, :Lh]  # chunk j
+                kh, vh = kz[:, :, Lh:], vz[:, :, Lh:]  # chunk 2*size-1-j
+                m_hi, n_hi, d_hi = _blockwise_update(
+                    qhi, ks, vs, m_hi, n_hi, d_hi, scale
+                )
+                sel = j < my
+                q2 = jnp.where(sel, qlo, qhi)
+                k2 = jnp.where(sel, ks, kh)
+                v2 = jnp.where(sel, vs, vh)
+                st2 = tuple(
+                    jnp.where(sel, a, b)
+                    for a, b in zip((m_lo, n_lo, d_lo), (m_hi, n_hi, d_hi))
+                )
+                m2, n2, d2 = _blockwise_update(q2, k2, v2, *st2, scale)
+                m_lo, n_lo, d_lo = (
+                    jnp.where(sel, n, o)
+                    for n, o in zip((m2, n2, d2), (m_lo, n_lo, d_lo))
+                )
+                m_hi, n_hi, d_hi = (
+                    jnp.where(sel, o, n)
+                    for n, o in zip((m2, n2, d2), (m_hi, n_hi, d_hi))
+                )
+                kz = jax.lax.ppermute(kz, name, perm)
+                vz = jax.lax.ppermute(vz, name, perm)
+                return kz, vz, m_lo, n_lo, d_lo, m_hi, n_hi, d_hi
+
+            _, _, m_lo, n_lo, d_lo, m_hi, n_hi, d_hi = jax.lax.fori_loop(
+                1, size, body, (kz, vz, *st_lo, *st_hi)
+            )
+            out_lo = n_lo / jnp.maximum(d_lo, 1e-30)[..., None]
+            out_hi = n_hi / jnp.maximum(d_hi, 1e-30)[..., None]
+            out = zigzag_merge(out_lo, out_hi, 2, name, size)  # (B, H, L, D)
+            return jnp.moveaxis(out, 1, 2).astype(q_blk.dtype)
+
+        return shard_map(
+            kernel, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec
+        )
+
+    if zigzag:
+        key = ("ring_attention.xla_zz", comm, B, S, H, D, str(q.dtype))
+        out = jitted(key, make_xla_zigzag)(q, k, v)
         return out if batched else out[0]
 
     def make_xla():
@@ -237,9 +469,9 @@ def ring_attention(
             # accumulators explicitly acc_dt: under x64, default-dtype
             # zeros/full are f64 and would drag the whole streaming
             # softmax into emulated double precision
-            m0 = jax.lax.pcast(jnp.full((B, H, L), -jnp.inf, acc_dt), (name,), to="varying")
-            num0 = jax.lax.pcast(jnp.zeros((B, H, L, D), acc_dt), (name,), to="varying")
-            den0 = jax.lax.pcast(jnp.zeros((B, H, L), acc_dt), (name,), to="varying")
+            m0 = pcast(jnp.full((B, H, L), -jnp.inf, acc_dt), (name,), to="varying")
+            num0 = pcast(jnp.zeros((B, H, L, D), acc_dt), (name,), to="varying")
+            den0 = pcast(jnp.zeros((B, H, L), acc_dt), (name,), to="varying")
 
             def body(r, carry):
                 kb, vb, m, num, den = carry
@@ -262,7 +494,7 @@ def ring_attention(
             out = num / jnp.maximum(den, 1e-30)[..., None]  # (B, H, L, D)
             return jnp.moveaxis(out, 1, 2).astype(q_blk.dtype)  # (B, L, H, D)
 
-        return jax.shard_map(
+        return shard_map(
             kernel, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec
         )
 

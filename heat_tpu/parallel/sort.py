@@ -61,6 +61,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from ..core._jax_compat import pcast, shard_map
 from ..core.communication import XlaCommunication, get_comm
 
 __all__ = [
@@ -345,7 +346,7 @@ def _rrs_batched(arr, n: int, comm: XlaCommunication, descending: bool, want_ind
         return svals, ranks
 
     spec2 = comm.spec(2, 0)
-    outs = jax.shard_map(
+    outs = shard_map(
         kernel,
         mesh=mesh,
         in_specs=spec2,
@@ -408,7 +409,7 @@ def _resplit_sort(arr, comm: XlaCommunication, descending: bool, want_indices: b
         vals = jnp.take_along_axis(block, idx, axis=0)
         return vals, idx
 
-    outs = jax.shard_map(
+    outs = shard_map(
         kernel,
         mesh=comm.mesh,
         in_specs=comm.spec(2, 1),

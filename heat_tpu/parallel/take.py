@@ -32,6 +32,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from ..core._jax_compat import pcast, shard_map
 from ..core.communication import XlaCommunication, get_comm
 
 __all__ = ["ring_take", "ring_put"]
@@ -120,7 +121,7 @@ def _ring_take(arr, idx, n: int, comm: XlaCommunication, fill: float):
         s = jax.lax.axis_index(name).astype(jnp.int32)
         # pcast-to-varying: a fresh constant is 'unvarying' in shard_map's
         # axis typing, but the loop writes per-device values into it
-        out0 = jax.lax.pcast(
+        out0 = pcast(
             jnp.full(q.shape + trail, jnp.asarray(fill, arr.dtype)), name, to="varying"
         )
 
@@ -139,7 +140,7 @@ def _ring_take(arr, idx, n: int, comm: XlaCommunication, fill: float):
         _, out = jax.lax.fori_loop(0, p, body, (block, out0))
         return out
 
-    return jax.shard_map(
+    return shard_map(
         kernel,
         mesh=mesh,
         in_specs=(comm.spec(arr.ndim, 0), comm.spec(1, 0)),
@@ -205,7 +206,7 @@ def _ring_put(idx, vals, n: int, m: int, comm: XlaCommunication, base=None):
             # the local base shard gives update-in-place semantics
             block = b[0]
         else:
-            block = jax.lax.pcast(
+            block = pcast(
                 jnp.zeros((wo,) + trail, vals.dtype), name, to="varying"
             )
 
@@ -226,7 +227,7 @@ def _ring_put(idx, vals, n: int, m: int, comm: XlaCommunication, base=None):
     in_specs = (comm.spec(1, 0), comm.spec(vals.ndim, 0))
     if base is not None:
         in_specs = in_specs + (comm.spec(base.ndim, 0),)
-    return jax.shard_map(
+    return shard_map(
         kernel,
         mesh=mesh,
         in_specs=in_specs,

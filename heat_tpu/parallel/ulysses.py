@@ -24,6 +24,7 @@ import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec
 
 from ..core._compile import jitted
+from ..core._jax_compat import pcast, shard_map
 from ..core.communication import XlaCommunication, get_comm
 from ..core.dndarray import DNDarray
 
@@ -145,6 +146,9 @@ def ulysses_attention(
                     )
                     for t in (qb, kb, vb)
                 )  # (B, S, H/p, D): full sequence per device
+                # causal rides the triangular-schedule kernel: each
+                # q-block program folds only k-chunks at or below its
+                # diagonal, so causal costs ~half of full attention here
                 out = flash_attention(qh, kh, vh, causal=causal, interpret=interp)
                 # head→seq swap back to the caller's layout
                 return jax.lax.all_to_all(
@@ -153,7 +157,7 @@ def ulysses_attention(
 
             # check_vma=False: pallas_call under shard_map — see the
             # identical note in ring_attention
-            return jax.shard_map(
+            return shard_map(
                 kern, mesh=mesh, in_specs=(spec, spec, spec),
                 out_specs=spec, check_vma=False,
             )

@@ -6,12 +6,28 @@ from XLA host-platform device multiplication — every test sees an 8-device
 mesh, and split/replicated paths exercise real (CPU-emulated) collectives.
 Set HEAT_TEST_DEVICES to change the mesh size (e.g. 1 or 7 for the
 uneven-chunk edge cases the reference probes with -np 7).
+
+Device-count plumbing is version-portable: newer jax exposes the
+``jax_num_cpu_devices`` config option, jax 0.4.x only honors the
+``--xla_force_host_platform_device_count`` XLA flag.  The flag is appended
+to XLA_FLAGS BEFORE importing jax (the CPU client reads it at lazy backend
+init), then the config option is tried and an ``AttributeError`` from an
+older jax is ignored — whichever knob the installed version understands
+takes effect, and both agree on the same count when both exist.
 """
 
 import os
 
-import jax
+_DEVICES = int(os.environ.get("HEAT_TEST_DEVICES", "8"))
+_FLAG = f"--xla_force_host_platform_device_count={_DEVICES}"
+if _FLAG not in os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") + " " + _FLAG).strip()
+
+import jax  # noqa: E402  (after the XLA_FLAGS setup above, by design)
 
 # must run before any jax computation
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", int(os.environ.get("HEAT_TEST_DEVICES", "8")))
+try:
+    jax.config.update("jax_num_cpu_devices", _DEVICES)
+except AttributeError:
+    pass  # jax 0.4.x: the XLA_FLAGS fallback above already took effect

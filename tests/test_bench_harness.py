@@ -34,12 +34,15 @@ def test_vs_golden_div_and_mul():
     golden = {
         "kmeans_iter_per_sec": {"reduce_gb_per_sec": 750.0},
         "eager_ops_per_sec": {"roundtrip_ms": 100.0},
-        "qr_svd_tall_skinny_ms": {"roundtrip_ms": 100.0},
+        # qr_svd is single-dispatch compute as of r6: its control is the
+        # matmul golden, combined multiplicatively (ms x TFLOP/s move in
+        # opposite directions under a machine slowdown)
+        "qr_svd_tall_skinny_ms": {"matmul_tflops": 165.0},
     }
     out = bench._vs_golden(results, golden)
     assert out["kmeans_iter_per_sec"] == pytest.approx(12.0)      # div
     assert out["eager_ops_per_sec"] == pytest.approx(100000.0)    # mul
-    assert out["qr_svd_tall_skinny_ms"] == pytest.approx(0.04)    # div (ms/ms)
+    assert out["qr_svd_tall_skinny_ms"] == pytest.approx(660.0)   # mul (ms x tflops)
     # a missing golden never fabricates a ratio
     assert "cdist_gb_per_sec" not in out
 
@@ -117,6 +120,79 @@ def test_every_headline_has_group_and_disposition_coverage():
         assert key in models or key in bench._NOT_MODELED, (
             f"{key} neither roofline-modeled nor excluded-with-reason"
         )
+
+
+def test_causal_attention_work_model_is_triangular():
+    # the causal model must claim ~HALF the full forward's FLOPs (the
+    # triangular schedule's visited tiles), not n^2 — the roofline % is
+    # only meaningful against work actually launched
+    models = bench._work_models()
+    full = models["attention_tokens_per_sec"][0]
+    causal = models["causal_attention_tokens_per_sec"][0]
+    s = bench.ATTN_S
+    assert causal == pytest.approx(full * (s + bench.ATTN_BQ) / (2 * s))
+    # the f32 pair: same schedule (same FLOPs), f32 bytes, HIGHEST peak
+    f32 = models["causal_attention_f32_tokens_per_sec"]
+    assert f32[0] == causal
+    assert f32[1] == 2 * models["causal_attention_tokens_per_sec"][1]
+    assert f32[2] == "f32_highest_tflops"
+
+
+def _fake_full_result():
+    """A representative full result for the compact-line contract tests,
+    with every headline populated at realistic magnitudes."""
+    rec = {
+        "metric": "kmeans_iter_per_sec",
+        "value": 9888.25,
+        "unit": "iter/s",
+        "vs_baseline": 123.45,
+        "cdist_gb_per_sec": 1354.12,
+        "moments_gb_per_sec": 797.33,
+        "global_sum_gb_per_sec": 694.01,
+        "kmedians_iter_per_sec": 1063.5,
+        "kmedians_churn_iter_per_sec": 143.21,
+        "kmedoids_iter_per_sec": 10466.7,
+        "eager_ops_per_sec": 3021.9,
+        "lasso_sweeps_per_sec": 1318.6,
+        "qr_svd_tall_skinny_ms": 2.87,
+        "attention_tokens_per_sec": 3400000.0,
+        "causal_attention_tokens_per_sec": 3700000.0,
+        "causal_attention_f32_tokens_per_sec": 620000.0,
+        "spread_pct": {k: 12.3 for k in bench._HEADLINE},
+        "golden": {
+            "health": {
+                "matmul_tflops": 0.843,
+                "reduce_gb_per_sec": 0.852,
+                "roundtrip_ms": 1.113,
+            }
+        },
+        "platform": "tpu",
+    }
+    rec["vs_golden"] = {k: 123.456 for k in bench._GOLDEN_MAP}
+    rec["roofline"] = bench._roofline(rec)
+    return rec
+
+
+def test_compact_line_is_self_contained_and_small():
+    import json
+
+    rec = _fake_full_result()
+    line = bench._compact_line(rec)
+    text = json.dumps(line, separators=(",", ":"))
+    # the driver-facing contract: one line, < ~1500 chars
+    assert len(text) < 1500, f"compact line too long: {len(text)}"
+    # headline contract keys survive
+    assert line["metric"] == "kmeans_iter_per_sec"
+    assert line["value"] == rec["value"]
+    # every headline value, golden health, vs_golden, roofline % present
+    for key in bench._HEADLINE:
+        assert key == line["metric"] or key in line, key
+    assert line["golden_health"] == rec["golden"]["health"]
+    assert set(line["vs_golden"]) == set(rec["vs_golden"])
+    assert "attention_tokens_per_sec" in line["roofline_pct"]
+    assert line["full_report"] == "BENCH_FULL.json"
+    # the verbose layers stay OUT of the line
+    assert "spread_pct" not in line and "roofline" not in line
 
 
 def test_regression_guard_uses_best_round(tmp_path, monkeypatch):

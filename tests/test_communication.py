@@ -10,6 +10,7 @@ import os
 import jax.numpy as jnp
 
 import heat_tpu as ht
+from heat_tpu.core._jax_compat import shard_map
 from heat_tpu.core.communication import XlaCommunication, get_comm, sanitize_comm, use_comm
 
 from suite import assert_array_equal, run_in_fresh_python
@@ -206,9 +207,15 @@ def test_init_multihost_single_process():
     an all-devices communicator; idempotent on re-call.  Runs in a fresh
     subprocess because distributed init must precede backend init."""
     script = (
+        "import os\n"
+        "os.environ['XLA_FLAGS'] = (os.environ.get('XLA_FLAGS', '')"
+        " + ' --xla_force_host_platform_device_count=4').strip()\n"
         "import socket, jax\n"
         "jax.config.update('jax_platforms', 'cpu')\n"
-        "jax.config.update('jax_num_cpu_devices', 4)\n"
+        "try:\n"
+        "    jax.config.update('jax_num_cpu_devices', 4)\n"
+        "except AttributeError:\n"
+        "    pass  # jax 0.4.x: XLA_FLAGS above already took effect\n"
         "s = socket.socket(); s.bind(('127.0.0.1', 0)); port = s.getsockname()[1]; s.close()\n"
         "import heat_tpu as ht\n"
         "comm = ht.init_multihost(f'127.0.0.1:{port}', num_processes=1, process_id=0)\n"
@@ -419,7 +426,7 @@ def test_shard_position_value_order():
         x = comm.apply_sharding(x, 0)
         stamped = np.asarray(
             jax.jit(
-                jax.shard_map(stamp, mesh=comm.mesh, in_specs=spec, out_specs=spec)
+                shard_map(stamp, mesh=comm.mesh, in_specs=spec, out_specs=spec)
             )(x)
         )
         c = comm.shard_width(length)

@@ -21,8 +21,12 @@ EXAMPLES = sorted(
 def test_example_runs(name):
     env = dict(os.environ)
     # examples configure their own virtual mesh via --devices; make sure
-    # nothing from the test session's env forces a platform underneath
-    env.pop("JAX_PLATFORMS", None)
+    # the test session's device-count flags don't leak underneath.  The
+    # platform stays pinned to CPU: with libtpu installed but no TPU
+    # attached, autodetection retries GCP metadata fetches for minutes
+    # before falling back, and this matrix smokes the examples, not
+    # platform discovery
+    env["JAX_PLATFORMS"] = "cpu"
     env.pop("XLA_FLAGS", None)
     path = os.path.join(REPO, "examples", name)
     with open(path) as f:

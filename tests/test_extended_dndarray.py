@@ -9,6 +9,7 @@ import numpy as np
 import pytest
 
 import heat_tpu as ht
+from heat_tpu.core._jax_compat import shard_map
 from suite import assert_array_equal
 
 RNG = np.random.default_rng(11)
@@ -225,7 +226,7 @@ def test_halo_stencil():
 
     spec = PartitionSpec(comm.axis_name)
     out = jax.jit(
-        jax.shard_map(stencil, mesh=comm.mesh, in_specs=spec, out_specs=spec)
+        shard_map(stencil, mesh=comm.mesh, in_specs=spec, out_specs=spec)
     )(wh)
     got = np.asarray(comm.unpad(out, n, 0))
     padded = np.zeros((n + 2, 1), np.float32)

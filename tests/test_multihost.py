@@ -26,6 +26,13 @@ import os, sys
 pid = int(sys.argv[1]); port = sys.argv[2]
 os.environ["JAX_PLATFORMS"] = "cpu"
 os.environ["JAX_NUM_CPU_DEVICES"] = "4"
+# jax 0.4.x reads the XLA flag, not JAX_NUM_CPU_DEVICES — pin it to 4,
+# dropping the device count inherited from the test session's conftest
+_flags = [f for f in os.environ.get("XLA_FLAGS", "").split()
+          if "--xla_force_host_platform_device_count" not in f]
+os.environ["XLA_FLAGS"] = " ".join(
+    _flags + ["--xla_force_host_platform_device_count=4"]
+)
 os.environ["HEAT_TPU_DISABLE_X64"] = "1"
 import jax
 jax.config.update("jax_cpu_collectives_implementation", "gloo")

@@ -75,8 +75,10 @@ def test_ring_map_distance_shape():
         for r in range(size):
             j = (i - r) % size
             got = tiles[r, i * L : (i + 1) * L, :]
+            # atol: the quadratic expansion cancels catastrophically near the
+            # diagonal (d≈0), so after sqrt the f32 error floor is ~1e-3
             np.testing.assert_allclose(
-                got, full[i * L : (i + 1) * L, j * L : (j + 1) * L], atol=1e-4
+                got, full[i * L : (i + 1) * L, j * L : (j + 1) * L], atol=2e-3
             )
 
 
