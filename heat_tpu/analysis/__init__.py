@@ -1,0 +1,31 @@
+"""spmdlint: static SPMD-correctness analysis for the heat_tpu tree.
+
+Importable API (the CLI lives in :mod:`heat_tpu.analysis.cli`, exposed as
+``scripts/spmdlint.py``)::
+
+    from heat_tpu.analysis import analyze_file, analyze_paths, all_rules
+
+Deliberately jax-free: the analyzer runs on a bare Python install so the
+CI gate never depends on an accelerator runtime.
+"""
+
+from .baseline import load_baseline, partition, write_baseline
+from .core import FileContext, analyze_file, analyze_paths, iter_py_files
+from .rules import RULES, Finding, Rule, all_rules
+
+# importing checkers registers every rule in RULES
+from . import checkers  # noqa: E402,F401
+
+__all__ = [
+    "FileContext",
+    "Finding",
+    "RULES",
+    "Rule",
+    "all_rules",
+    "analyze_file",
+    "analyze_paths",
+    "iter_py_files",
+    "load_baseline",
+    "partition",
+    "write_baseline",
+]

@@ -1,0 +1,728 @@
+"""Per-rule checkers for the SPMD-correctness analyzer.
+
+Each checker walks one :class:`~heat_tpu.analysis.core.FileContext` and
+yields findings.  Rule SPMD101 is *hybrid* static/dynamic: permutation
+builders are fixed at trace time (the whole point — ppermute perms are
+compile-time metadata), so the checker extracts the builder expression and
+EVALUATES it for every mesh size 1..8, checking that each result is a
+valid partial bijection.  The evaluation sandbox executes only
+module-level ``def`` source from the analyzed file plus arithmetic
+builtins — never imports, never jax.
+"""
+
+from __future__ import annotations
+
+import ast
+import builtins
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+from .core import FileContext
+from .rules import Finding, rule
+
+__all__ = [
+    "MESH_SIZES",
+    "check_partial_bijection",
+    "verify_ring_schedule",
+    "verify_zigzag_builders",
+]
+
+#: every perm builder is evaluated for these mesh sizes — 1 (degenerate),
+#: powers of two (real TPU slices), and the awkward primes the test
+#: matrix also sweeps
+MESH_SIZES = tuple(range(1, 9))
+
+_SIZE_NAMES = {"size", "p", "n", "world_size", "num_devices", "mesh_size"}
+
+_SAFE_BUILTINS = {
+    k: getattr(builtins, k)
+    for k in (
+        "range", "len", "min", "max", "abs", "enumerate", "zip", "sum",
+        "list", "tuple", "sorted", "reversed", "int", "divmod",
+    )
+}
+
+
+# --------------------------------------------------------------------- #
+# permutation ground truth (shared with the runtime property tests)      #
+# --------------------------------------------------------------------- #
+def check_partial_bijection(perm, size: int) -> Optional[str]:
+    """Validate one ppermute permutation for mesh ``size``: pairs of ints
+    in range, no duplicated source, no duplicated destination (partial
+    perms are legal — absent destinations receive zeros).  Returns an
+    error string or None."""
+    try:
+        pairs = [(int(s), int(d)) for s, d in perm]
+    except (TypeError, ValueError):
+        return f"not a sequence of (src, dst) pairs: {perm!r}"
+    srcs = [s for s, _ in pairs]
+    dsts = [d for _, d in pairs]
+    bad = [x for x in srcs + dsts if not 0 <= x < size]
+    if bad:
+        return f"index {bad[0]} out of range for mesh size {size}"
+    if len(set(srcs)) != len(srcs):
+        dup = sorted(s for s in set(srcs) if srcs.count(s) > 1)
+        return f"duplicate source(s) {dup} at mesh size {size}"
+    if len(set(dsts)) != len(dsts):
+        dup = sorted(d for d in set(dsts) if dsts.count(d) > 1)
+        return f"duplicate destination(s) {dup} at mesh size {size} (collision: two shards write one slot)"
+    return None
+
+
+def verify_ring_schedule(ring_source, sizes: Sequence[int] = MESH_SIZES) -> Optional[str]:
+    """Check ``ring_source(position, round, size)`` against the +1 ring
+    rotation it documents: simulate ``[(i, (i+1) % size)]`` applied
+    ``round`` times and compare origins."""
+    for s in sizes:
+        origins = list(range(s))
+        for r in range(s):
+            for pos in range(s):
+                if ring_source(pos, r, s) != origins[pos]:
+                    return (
+                        f"ring_source({pos}, {r}, {s}) = {ring_source(pos, r, s)}"
+                        f" but the +1 rotation delivers block {origins[pos]}"
+                    )
+            origins = [origins[(pos - 1) % s] for pos in range(s)]
+    return None
+
+
+def verify_zigzag_builders(
+    zigzag_perms=None,
+    zigzag_inverse_perms=None,
+    zigzag_chunk_owner=None,
+    sizes: Sequence[int] = MESH_SIZES,
+) -> Optional[str]:
+    """Full-bijection + round-trip checks for the zig-zag resplit
+    schedules.  Each stream perm must be a TOTAL bijection (every device
+    sends and receives exactly once), and forward-then-inverse must
+    restore the contiguous chunk layout."""
+    for s in sizes:
+        streams = {}
+        if zigzag_perms is not None:
+            streams["zigzag_perms"] = zigzag_perms(s)
+        if zigzag_inverse_perms is not None:
+            streams["zigzag_inverse_perms"] = zigzag_inverse_perms(s)
+        for name, perms in streams.items():
+            for k, perm in enumerate(perms):
+                err = check_partial_bijection(perm, s)
+                if err is None and len({d for _, d in perm}) != s:
+                    err = f"stream does not cover every device at size {s}"
+                if err:
+                    return f"{name}({s}) stream {k}: {err}"
+        if zigzag_perms is not None and zigzag_chunk_owner is not None:
+            fwd = zigzag_perms(s)
+            for i in range(s):
+                for k in (0, 1):
+                    dst = dict(fwd[k])[i]
+                    want = zigzag_chunk_owner(2 * i + k, s)
+                    if dst != want:
+                        return (
+                            f"zigzag_perms({s}) sends chunk {2 * i + k} to "
+                            f"{dst}, zigzag_chunk_owner says {want}"
+                        )
+        if zigzag_perms is not None and zigzag_inverse_perms is not None:
+            # forward then inverse must restore the contiguous layout:
+            # chunk c starts at device c // 2, comes home to c // 2
+            fwd, inv = zigzag_perms(s), zigzag_inverse_perms(s)
+            for c in range(2 * s):
+                home = dict(fwd[c % 2])[c // 2]
+                # at its zig-zag home the chunk is the low half iff c < s;
+                # low halves ride the even-chunk stream of the inverse
+                stream = inv[0] if (c < s) == (home % 2 == 0) else inv[1]
+                back = dict(stream)[home]
+                if back != c // 2:
+                    return (
+                        f"zig-zag round trip broken at size {s}: chunk {c} "
+                        f"returns to device {back}, expected {c // 2}"
+                    )
+    return None
+
+
+# --------------------------------------------------------------------- #
+# sandboxed evaluation of perm expressions                               #
+# --------------------------------------------------------------------- #
+class _Unresolvable(Exception):
+    pass
+
+
+def _module_def_env(ctx: FileContext) -> Dict[str, object]:
+    """Exec every module-level ``def`` from source into one shared env.
+    Definition never runs the body, so jax-using helpers exec fine and
+    only fail (NameError) if a perm expression actually calls them —
+    which we catch and treat as unverifiable."""
+    env: Dict[str, object] = {"__builtins__": _SAFE_BUILTINS}
+    for st in ctx.tree.body:
+        if isinstance(st, ast.FunctionDef):
+            src = ast.get_source_segment(ctx.source, st)
+            if src is None:
+                continue
+            try:
+                exec(compile(ast.parse(src), f"<{ctx.relpath}>", "exec"), env)
+            except Exception:
+                continue
+    return env
+
+
+def _free_names(expr: ast.AST) -> List[str]:
+    bound = set()
+    for node in ast.walk(expr):
+        if isinstance(node, ast.comprehension):
+            for t in ast.walk(node.target):
+                if isinstance(t, ast.Name):
+                    bound.add(t.id)
+        elif isinstance(node, ast.Lambda):
+            bound.update(a.arg for a in node.args.args)
+    out = []
+    for node in ast.walk(expr):
+        if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load):
+            if node.id not in bound and node.id not in _SAFE_BUILTINS:
+                out.append(node.id)
+    return out
+
+
+def _eval_expr(ctx: FileContext, expr: ast.AST, at: ast.AST, size: int,
+               env: Dict[str, object], depth: int = 0):
+    """Evaluate ``expr`` with mesh-size variables bound to ``size``.
+    Free names resolve through (in order): the module-def env, nearest
+    assignment (constants, ``*.size`` attributes, recursively evaluable
+    expressions), parameter defaults, and the size-name convention."""
+    if depth > 6:
+        raise _Unresolvable("resolution too deep")
+    local: Dict[str, object] = {}
+    params = {}
+    for fn in ctx.enclosing_functions(at):
+        if isinstance(fn, ast.Lambda):
+            args = fn.args
+        else:
+            args = fn.args
+        names = [a.arg for a in args.args + args.kwonlyargs]
+        defaults = list(args.defaults)
+        for name, default in zip(reversed(args.args), reversed(defaults)):
+            params.setdefault(name.arg, default)
+        for name in names:
+            params.setdefault(name, None)
+    for name in _free_names(expr):
+        if name in env or name in local:
+            continue
+        rec = ctx.lookup(name, at)
+        if rec is not None and rec[0] == "expr":
+            val = rec[1]
+            if isinstance(val, ast.Constant):
+                local[name] = val.value
+                continue
+            if isinstance(val, ast.Attribute) and val.attr == "size":
+                local[name] = size
+                continue
+            try:
+                local[name] = _eval_expr(ctx, val, at, size, env, depth + 1)
+                continue
+            except _Unresolvable:
+                pass
+        if name in params:
+            default = params[name]
+            if name in _SIZE_NAMES:
+                local[name] = size
+                continue
+            if isinstance(default, ast.Constant) and default.value is not None:
+                local[name] = default.value
+                continue
+            raise _Unresolvable(f"parameter {name!r}")
+        if name in _SIZE_NAMES:
+            local[name] = size
+            continue
+        raise _Unresolvable(f"name {name!r}")
+    code = compile(ast.Expression(body=_strip_locations(expr)), "<perm>", "eval")
+    merged = dict(env)
+    merged.update(local)
+    try:
+        return eval(code, merged)
+    except _UnresolvableErrors as e:
+        raise _Unresolvable(str(e))
+
+
+_UnresolvableErrors = (NameError, AttributeError, TypeError, ValueError, IndexError, KeyError)
+
+
+def _strip_locations(expr: ast.AST) -> ast.AST:
+    import copy
+
+    new = copy.deepcopy(expr)
+    return ast.fix_missing_locations(
+        ast.copy_location(new, ast.Expr(lineno=1, col_offset=0))
+    )
+
+
+#: builders whose results SPMD101 verifies whenever the analyzed file
+#: defines them — the schedule metadata of the zig-zag causal ring
+_BUILDER_NAMES = ("zigzag_perms", "zigzag_inverse_perms", "zigzag_chunk_owner", "ring_source")
+
+
+@rule("SPMD101", "ppermute permutations must be statically-valid bijections", dynamic=True)
+def check_ppermute_bijection(ctx: FileContext) -> Iterable[Finding]:
+    """Every ``jax.lax.ppermute`` perm that is visible as a comprehension,
+    a literal, or a call into a local builder is evaluated for mesh sizes
+    1..8 and validated as a partial bijection (distinct sources, distinct
+    destinations, indices in range).  Files defining the zig-zag /ring
+    schedule builders additionally get their cycle structure verified
+    against simulation."""
+    env = None  # built lazily: most files have no ppermute at all
+
+    def get_env():
+        nonlocal env
+        if env is None:
+            env = _module_def_env(ctx)
+        return env
+
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call) or not ctx.resolves_to(node.func, "ppermute"):
+            continue
+        perm_expr = None
+        if len(node.args) >= 3:
+            perm_expr = node.args[2]
+        else:
+            for kw in node.keywords:
+                if kw.arg == "perm":
+                    perm_expr = kw.value
+        if perm_expr is None:
+            continue
+        expr, at = perm_expr, node
+        if isinstance(expr, ast.Name):
+            rec = ctx.lookup(expr.id, node)
+            if rec is None:
+                continue  # parameter or unknown: checked at its builder
+            if rec[0] == "expr":
+                expr = rec[1]
+            else:  # tuple-unpack from a builder call
+                call, idx = rec[1], rec[2]
+                expr = ast.Subscript(
+                    value=call, slice=ast.Constant(value=idx), ctx=ast.Load()
+                )
+        if isinstance(expr, ast.Name):
+            continue  # parameter-fed perms are validated at the builder
+        for size in MESH_SIZES:
+            try:
+                perm = _eval_expr(ctx, expr, at, size, get_env())
+            except _Unresolvable:
+                break  # not statically evaluable here: builder-site duty
+            err = check_partial_bijection(perm, size)
+            if err:
+                yield ctx.finding(
+                    "SPMD101", node,
+                    f"ppermute perm is not a valid permutation: {err}",
+                    hint="every (src, dst) pair needs distinct sources and "
+                    "distinct destinations in [0, mesh size); rebuild the "
+                    "perm from the mesh size, not from data",
+                )
+                break
+
+    # schedule builders defined here: verify cycle structure by simulation
+    defs = {
+        name: ctx.module_function(name)
+        for name in _BUILDER_NAMES
+        if ctx.module_function(name) is not None
+    }
+    if defs:
+        env = get_env()
+        have = {k: env.get(k) for k in defs if callable(env.get(k))}
+        err = None
+        if "ring_source" in have:
+            err = verify_ring_schedule(have["ring_source"])
+            anchor = defs["ring_source"]
+        if err is None and ("zigzag_perms" in have or "zigzag_inverse_perms" in have):
+            err = verify_zigzag_builders(
+                zigzag_perms=have.get("zigzag_perms"),
+                zigzag_inverse_perms=have.get("zigzag_inverse_perms"),
+                zigzag_chunk_owner=have.get("zigzag_chunk_owner"),
+            )
+            anchor = defs.get("zigzag_perms") or defs.get("zigzag_inverse_perms")
+        if err:
+            yield ctx.finding(
+                "SPMD101", anchor,
+                f"schedule builder fails simulation: {err}",
+                hint="the perm-builder contract is checked for mesh sizes "
+                "1..8 against a direct simulation of the ring/zig-zag "
+                "layout; see tests/test_spmdlint.py for the ground truth",
+            )
+
+
+# --------------------------------------------------------------------- #
+# SPMD102: collective axis names vs the enclosing shard_map              #
+# --------------------------------------------------------------------- #
+#: collective leaf name -> positional index of its axis-name argument
+_COLLECTIVES = {
+    "psum": 1, "pmax": 1, "pmin": 1, "pmean": 1, "ppermute": 1,
+    "all_gather": 1, "all_to_all": 1, "psum_scatter": 1, "pshuffle": 1,
+    "pbroadcast": 1, "pcast": 1, "axis_index": 0,
+}
+
+
+def _axis_exprs_of_collective(call: ast.Call, leaf: str) -> List[ast.AST]:
+    idx = _COLLECTIVES[leaf]
+    expr = None
+    if len(call.args) > idx:
+        expr = call.args[idx]
+    else:
+        for kw in call.keywords:
+            if kw.arg in ("axis_name", "axes", "axis"):
+                expr = kw.value
+    if expr is None:
+        return []
+    if isinstance(expr, (ast.Tuple, ast.List)):
+        return list(expr.elts)
+    return [expr]
+
+
+def _is_axis_name_binding(ctx: FileContext, name: str, at: ast.AST) -> bool:
+    rec = ctx.lookup(name, at)
+    return (
+        rec is not None
+        and rec[0] == "expr"
+        and isinstance(rec[1], ast.Attribute)
+        and rec[1].attr == "axis_name"
+    )
+
+
+@rule("SPMD102", "collective axis names must match the enclosing shard_map mesh axis")
+def check_axis_names(ctx: FileContext) -> Iterable[Finding]:
+    """Inside each ``shard_map`` kernel, every collective's axis-name
+    argument must be (a) one of the axis expressions named by the
+    PartitionSpecs of the shard_map's in/out specs, (b) a variable bound
+    from some ``*.axis_name``, or (c) a parameter (the helper-function
+    pass-through, validated at its call sites).  Anything else is a
+    mesh/axis mismatch waiting for a different mesh to crash on."""
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call) or not ctx.resolves_to(node.func, "shard_map"):
+            continue
+        kernel = ctx._fn_node_of(node.args[0], node) if node.args else None
+        if kernel is None:
+            for kw in node.keywords:
+                if kw.arg == "f":
+                    kernel = ctx._fn_node_of(kw.value, node)
+        if kernel is None:
+            continue
+        spec_tokens: set = set()
+        spec_strings: set = set()
+        for kw in node.keywords:
+            if kw.arg not in ("in_specs", "out_specs"):
+                continue
+            for sub in ast.walk(kw.value):
+                if isinstance(sub, ast.Call) and ctx.resolves_to(
+                    sub.func, "PartitionSpec", "P"
+                ):
+                    for a in sub.args:
+                        if isinstance(a, ast.Constant):
+                            if isinstance(a.value, str):
+                                spec_strings.add(a.value)
+                        elif isinstance(a, (ast.Name, ast.Attribute)):
+                            spec_tokens.add(ast.dump(_strip_locations(a)))
+
+        kernel_params = {a.arg for a in kernel.args.args + kernel.args.kwonlyargs}
+        for sub in ast.walk(kernel):
+            if not isinstance(sub, ast.Call):
+                continue
+            dotted = ctx.resolve(sub.func) or ""
+            leaf = dotted.rsplit(".", 1)[-1]
+            if leaf not in _COLLECTIVES:
+                continue
+            if not ("jax" in dotted or "lax" in dotted or dotted == leaf or "_jax_compat" in dotted):
+                continue
+            for expr in _axis_exprs_of_collective(sub, leaf):
+                if isinstance(expr, ast.Constant):
+                    if expr.value is None:
+                        continue
+                    if spec_strings and expr.value in spec_strings:
+                        continue
+                    if not spec_strings and not spec_tokens:
+                        continue  # specs not statically visible
+                    yield ctx.finding(
+                        "SPMD102", sub,
+                        f"collective {leaf!r} names axis {expr.value!r}, "
+                        f"not an axis of the enclosing shard_map "
+                        f"({sorted(spec_strings) or 'symbolic specs'})",
+                        hint="use the mesh axis named in the shard_map's "
+                        "PartitionSpecs (conventionally the variable bound "
+                        "from comm.axis_name)",
+                    )
+                    continue
+                if isinstance(expr, ast.Name):
+                    enclosing_params = set(kernel_params)
+                    for fn in ctx.enclosing_functions(sub):
+                        enclosing_params.update(
+                            a.arg for a in fn.args.args + fn.args.kwonlyargs
+                        )
+                    if expr.id in enclosing_params:
+                        continue  # pass-through: call sites carry the proof
+                    if ast.dump(_strip_locations(expr)) in spec_tokens:
+                        continue
+                    if _is_axis_name_binding(ctx, expr.id, sub):
+                        continue
+                    yield ctx.finding(
+                        "SPMD102", sub,
+                        f"collective {leaf!r} axis {expr.id!r} does not "
+                        "match the enclosing shard_map's mesh axis",
+                        hint="bind the axis once (`name = comm.axis_name`) "
+                        "and use that same variable in the PartitionSpecs "
+                        "and every collective",
+                    )
+                elif isinstance(expr, ast.Attribute):
+                    if expr.attr == "axis_name":
+                        continue
+                    if ast.dump(_strip_locations(expr)) in spec_tokens:
+                        continue
+                    yield ctx.finding(
+                        "SPMD102", sub,
+                        f"collective {leaf!r} axis expression is not the "
+                        "enclosing shard_map's mesh axis",
+                        hint="pass the axis name bound from comm.axis_name",
+                    )
+
+
+# --------------------------------------------------------------------- #
+# SPMD201: trace purity                                                  #
+# --------------------------------------------------------------------- #
+_BANNED_CALLS = {
+    "time.time": "wall-clock reads bake one value into the compiled program",
+    "time.perf_counter": "wall-clock reads bake one value into the compiled program",
+    "time.monotonic": "wall-clock reads bake one value into the compiled program",
+    "time.sleep": "host sleeps are invisible to the compiled program",
+    "print": "host print runs at TRACE time only (once, with tracers)",
+    "open": "file I/O at trace time runs once, not per call",
+    "input": "blocking host I/O inside a traced function",
+    "breakpoint": "debugger traps do not survive tracing",
+}
+_BANNED_PREFIXES = {
+    "numpy.random.": "numpy RNG is host state: traced once, frozen forever "
+    "— use jax.random with an explicit key",
+    "random.": "stdlib RNG is host state: traced once, frozen forever — "
+    "use jax.random with an explicit key",
+}
+
+
+@rule("SPMD201", "no host effects inside jit/shard_map/pallas-traced functions")
+def check_trace_purity(ctx: FileContext) -> Iterable[Finding]:
+    """Functions handed to ``jit``/``shard_map``/``pallas_call`` (or
+    defined inside an op-engine ``jitted`` factory) run ONCE at trace
+    time; host effects inside them silently freeze (RNG, clocks) or
+    vanish (print, I/O), and ``global`` writes make the cached executable
+    depend on hidden state."""
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Call) and ctx.in_traced_context(node):
+            dotted = ctx.resolve(node.func)
+            if dotted is None:
+                continue
+            if dotted in _BANNED_CALLS:
+                yield ctx.finding(
+                    "SPMD201", node,
+                    f"host effect {dotted!r} inside a traced function",
+                    hint=_BANNED_CALLS[dotted],
+                )
+                continue
+            for prefix, why in _BANNED_PREFIXES.items():
+                if dotted.startswith(prefix) and not dotted.startswith("jax."):
+                    yield ctx.finding(
+                        "SPMD201", node,
+                        f"host RNG {dotted!r} inside a traced function",
+                        hint=why,
+                    )
+                    break
+        elif isinstance(node, ast.Global) and ctx.in_traced_context(node):
+            yield ctx.finding(
+                "SPMD201", node,
+                f"global-variable write ({', '.join(node.names)}) inside a "
+                "traced function",
+                hint="traced functions must be pure: thread state through "
+                "arguments/carries, or move the mutation outside the jit",
+            )
+
+
+# --------------------------------------------------------------------- #
+# SPMD301/302: Pallas tiling and grids                                   #
+# --------------------------------------------------------------------- #
+@rule("SPMD301", "Pallas BlockSpec tiles must respect the hardware tile grid")
+def check_pallas_tiling(ctx: FileContext) -> Iterable[Finding]:
+    """Literal BlockSpec dimensions must sit on the TPU tile grid: the
+    minor-most block dim a multiple of 128, the second-minor a multiple
+    of the dtype's sublane count (8 for f32 — bf16 needs 16, flagged in
+    the hint).  Size-1 dims and symbolic dims (``bq``, ``D`` — values
+    produced by `_pick_block`-style helpers) are exempt: Mosaic also
+    accepts block dims equal to the array dims."""
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call) or not ctx.resolves_to(node.func, "BlockSpec"):
+            continue
+        shape = node.args[0] if node.args else None
+        for kw in node.keywords:
+            if kw.arg == "block_shape":
+                shape = kw.value
+        if not isinstance(shape, (ast.Tuple, ast.List)) or len(shape.elts) < 2:
+            continue
+        minor, second = shape.elts[-1], shape.elts[-2]
+        if isinstance(minor, ast.Constant) and isinstance(minor.value, int):
+            v = minor.value
+            if v > 1 and v % 128:
+                yield ctx.finding(
+                    "SPMD301", node,
+                    f"BlockSpec minor dim {v} is not a multiple of the "
+                    "128-lane tile",
+                    hint="pick a 128-multiple (or exactly the array dim); "
+                    "f32 tiles are 8x128, bf16 16x128",
+                )
+        if isinstance(second, ast.Constant) and isinstance(second.value, int):
+            v = second.value
+            if v > 1 and v % 8:
+                yield ctx.finding(
+                    "SPMD301", node,
+                    f"BlockSpec second-minor dim {v} is not a multiple of "
+                    "the sublane tile (8 for f32, 16 for bf16)",
+                    hint="round the block up to the dtype's sublane "
+                    "multiple or use the full array dim",
+                )
+
+
+@rule("SPMD302", "pallas_call grids must be static")
+def check_pallas_static_grid(ctx: FileContext) -> Iterable[Finding]:
+    """The grid is compile-time program structure: building it from
+    traced array values (``jnp.*``/``lax.*`` results) either fails to
+    lower or silently re-specializes per call."""
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call) or not ctx.resolves_to(node.func, "pallas_call"):
+            continue
+        grid = None
+        for kw in node.keywords:
+            if kw.arg == "grid":
+                grid = kw.value
+        if grid is None:
+            continue
+        for sub in ast.walk(grid):
+            if isinstance(sub, ast.Call):
+                dotted = ctx.resolve(sub.func) or ""
+                if dotted.startswith(("jax.numpy.", "jax.lax.", "jax.random.")):
+                    yield ctx.finding(
+                        "SPMD302", sub,
+                        f"pallas_call grid uses traced value {dotted!r}",
+                        hint="grids must be python ints fixed at trace "
+                        "time; derive them from static shapes "
+                        "(x.shape[...] // block), not from array values",
+                    )
+
+
+# --------------------------------------------------------------------- #
+# SPMD401: jitted() cache-key hygiene                                    #
+# --------------------------------------------------------------------- #
+_OK_KEY_ATTRS = {
+    "dtype", "ndim", "shape", "size", "split", "axis_name", "name",
+    "itemsize", "value",
+}
+_OK_KEY_CALLS = {"str", "int", "float", "bool", "tuple", "len", "repr", "frozenset", "hash"}
+
+
+def _classify_key_element(ctx: FileContext, el: ast.AST, fn_scope) -> Optional[Tuple[str, str]]:
+    """Return (message, hint) when ``el`` is a risky cache-key part."""
+    if isinstance(el, ast.Constant):
+        return None
+    if isinstance(el, (ast.Tuple,)):
+        for sub in el.elts:
+            bad = _classify_key_element(ctx, sub, fn_scope)
+            if bad:
+                return bad
+        return None
+    if isinstance(el, (ast.List, ast.Dict, ast.Set)):
+        return (
+            "unhashable literal in jitted() key",
+            "use a tuple (lists/dicts/sets raise TypeError at lookup)",
+        )
+    if isinstance(el, ast.Lambda):
+        return (
+            "lambda in jitted() key",
+            "a fresh lambda has a fresh identity every call: the cache "
+            "grows one dead entry per call and never hits",
+        )
+    if isinstance(el, ast.Starred):
+        return ("starred element in jitted() key", "splice statically instead")
+    if isinstance(el, ast.Name):
+        # a name that the enclosing function CALLS is a callable value:
+        # bound methods / closures in keys are the ring_map cache leak
+        if fn_scope is not None:
+            for sub in ast.walk(fn_scope):
+                if (
+                    isinstance(sub, ast.Call)
+                    and isinstance(sub.func, ast.Name)
+                    and sub.func.id == el.id
+                ):
+                    return (
+                        f"callable {el.id!r} in jitted() key",
+                        "bound methods and closures are not identity-stable "
+                        "across calls (PR-1 ring_map leak); key on stable "
+                        "data instead, or gate with _compile.cache_stable() "
+                        "and suppress",
+                    )
+        return None
+    if isinstance(el, ast.Attribute):
+        if el.attr in _OK_KEY_ATTRS:
+            return None
+        return (
+            f"attribute {ast.unparse(el)!r} in jitted() key may be a bound "
+            "method or per-call object",
+            "key on plain data (dtype/shape/axis tuples, str(dtype), "
+            "comm) — never on methods or arrays",
+        )
+    if isinstance(el, ast.Call):
+        if isinstance(el.func, ast.Name) and el.func.id in _OK_KEY_CALLS:
+            return None
+        dotted = ctx.resolve(el.func) or ""
+        if dotted.startswith(("jax.numpy.", "numpy.", "jax.")):
+            return (
+                f"array-valued call {dotted!r} in jitted() key",
+                "jax arrays are unhashable and never identity-stable; key "
+                "on the static parameters that produced the array",
+            )
+        return (
+            f"unvetted call {ast.unparse(el.func)!r} in jitted() key",
+            "only str/int/float/bool/tuple/len conversions are known "
+            "hashable+stable; hoist anything else into a named static",
+        )
+    if isinstance(el, (ast.BinOp, ast.UnaryOp, ast.Compare, ast.IfExp, ast.Subscript)):
+        return None  # plain data arithmetic: hashable if its parts are
+    if isinstance(el, ast.JoinedStr):
+        return None
+    return None
+
+
+@rule("SPMD401", "jitted() cache keys: hashable, identity-stable parts only")
+def check_jit_cache_keys(ctx: FileContext) -> Iterable[Finding]:
+    """Call sites of the op engine's ``jitted(key, make_fn)`` must build
+    ``key`` from parts that are hashable AND identity-stable across calls
+    — no bound methods, no lambdas/closures, no arrays.  The key must be
+    a tuple literal visible at the call site (directly or via one local
+    assignment) so this can be audited at all."""
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call) or not ctx.resolves_to(node.func, "jitted"):
+            continue
+        if not node.args:
+            continue
+        key = node.args[0]
+        anchor = node
+        if isinstance(key, ast.Name):
+            rec = ctx.lookup(key.id, node)
+            if rec is not None and rec[0] == "expr":
+                key = rec[1]
+        if not isinstance(key, ast.Tuple):
+            yield ctx.finding(
+                "SPMD401", anchor,
+                "jitted() key is not a statically-visible tuple literal",
+                hint="build the key as a tuple at (or one assignment above) "
+                "the call site so its parts can be audited",
+            )
+            continue
+        if not (key.elts and isinstance(key.elts[0], ast.Constant)
+                and isinstance(key.elts[0].value, str)):
+            yield ctx.finding(
+                "SPMD401", anchor,
+                "jitted() key does not start with a namespace string",
+                hint="lead with a unique op-name string so two ops can "
+                "never collide on structurally-equal parameter tuples",
+            )
+        enclosing = ctx.enclosing_functions(node)
+        fn_scope = enclosing[-1] if enclosing else None
+        for el in key.elts:
+            bad = _classify_key_element(ctx, el, fn_scope)
+            if bad:
+                yield ctx.finding("SPMD401", anchor, bad[0], hint=bad[1])

@@ -1,0 +1,112 @@
+"""Command-line front end; thin so ``scripts/spmdlint.py`` stays a stub.
+
+Exit codes: 0 clean (or baseline-covered), 1 new findings, 2 bad usage.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+from typing import List, Optional
+
+from .baseline import DEFAULT_BASELINE, load_baseline, partition, write_baseline
+from .core import analyze_paths
+from .rules import all_rules
+
+
+def _repo_root() -> str:
+    # heat_tpu/analysis/cli.py -> repo root two levels above the package
+    return os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="spmdlint",
+        description="Static SPMD-correctness analyzer for heat_tpu "
+        "(collective discipline, trace purity, Pallas tiling, jit-cache keys).",
+    )
+    p.add_argument(
+        "paths", nargs="*", default=None,
+        help="files/directories to analyze (default: the heat_tpu package)",
+    )
+    p.add_argument(
+        "--baseline", nargs="?", const=True, default=None, metavar="FILE",
+        help="compare against the committed baseline (optionally at FILE; "
+        f"default {DEFAULT_BASELINE} at the repo root) and fail only on "
+        "NEW findings",
+    )
+    p.add_argument(
+        "--update-baseline", action="store_true",
+        help="rewrite the baseline file from the current findings and exit 0",
+    )
+    p.add_argument(
+        "--no-dynamic", action="store_true",
+        help="skip rules that evaluate perm-builder source (SPMD101)",
+    )
+    p.add_argument(
+        "--rule", action="append", default=None, metavar="ID",
+        help="run only this rule id (repeatable)",
+    )
+    p.add_argument("--list-rules", action="store_true", help="print the rule catalog")
+    p.add_argument("-q", "--quiet", action="store_true", help="counts only, no per-finding output")
+    return p
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    root = _repo_root()
+
+    if args.list_rules:
+        from . import checkers  # noqa: F401  (register rules)
+
+        for r in all_rules():
+            dyn = " [dynamic]" if r.dynamic else ""
+            print(f"{r.id}  {r.title}{dyn}")
+        return 0
+
+    paths = args.paths or [os.path.join(root, "heat_tpu")]
+    missing = [p for p in paths if not os.path.exists(p)]
+    if missing:
+        print(f"spmdlint: no such path: {', '.join(missing)}", file=sys.stderr)
+        return 2
+
+    findings = analyze_paths(paths, dynamic=not args.no_dynamic, root=root)
+    if args.rule:
+        findings = [f for f in findings if f.rule in args.rule]
+
+    baseline_path = None
+    if args.baseline is not None or args.update_baseline:
+        baseline_path = (
+            args.baseline
+            if isinstance(args.baseline, str)
+            else os.path.join(root, DEFAULT_BASELINE)
+        )
+
+    if args.update_baseline:
+        write_baseline(baseline_path, findings)
+        print(f"spmdlint: baseline written to {baseline_path} ({len(findings)} findings)")
+        return 0
+
+    if baseline_path is not None:
+        new, old, stale = partition(findings, load_baseline(baseline_path))
+        if not args.quiet:
+            for f in new:
+                print(f.render())
+            for fp in stale:
+                print(f"stale baseline entry (fix it and update the baseline): {fp}")
+        print(
+            f"spmdlint: {len(new)} new, {len(old)} baselined, "
+            f"{len(stale)} stale baseline entries"
+        )
+        return 1 if new else 0
+
+    if not args.quiet:
+        for f in findings:
+            print(f.render())
+    print(f"spmdlint: {len(findings)} finding(s)")
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

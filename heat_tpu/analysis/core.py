@@ -1,0 +1,395 @@
+"""AST walker core: per-file model shared by every checker.
+
+``FileContext`` parses one Python source file and precomputes what the
+rules need:
+
+- an import-alias map so ``pl.BlockSpec`` / ``ppermute`` / ``jitted``
+  resolve to dotted names regardless of import spelling (relative imports
+  are resolved against the file's package position on disk);
+- a parent map (child → parent AST node) for enclosing-statement and
+  enclosing-function queries;
+- per-scope assignment tables (including tuple-unpacking, the
+  ``mesh, name = comm.mesh, comm.axis_name`` idiom);
+- the set of TRACED functions: anything passed to ``jit`` / ``shard_map``
+  / ``pallas_call`` / ``lax.fori_loop``-family / ``vmap``/``grad``,
+  decorated with ``jax.jit`` (bare or via ``partial``), or nested inside a
+  factory handed to the op engine's ``jitted``;
+- inline-suppression handling (``# spmdlint: disable=SPMD101`` on the
+  finding's line or its statement's first line, ``# spmdlint: skip-file``
+  in the header).
+
+Checkers receive a context and call :meth:`FileContext.finding`, which
+applies inline suppressions and stamps the line-insensitive baseline
+fingerprint.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple, Union
+
+from .rules import RULES, Finding, all_rules
+
+__all__ = ["FileContext", "analyze_file", "analyze_paths", "iter_py_files"]
+
+_SUPPRESS_RE = re.compile(r"#\s*spmdlint:\s*disable=([A-Za-z0-9_,\s]+)")
+_SKIP_FILE_RE = re.compile(r"#\s*spmdlint:\s*skip-file")
+
+#: jax entry points whose function argument (by position) gets traced
+_TRACING_CALLS = {
+    "jit": (0,),
+    "shard_map": (0,),
+    "pallas_call": (0,),
+    "fori_loop": (2,),
+    "while_loop": (0, 1),
+    "scan": (0,),
+    "cond": (1, 2),
+    "vmap": (0,),
+    "grad": (0,),
+    "value_and_grad": (0,),
+    "checkpoint": (0,),
+    "remat": (0,),
+}
+
+FuncNode = Union[ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda]
+_FUNC_TYPES = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+
+
+def _module_name_for(path: str) -> str:
+    """Dotted module name from the file's package position on disk (walk
+    up while ``__init__.py`` exists).  Fixture files outside any package
+    just get their stem."""
+    path = os.path.abspath(path)
+    parts = [os.path.splitext(os.path.basename(path))[0]]
+    d = os.path.dirname(path)
+    while os.path.isfile(os.path.join(d, "__init__.py")):
+        parts.append(os.path.basename(d))
+        d = os.path.dirname(d)
+    name = ".".join(reversed(parts))
+    return name[: -len(".__init__")] if name.endswith(".__init__") else name
+
+
+class FileContext:
+    def __init__(self, path: str, source: Optional[str] = None, relpath: Optional[str] = None):
+        self.path = path
+        self.relpath = relpath or os.path.relpath(path)
+        if source is None:
+            with open(path, "r", encoding="utf-8") as f:
+                source = f.read()
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source, filename=path)
+        self.module = _module_name_for(path) if os.path.exists(path) else "<fixture>"
+
+        self.parents: Dict[ast.AST, ast.AST] = {}
+        for parent in ast.walk(self.tree):
+            for child in ast.iter_child_nodes(parent):
+                self.parents[child] = parent
+
+        self.aliases = self._collect_aliases()
+        self._scope_assigns: Dict[ast.AST, Dict[str, Tuple]] = {}
+        self.traced_fns = self._collect_traced()
+        self.skip_file = any(
+            _SKIP_FILE_RE.search(ln) for ln in self.lines[:5]
+        )
+
+    # ------------------------------------------------------------------ #
+    # imports / name resolution                                           #
+    # ------------------------------------------------------------------ #
+    def _collect_aliases(self) -> Dict[str, str]:
+        """local name -> dotted origin (``pl`` -> ``jax.experimental.pallas``)."""
+        out: Dict[str, str] = {}
+        pkg_parts = self.module.split(".")
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    out[a.asname or a.name.split(".")[0]] = (
+                        a.name if a.asname else a.name.split(".")[0]
+                    )
+            elif isinstance(node, ast.ImportFrom):
+                mod = node.module or ""
+                if node.level:
+                    # resolve `from ..core import x` against this module
+                    base = pkg_parts[: max(len(pkg_parts) - node.level, 0)]
+                    mod = ".".join(base + ([mod] if mod else []))
+                for a in node.names:
+                    if a.name == "*":
+                        continue
+                    out[a.asname or a.name] = f"{mod}.{a.name}" if mod else a.name
+        return out
+
+    def resolve(self, node: ast.AST) -> Optional[str]:
+        """Dotted name of a Name/Attribute chain with import aliases
+        substituted; None for anything else."""
+        parts: List[str] = []
+        while isinstance(node, ast.Attribute):
+            parts.append(node.attr)
+            node = node.value
+        if not isinstance(node, ast.Name):
+            return None
+        root = self.aliases.get(node.id, node.id)
+        parts.append(root)
+        return ".".join(reversed(parts))
+
+    def resolves_to(self, node: ast.AST, *names: str) -> bool:
+        """True when ``node`` resolves to any of ``names`` (matched on the
+        full dotted path or any dotted-boundary suffix)."""
+        dotted = self.resolve(node)
+        if dotted is None:
+            return False
+        for n in names:
+            if dotted == n or dotted.endswith("." + n):
+                return True
+        return False
+
+    # ------------------------------------------------------------------ #
+    # structure queries                                                   #
+    # ------------------------------------------------------------------ #
+    def enclosing_functions(self, node: ast.AST) -> List[FuncNode]:
+        """Function nodes containing ``node``, innermost first."""
+        out: List[FuncNode] = []
+        cur = self.parents.get(node)
+        while cur is not None:
+            if isinstance(cur, _FUNC_TYPES):
+                out.append(cur)
+            cur = self.parents.get(cur)
+        return out
+
+    def enclosing_statement(self, node: ast.AST) -> ast.AST:
+        cur = node
+        while cur is not None and not isinstance(cur, ast.stmt):
+            cur = self.parents.get(cur)
+        return cur if cur is not None else node
+
+    def qualname(self, node: ast.AST) -> str:
+        names = []
+        for fn in self.enclosing_functions(node):
+            names.append(getattr(fn, "name", "<lambda>"))
+        return ".".join(reversed(names)) or "<module>"
+
+    def scope_assignments(self, scope: ast.AST) -> Dict[str, Tuple]:
+        """name -> ("expr", value_node) | ("unpack", call_node, index) for
+        assignments made DIRECTLY in ``scope`` (nested defs excluded)."""
+        cached = self._scope_assigns.get(scope)
+        if cached is not None:
+            return cached
+        table: Dict[str, Tuple] = {}
+
+        def visit(stmts):
+            for st in stmts:
+                if isinstance(st, _FUNC_TYPES + (ast.ClassDef,)):
+                    continue
+                if isinstance(st, ast.Assign):
+                    self._record_assign(table, st.targets, st.value)
+                elif isinstance(st, ast.AnnAssign) and st.value is not None:
+                    self._record_assign(table, [st.target], st.value)
+                for field in ("body", "orelse", "finalbody"):
+                    sub = getattr(st, field, None)
+                    if sub:
+                        visit(sub)
+                for h in getattr(st, "handlers", []) or []:
+                    visit(h.body)
+
+        body = getattr(scope, "body", [])
+        visit(body if isinstance(body, list) else [])
+        self._scope_assigns[scope] = table
+        return table
+
+    @staticmethod
+    def _record_assign(table, targets, value):
+        for tgt in targets:
+            if isinstance(tgt, ast.Name):
+                table[tgt.id] = ("expr", value)
+            elif isinstance(tgt, (ast.Tuple, ast.List)):
+                elts = tgt.elts
+                if isinstance(value, (ast.Tuple, ast.List)) and len(value.elts) == len(elts):
+                    for t, v in zip(elts, value.elts):
+                        if isinstance(t, ast.Name):
+                            table[t.id] = ("expr", v)
+                elif isinstance(value, ast.Call):
+                    for i, t in enumerate(elts):
+                        if isinstance(t, ast.Name):
+                            table[t.id] = ("unpack", value, i)
+
+    def lookup(self, name: str, node: ast.AST) -> Optional[Tuple]:
+        """Nearest-scope assignment record for ``name`` visible at
+        ``node``: enclosing functions innermost-out, then module level."""
+        for scope in self.enclosing_functions(node) + [self.tree]:
+            rec = self.scope_assignments(scope).get(name)
+            if rec is not None:
+                return rec
+        return None
+
+    def module_function(self, name: str) -> Optional[ast.FunctionDef]:
+        for st in self.tree.body:
+            if isinstance(st, ast.FunctionDef) and st.name == name:
+                return st
+        return None
+
+    def local_function(self, name: str, at: ast.AST) -> Optional[FuncNode]:
+        """A def or name-bound lambda named ``name`` visible at ``at``."""
+        for scope in self.enclosing_functions(at) + [self.tree]:
+            for st in ast.walk(scope) if scope is not self.tree else self.tree.body:
+                if isinstance(st, ast.FunctionDef) and st.name == name:
+                    return st
+            rec = self.scope_assignments(scope).get(name)
+            if rec and rec[0] == "expr" and isinstance(rec[1], ast.Lambda):
+                return rec[1]
+        return None
+
+    # ------------------------------------------------------------------ #
+    # traced-function discovery                                           #
+    # ------------------------------------------------------------------ #
+    def _fn_node_of(self, expr: ast.AST, at: ast.AST) -> Optional[FuncNode]:
+        """Resolve a function-valued expression to its def/lambda node."""
+        if isinstance(expr, ast.Lambda):
+            return expr
+        if isinstance(expr, ast.Call):
+            # functools.partial(kernel, ...) and decorator-style wrappers
+            if self.resolves_to(expr.func, "functools.partial", "partial") and expr.args:
+                return self._fn_node_of(expr.args[0], at)
+            return None
+        if isinstance(expr, ast.Name):
+            return self.local_function(expr.id, at)
+        return None
+
+    def _collect_traced(self) -> set:
+        traced: set = set()
+
+        def mark(fn: Optional[FuncNode]):
+            if fn is not None:
+                traced.add(fn)
+
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Call):
+                dotted = self.resolve(node.func) or ""
+                leaf = dotted.rsplit(".", 1)[-1]
+                if leaf in _TRACING_CALLS and (
+                    "jax" in dotted
+                    or leaf in ("shard_map", "pallas_call", "jit")
+                    or dotted == leaf
+                ):
+                    for idx in _TRACING_CALLS[leaf]:
+                        if idx < len(node.args):
+                            mark(self._fn_node_of(node.args[idx], node))
+                elif leaf == "jitted" and len(node.args) >= 2:
+                    # op-engine factory: make_fn itself runs eagerly at
+                    # build time, but every function DEFINED inside it is
+                    # the traced program
+                    factory = self._fn_node_of(node.args[1], node)
+                    if isinstance(factory, ast.Lambda):
+                        # lambda: lambda a, b: ... — the inner lambda(s)
+                        for sub in ast.walk(factory.body):
+                            if isinstance(sub, _FUNC_TYPES):
+                                traced.add(sub)
+                    elif factory is not None:
+                        for sub in ast.walk(factory):
+                            if isinstance(sub, _FUNC_TYPES) and sub is not factory:
+                                traced.add(sub)
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for dec in node.decorator_list:
+                    target = dec.func if isinstance(dec, ast.Call) else dec
+                    if self.resolves_to(target, "jax.jit", "jit"):
+                        traced.add(node)
+                    elif (
+                        isinstance(dec, ast.Call)
+                        and self.resolves_to(dec.func, "functools.partial", "partial")
+                        and dec.args
+                        and self.resolves_to(dec.args[0], "jax.jit", "jit")
+                    ):
+                        traced.add(node)
+        return traced
+
+    def in_traced_context(self, node: ast.AST) -> bool:
+        """True when ``node`` executes at trace time: some enclosing
+        function is (or is nested in) a traced function."""
+        return any(fn in self.traced_fns for fn in self.enclosing_functions(node))
+
+    # ------------------------------------------------------------------ #
+    # findings / suppression                                              #
+    # ------------------------------------------------------------------ #
+    def _suppressed(self, rule_id: str, node: ast.AST) -> bool:
+        stmt = self.enclosing_statement(node)
+        lines = {getattr(node, "lineno", 0), getattr(stmt, "lineno", 0)}
+        # for multiline simple statements (a jitted() call with its key on
+        # its own line) accept the pragma anywhere in the span; defs and
+        # classes stay first-line-only so a nested suppression cannot
+        # accidentally silence a finding anchored at the def itself
+        if not isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+            start = getattr(stmt, "lineno", 0)
+            end = getattr(stmt, "end_lineno", start) or start
+            lines.update(range(start, end + 1))
+        for ln in lines:
+            if 1 <= ln <= len(self.lines):
+                m = _SUPPRESS_RE.search(self.lines[ln - 1])
+                if m and rule_id in [s.strip() for s in m.group(1).split(",")]:
+                    return True
+        return False
+
+    def finding(
+        self, rule_id: str, node: ast.AST, message: str, hint: str = ""
+    ) -> Optional[Finding]:
+        """Build a Finding at ``node``, honoring inline suppressions."""
+        if self.skip_file or self._suppressed(rule_id, node):
+            return None
+        line = getattr(node, "lineno", 0)
+        snippet = self.lines[line - 1].strip() if 1 <= line <= len(self.lines) else ""
+        context = f"{self.qualname(node)}::{' '.join(snippet.split())}"
+        return Finding(
+            rule=rule_id,
+            path=self.relpath,
+            line=line,
+            message=message,
+            hint=hint,
+            context=context,
+        )
+
+
+def iter_py_files(paths: Sequence[str]) -> Iterable[str]:
+    for p in paths:
+        if os.path.isdir(p):
+            for root, dirs, files in os.walk(p):
+                dirs[:] = sorted(d for d in dirs if not d.startswith((".", "__pycache__")))
+                for f in sorted(files):
+                    if f.endswith(".py"):
+                        yield os.path.join(root, f)
+        elif p.endswith(".py"):
+            yield p
+
+
+def analyze_file(
+    path: str,
+    source: Optional[str] = None,
+    dynamic: bool = True,
+    relpath: Optional[str] = None,
+    rules: Optional[Sequence[str]] = None,
+) -> List[Finding]:
+    # import for the side effect of registering every rule
+    from . import checkers  # noqa: F401
+
+    ctx = FileContext(path, source=source, relpath=relpath)
+    if ctx.skip_file:
+        return []
+    findings: List[Finding] = []
+    for r in all_rules():
+        if rules is not None and r.id not in rules:
+            continue
+        if r.dynamic and not dynamic:
+            continue
+        findings.extend(f for f in r.check(ctx) if f is not None)
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    return findings
+
+
+def analyze_paths(
+    paths: Sequence[str], dynamic: bool = True, root: Optional[str] = None
+) -> List[Finding]:
+    """Analyze every ``.py`` under ``paths``; ``root`` anchors the
+    relative paths used in findings and baseline fingerprints."""
+    findings: List[Finding] = []
+    for f in iter_py_files(paths):
+        rel = os.path.relpath(f, root) if root else os.path.relpath(f)
+        findings.extend(analyze_file(f, dynamic=dynamic, relpath=rel))
+    return findings

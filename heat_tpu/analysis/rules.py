@@ -1,0 +1,75 @@
+"""Rule registry and the Finding record.
+
+A rule is a function ``check(ctx) -> Iterable[Finding]`` registered under a
+stable id.  Ids are grouped by family so suppressions and docs stay legible:
+
+=========  ===============================================================
+SPMD101    ppermute permutations must be valid (partial) bijections
+SPMD102    collective axis names must match the enclosing shard_map mesh
+SPMD201    trace purity: no host effects inside jit/shard_map/pallas fns
+SPMD301    Pallas BlockSpec tiles must respect the hardware tile grid
+SPMD302    pallas_call grids must be static (no traced values)
+SPMD401    jitted() cache keys: hashable, identity-stable parts only
+=========  ===============================================================
+
+The catalog with fix guidance lives in docs/lint.md; each checker's
+docstring is the source of truth for its exact conditions.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Callable, Dict, Iterable, List
+
+__all__ = ["Finding", "Rule", "RULES", "rule", "all_rules"]
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One analyzer hit: ``path:line  rule  message`` plus a fix hint."""
+
+    rule: str
+    path: str  # repo/package-relative where possible
+    line: int
+    message: str
+    hint: str = ""
+    #: stable identity for the baseline: deliberately line-insensitive
+    #: (enclosing def + normalized source snippet), so findings survive
+    #: unrelated edits above them
+    context: str = ""
+
+    def fingerprint(self) -> str:
+        return f"{self.rule}::{self.path}::{self.context}"
+
+    def render(self) -> str:
+        out = f"{self.path}:{self.line}: {self.rule} {self.message}"
+        if self.hint:
+            out += f"\n    hint: {self.hint}"
+        return out
+
+
+@dataclass
+class Rule:
+    id: str
+    title: str
+    check: Callable  # (FileContext) -> Iterable[Finding]
+    #: rules that execute snippets of the analyzed source (perm builders)
+    #: are skipped under --no-dynamic
+    dynamic: bool = False
+
+
+RULES: Dict[str, Rule] = {}
+
+
+def rule(rule_id: str, title: str, dynamic: bool = False):
+    """Register a checker under ``rule_id``."""
+
+    def deco(fn):
+        RULES[rule_id] = Rule(rule_id, title, fn, dynamic=dynamic)
+        return fn
+
+    return deco
+
+
+def all_rules() -> List[Rule]:
+    return [RULES[k] for k in sorted(RULES)]

@@ -17,13 +17,46 @@ chain into one device round trip.
 
 from __future__ import annotations
 
+import sys
+import types as _types
 from typing import Any, Callable, Dict, Tuple
+
+import numpy as np
 
 import jax
 
-__all__ = ["jitted", "clear_cache", "cache_size"]
+__all__ = ["jitted", "cache_stable", "clear_cache", "cache_size"]
 
 _CACHE: Dict[Tuple, Any] = {}
+
+
+def cache_stable(fn: Any) -> bool:
+    """True when ``fn``'s identity repeats across calls, so it is safe to
+    embed in a ``jitted`` key.
+
+    Import-time singletons qualify: plain module-level ``def``s, numpy
+    ufuncs, and any other callable that IS the attribute of its module
+    under its own name (``jnp.add`` is a ``ufunc`` instance, ``jnp.where``
+    a ``PjitFunction`` — both created once at import).  Lambdas, closures
+    (anything defined inside a function — ``"<locals>"`` in the qualname),
+    bound methods, and per-call ``partial`` objects do not: keying on a
+    per-call identity grows the cache by one dead entry per call without
+    ever hitting.  Callers must route unstable functions to a transient
+    ``jax.jit`` or the eager path instead (spmdlint rule SPMD401).
+    """
+    if getattr(fn, "__self__", None) is not None:
+        return False  # bound method: per-instance identity
+    if isinstance(fn, _types.FunctionType):
+        return (
+            fn.__closure__ is None
+            and "<locals>" not in fn.__qualname__
+            and fn.__name__ != "<lambda>"
+        )
+    if isinstance(fn, np.ufunc):
+        return True  # ufuncs only exist as import-time singletons
+    mod = sys.modules.get(getattr(fn, "__module__", None) or "")
+    name = getattr(fn, "__name__", None)
+    return mod is not None and name is not None and getattr(mod, name, None) is fn
 
 
 def jitted(key: Tuple, make_fn: Callable[[], Callable]) -> Callable:

@@ -28,7 +28,7 @@ import jax
 import jax.numpy as jnp
 
 from . import factories, sanitation, types
-from ._compile import jitted
+from ._compile import cache_stable, jitted
 from .communication import sanitize_comm
 from .dndarray import DNDarray
 from .stride_tricks import broadcast_shape, sanitize_axis
@@ -179,9 +179,11 @@ def __binary_op(
     # result_type instead, which profiling showed was ~60% of the whole
     # eager per-op cost (VERDICT r3 #7).
     statics = _freeze(fn_kwargs)
-    if statics is not None:
+    # `operation` in the key is safe only for cache-stable callables
+    # (module-level jnp functions); unstable ones take the eager path
+    if statics is not None and cache_stable(operation):
         fn = jitted(
-            ("binary", operation, statics),
+            ("binary", operation, statics),  # spmdlint: disable=SPMD401
             lambda: lambda x, y: operation(x, y, **fn_kwargs),
         )
         try:
@@ -244,9 +246,10 @@ def __local_op(
     if not no_cast and types.heat_type_is_exact(x.dtype):
         cast = jnp.float32 if x.dtype is not types.int64 else jnp.float64
     statics = _freeze(kwargs)
-    if statics is not None:
+    # keyed on `operation` only when cache-stable, else eager (SPMD401)
+    if statics is not None and cache_stable(operation):
         fn = jitted(
-            ("local", operation, cast, statics),
+            ("local", operation, cast, statics),  # spmdlint: disable=SPMD401
             lambda: lambda a: operation(a.astype(cast) if cast else a, **kwargs),
         )
         result = fn(arr)
@@ -321,7 +324,8 @@ def __reduce_op(
     out_split_pad = split if padded else None
     comm = x.comm
     statics = _freeze(kwargs)
-    if statics is not None:
+    # keyed on `reduction` only when cache-stable, else eager (SPMD401)
+    if statics is not None and cache_stable(reduction):
         def make():
             def f(a):
                 if pad_in is not None:
@@ -350,7 +354,7 @@ def __reduce_op(
 
         fn = jitted(
             ("reduce", reduction, axis, keepdims, cast, statics, pad_in, out_split_pad,
-             comm if padded else None),
+             comm if padded else None),  # spmdlint: disable=SPMD401
             make,
         )
         result = fn(x._buffer if padded else x.larray)
@@ -424,13 +428,18 @@ def __cum_op(
     else:
         # any other axis is unpadded: the buffer feeds the op directly
         arr = x._buffer if padded and axis != x.split else x.larray
-        fn = jitted(
-            ("cum", operation, axis, cast),
-            lambda: lambda a: (
-                lambda r: r.astype(cast) if cast is not None else r
-            )(operation(a, axis=axis)),
-        )
-        result = fn(arr)
+        if cache_stable(operation):
+            fn = jitted(
+                ("cum", operation, axis, cast),  # spmdlint: disable=SPMD401
+                lambda: lambda a: (
+                    lambda r: r.astype(cast) if cast is not None else r
+                )(operation(a, axis=axis)),
+            )
+            result = fn(arr)
+        else:
+            result = operation(arr, axis=axis)
+            if cast is not None:
+                result = result.astype(cast)
         result = _canonical_result(result)
         out_dtype = types.canonical_heat_type(result.dtype)
         result = x.comm.apply_sharding(result, x.split)

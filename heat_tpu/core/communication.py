@@ -489,6 +489,18 @@ class XlaCommunication(Communication):
         if orig % n != 0:
             array = self.pad_to_shards(array, axis=0)
         perm = tuple((int(s), int(d)) for s, d in perm)
+        # runtime twin of spmdlint SPMD101: ppermute silently drops or
+        # XOR-merges shards on duplicate endpoints — fail loudly instead
+        srcs = [s for s, _ in perm]
+        dsts = [d for _, d in perm]
+        bad = [v for v in srcs + dsts if not 0 <= v < n]
+        if bad:
+            raise ValueError(f"permute: index {bad[0]} out of range for {n} shards")
+        if len(set(srcs)) != len(srcs) or len(set(dsts)) != len(dsts):
+            raise ValueError(
+                f"permute: perm {perm} is not a partial bijection "
+                "(duplicate source or destination)"
+            )
         mesh = self._mesh
         axis = self.axis_name
 

@@ -476,7 +476,7 @@ def _moment2(x, axis, ddof, kwargs, name, finalize):
         raise TypeError(f"unexpected keyword arguments: {sorted(kwargs)}")
     cast = jnp.float32 if types.heat_type_is_exact(x.dtype) else None
     fn = jitted(
-        (name, axis, ddof, cast, keepdims),
+        ("stat.moment2", name, axis, ddof, cast, keepdims),
         lambda: lambda a: finalize(
             jnp.var(a.astype(cast) if cast else a, axis=axis, ddof=ddof, keepdims=keepdims)
         ),

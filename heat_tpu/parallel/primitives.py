@@ -16,7 +16,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec
 
-from ..core._compile import jitted
+from ..core._compile import cache_stable, jitted
 from ..core._jax_compat import pcast, shard_map
 from ..core.communication import XlaCommunication, get_comm
 from ..core.dndarray import DNDarray
@@ -122,18 +122,11 @@ def ring_map(
     # cached per (comm, fn) — but only for cache-STABLE fns: a
     # module-level plain function repeats its identity across calls, so
     # the compiled ring program is reused.  Everything else — lambdas,
-    # closures (anything defined inside a function: "<locals>" in the
-    # qualname), bound methods (per-instance identity, possibly
-    # unhashable receiver) — gets a transient jit (the old behavior):
+    # closures, bound methods — gets a transient jit (the old behavior):
     # keying on per-call identities would grow the global cache by one
     # dead entry per call without ever hitting
-    if (
-        getattr(fn, "__closure__", None) is None
-        and "<locals>" not in getattr(fn, "__qualname__", "<locals>")
-        and getattr(fn, "__name__", "<lambda>") != "<lambda>"
-        and getattr(fn, "__self__", None) is None
-    ):
-        out = jitted(("ring_map", comm, fn), make)(arr)
+    if cache_stable(fn):
+        out = jitted(("ring_map", comm, fn), make)(arr)  # spmdlint: disable=SPMD401
     else:
         out = jax.jit(make())(arr)
     return out

@@ -8,6 +8,11 @@ cd "$(dirname "$0")/.."
 sizes=("$@")
 [ $# -eq 0 ] && sizes=(1 2 3 4 7)
 fail=0
+echo "=== spmdlint (static SPMD-correctness gate, docs/lint.md) ==="
+if ! python scripts/spmdlint.py --baseline; then
+    echo "FAILED spmdlint"
+    fail=1
+fi
 for n in "${sizes[@]}"; do
     echo "=== mesh size $n ==="
     if ! HEAT_TEST_DEVICES="$n" python -m pytest tests/ -q -x; then

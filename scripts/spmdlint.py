@@ -1,0 +1,20 @@
+#!/usr/bin/env python
+"""Entry point for the SPMD-correctness linter.
+
+    python scripts/spmdlint.py heat_tpu/            # full report, exit 1 on findings
+    python scripts/spmdlint.py --baseline           # CI gate: fail on NEW findings only
+    python scripts/spmdlint.py --update-baseline    # rewrite spmdlint-baseline.json
+    python scripts/spmdlint.py --list-rules
+
+See docs/lint.md for the rule catalog and suppression syntax.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from heat_tpu.analysis.cli import main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(main())

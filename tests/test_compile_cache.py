@@ -1,0 +1,95 @@
+"""The op engine's keyed jit cache: re-entry, clearing, stability.
+
+Covers the `clear_cache()` / `cache_size()` / `jitted()` contract (the
+cache must repopulate identically after a clear) and the `cache_stable()`
+predicate that gates which callables may appear in keys (spmdlint
+SPMD401's runtime twin).
+"""
+
+from functools import partial
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from heat_tpu.core._compile import cache_size, cache_stable, clear_cache, jitted
+
+
+def _module_level_fn(x):
+    return x + 1
+
+
+class _Obj:
+    def method(self):  # pragma: no cover - identity only
+        return None
+
+
+def test_jitted_reentry_hits_cache():
+    clear_cache()
+    calls = []
+
+    def make():
+        calls.append(1)
+        return lambda a: a * 2.0
+
+    key = ("test.reentry", 0)
+    f1 = jitted(key, make)
+    f2 = jitted(key, make)
+    assert f1 is f2, "same key must return the same compiled callable"
+    assert len(calls) == 1, "make_fn runs only on the miss"
+    assert cache_size() == 1
+
+
+def test_cache_repopulates_identically_after_clear():
+    clear_cache()
+    key = ("test.clear", 3)
+
+    def make():
+        return lambda a: a + 3.0
+
+    x = jnp.arange(5.0)
+    f1 = jitted(key, make)
+    before = np.asarray(f1(x))
+    assert cache_size() == 1
+
+    clear_cache()
+    assert cache_size() == 0
+
+    f2 = jitted(key, make)
+    assert f2 is not f1, "clear must really drop the entry"
+    assert cache_size() == 1
+    np.testing.assert_array_equal(np.asarray(f2(x)), before)
+    # re-entry after repopulation is again a pure cache hit
+    assert jitted(key, make) is f2 and cache_size() == 1
+
+
+def test_distinct_keys_distinct_entries():
+    clear_cache()
+    make = lambda: lambda a: a  # noqa: E731
+    jitted(("test.k", 1), make)
+    jitted(("test.k", 2), make)
+    assert cache_size() == 2
+
+
+def test_cache_stable_accepts_import_time_singletons():
+    assert cache_stable(_module_level_fn)
+    assert cache_stable(jnp.add)       # jax ufunc singleton
+    assert cache_stable(np.add)        # numpy ufunc
+    assert cache_stable(jnp.sum)       # plain function
+    assert cache_stable(jnp.maximum)   # PjitFunction singleton
+
+
+def test_cache_stable_rejects_per_call_identities():
+    assert not cache_stable(lambda x: x)
+
+    def outer():
+        y = 2.0
+
+        def closure(x):
+            return x * y
+
+        return closure
+
+    assert not cache_stable(outer())
+    assert not cache_stable(_Obj().method)
+    assert not cache_stable(partial(_module_level_fn, 1))

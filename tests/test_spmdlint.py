@@ -1,0 +1,447 @@
+"""spmdlint: per-rule fixture tests + the zero-new-findings CI gate.
+
+Each rule gets at least one fixture that TRIGGERS it and one clean
+fixture that passes; the final tests run the analyzer over the real
+``heat_tpu`` tree and assert nothing new fires (the committed baseline is
+currently empty, so "nothing new" means "nothing at all").  The runtime
+property tests at the bottom pin the lint rules to ground truth: the
+perm builders the analyzer verifies by simulation are also executed and
+checked directly for mesh sizes 1..8.
+"""
+
+import json
+import os
+
+import pytest
+
+from heat_tpu.analysis import Finding, all_rules, analyze_file, analyze_paths
+from heat_tpu.analysis.baseline import load_baseline, partition, write_baseline
+from heat_tpu.analysis.checkers import (
+    MESH_SIZES,
+    check_partial_bijection,
+    verify_ring_schedule,
+    verify_zigzag_builders,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def lint(source, rule=None, dynamic=True):
+    findings = analyze_file(
+        os.path.join(REPO, "tests", "_fixture.py"),
+        source=source,
+        dynamic=dynamic,
+        relpath="tests/_fixture.py",
+    )
+    if rule is not None:
+        findings = [f for f in findings if f.rule == rule]
+    return findings
+
+
+def rules_of(findings):
+    return sorted({f.rule for f in findings})
+
+
+# --------------------------------------------------------------------- #
+# SPMD101: ppermute bijections                                           #
+# --------------------------------------------------------------------- #
+def test_spmd101_triggers_on_duplicate_destination():
+    src = """
+import jax
+
+def kernel(x, size):
+    perm = [(i, 0) for i in range(size)]
+    return jax.lax.ppermute(x, "ax", perm)
+"""
+    findings = lint(src, "SPMD101")
+    assert findings, "duplicate-destination perm must fire SPMD101"
+    assert "duplicate destination" in findings[0].message
+
+
+def test_spmd101_triggers_on_out_of_range():
+    src = """
+import jax
+
+def kernel(x, size):
+    return jax.lax.ppermute(x, "ax", [(i, i + 1) for i in range(size)])
+"""
+    findings = lint(src, "SPMD101")
+    assert findings and "out of range" in findings[0].message
+
+
+def test_spmd101_clean_on_rotation_and_partial_perms():
+    src = """
+import jax
+
+def rotate(x, size):
+    perm = [(i, (i + 1) % size) for i in range(size)]
+    return jax.lax.ppermute(x, "ax", perm)
+
+def halo(x, size):
+    # partial perms (boundary shards idle) are legal ppermute
+    fwd = [(i, i + 1) for i in range(size - 1)]
+    return jax.lax.ppermute(x, "ax", fwd)
+"""
+    assert lint(src, "SPMD101") == []
+
+
+def test_spmd101_verifies_builder_by_simulation():
+    bad = """
+def ring_source(position, round, size):
+    return (position + round) % size
+"""
+    findings = lint(bad, "SPMD101")
+    assert findings and "fails simulation" in findings[0].message
+
+    good = """
+def ring_source(position, round, size):
+    return (position - round) % size
+"""
+    assert lint(good, "SPMD101") == []
+
+
+def test_spmd101_skipped_without_dynamic():
+    src = """
+import jax
+
+def kernel(x, size):
+    return jax.lax.ppermute(x, "ax", [(i, 0) for i in range(size)])
+"""
+    assert lint(src, "SPMD101", dynamic=False) == []
+
+
+# --------------------------------------------------------------------- #
+# SPMD102: collective axis names                                         #
+# --------------------------------------------------------------------- #
+def test_spmd102_triggers_on_axis_string_mismatch():
+    src = """
+import jax
+from jax.sharding import PartitionSpec
+from jax.experimental.shard_map import shard_map
+
+def f(x, mesh):
+    return shard_map(
+        lambda s: jax.lax.psum(s, "other"),
+        mesh=mesh,
+        in_specs=PartitionSpec("heat"),
+        out_specs=PartitionSpec("heat"),
+    )(x)
+"""
+    findings = lint(src, "SPMD102")
+    assert findings and "'other'" in findings[0].message
+
+
+def test_spmd102_triggers_on_unrelated_axis_variable():
+    src = """
+import jax
+from jax.sharding import PartitionSpec
+from jax.experimental.shard_map import shard_map
+
+def f(x, mesh, comm):
+    name = comm.axis_name
+    rogue = "elsewhere"
+    return shard_map(
+        lambda s: jax.lax.psum(s, rogue),
+        mesh=mesh,
+        in_specs=PartitionSpec(name),
+        out_specs=PartitionSpec(name),
+    )(x)
+"""
+    assert lint(src, "SPMD102")
+
+
+def test_spmd102_clean_on_axis_name_binding():
+    src = """
+import jax
+from jax.sharding import PartitionSpec
+from jax.experimental.shard_map import shard_map
+
+def f(x, mesh, comm):
+    name = comm.axis_name
+    def kernel(s):
+        i = jax.lax.axis_index(name)
+        return jax.lax.psum(s, name) + i
+    return shard_map(
+        kernel, mesh=mesh,
+        in_specs=PartitionSpec(name), out_specs=PartitionSpec(name),
+    )(x)
+
+def helper_passthrough(s, axis_name):
+    # parameters are validated at call sites, not here
+    return jax.lax.psum(s, axis_name)
+"""
+    assert lint(src, "SPMD102") == []
+
+
+# --------------------------------------------------------------------- #
+# SPMD201: trace purity                                                  #
+# --------------------------------------------------------------------- #
+def test_spmd201_triggers_on_host_effects():
+    src = """
+import time
+import numpy as np
+import jax
+
+@jax.jit
+def f(x):
+    t = time.time()
+    print(x)
+    noise = np.random.uniform()
+    return x * t + noise
+"""
+    findings = lint(src, "SPMD201")
+    msgs = " | ".join(f.message for f in findings)
+    assert "time.time" in msgs and "print" in msgs and "numpy.random" in msgs
+
+
+def test_spmd201_triggers_on_global_write_in_shard_map_kernel():
+    src = """
+from jax.experimental.shard_map import shard_map
+
+_STATE = 0
+
+def f(x, mesh, specs):
+    def kernel(s):
+        global _STATE
+        _STATE += 1
+        return s
+    return shard_map(kernel, mesh=mesh, in_specs=specs, out_specs=specs)(x)
+"""
+    findings = lint(src, "SPMD201")
+    assert findings and "global" in findings[0].message
+
+
+def test_spmd201_clean_outside_traced_context():
+    src = """
+import time
+import jax
+
+def untraced(x):
+    print(x)          # host-side helper: fine
+    return time.time()
+
+@jax.jit
+def f(x):
+    return x * 2.0    # pure
+"""
+    assert lint(src, "SPMD201") == []
+
+
+def test_spmd201_sees_through_jitted_factories():
+    src = """
+from heat_tpu.core._compile import jitted
+
+def op(x):
+    fn = jitted(("op",), lambda: lambda a: print(a) or a)
+    return fn(x)
+"""
+    findings = lint(src, "SPMD201")
+    assert findings and "print" in findings[0].message
+
+
+# --------------------------------------------------------------------- #
+# SPMD301/302: Pallas tiling and grids                                   #
+# --------------------------------------------------------------------- #
+def test_spmd301_triggers_on_off_tile_blocks():
+    src = """
+from jax.experimental import pallas as pl
+
+def build(kernel):
+    bad_minor = pl.BlockSpec((8, 100), lambda i: (i, 0))
+    bad_sublane = pl.BlockSpec((9, 128), lambda i: (i, 0))
+    return bad_minor, bad_sublane
+"""
+    findings = lint(src, "SPMD301")
+    assert len(findings) == 2
+    assert "128-lane" in findings[0].message and "sublane" in findings[1].message
+
+
+def test_spmd301_clean_on_tile_aligned_and_symbolic_blocks():
+    src = """
+from jax.experimental import pallas as pl
+
+def build(bq, D):
+    ok = pl.BlockSpec((8, 128), lambda i: (i, 0))
+    scalar = pl.BlockSpec((1, 128), lambda i: (i, 0))
+    symbolic = pl.BlockSpec((1, bq, D), lambda b, q: (b, q, 0))
+    return ok, scalar, symbolic
+"""
+    assert lint(src, "SPMD301") == []
+
+
+def test_spmd302_triggers_on_traced_grid():
+    src = """
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+def build(kernel, x):
+    return pl.pallas_call(kernel, grid=(jnp.argmax(x),))
+"""
+    findings = lint(src, "SPMD302")
+    assert findings and "traced value" in findings[0].message
+
+
+def test_spmd302_clean_on_static_grid():
+    src = """
+from jax.experimental import pallas as pl
+
+def build(kernel, S, bq):
+    return pl.pallas_call(kernel, grid=(S // bq, 4))
+"""
+    assert lint(src, "SPMD302") == []
+
+
+# --------------------------------------------------------------------- #
+# SPMD401: jitted() cache-key hygiene                                    #
+# --------------------------------------------------------------------- #
+def test_spmd401_triggers_on_callable_in_key():
+    src = """
+from heat_tpu.core._compile import jitted
+
+def apply(fn, x):
+    return jitted(("apply", fn), lambda: lambda a: fn(a))(x)
+"""
+    findings = lint(src, "SPMD401")
+    assert findings and "callable 'fn'" in findings[0].message
+
+
+def test_spmd401_triggers_on_lambda_array_and_shapeless_keys():
+    src = """
+import jax.numpy as jnp
+from heat_tpu.core._compile import jitted
+
+def bad(x):
+    a = jitted(("k1", lambda: 1), lambda: lambda v: v)(x)
+    b = jitted(("k2", jnp.zeros(3)), lambda: lambda v: v)(x)
+    c = jitted(make_key(), lambda: lambda v: v)(x)
+    d = jitted((1, 2), lambda: lambda v: v)(x)
+    return a, b, c, d
+"""
+    msgs = " | ".join(f.message for f in lint(src, "SPMD401"))
+    assert "lambda in jitted() key" in msgs
+    assert "array-valued call" in msgs
+    assert "not a statically-visible tuple literal" in msgs
+    assert "namespace string" in msgs
+
+
+def test_spmd401_clean_on_static_data_keys():
+    src = """
+from heat_tpu.core._compile import jitted
+
+def good(x, axis, comm, widths):
+    key = ("op.good", axis, str(x.dtype), x.ndim, comm, tuple(widths))
+    return jitted(key, lambda: lambda v: v)(x)
+"""
+    assert lint(src, "SPMD401") == []
+
+
+# --------------------------------------------------------------------- #
+# suppressions / baseline mechanics                                      #
+# --------------------------------------------------------------------- #
+def test_inline_suppression_and_skip_file():
+    hot = """
+import time
+import jax
+
+@jax.jit
+def f(x):
+    return x * time.time()  # spmdlint: disable=SPMD201
+"""
+    assert lint(hot, "SPMD201") == []
+
+    skipped = """# spmdlint: skip-file
+import time
+import jax
+
+@jax.jit
+def f(x):
+    return x * time.time()
+"""
+    assert lint(skipped) == []
+
+
+def test_suppression_is_rule_specific():
+    src = """
+import time
+import jax
+
+@jax.jit
+def f(x):
+    return x * time.time()  # spmdlint: disable=SPMD401
+"""
+    assert lint(src, "SPMD201"), "suppressing another rule must not silence SPMD201"
+
+
+def test_baseline_partition_roundtrip(tmp_path):
+    f1 = Finding(rule="SPMD201", path="a.py", line=3, message="m", context="f::x")
+    f2 = Finding(rule="SPMD401", path="b.py", line=9, message="n", context="g::y")
+    path = str(tmp_path / "base.json")
+    write_baseline(path, [f1])
+    base = load_baseline(path)
+    new, old, stale = partition([f1, f2], base)
+    assert [f.rule for f in new] == ["SPMD401"]
+    assert [f.rule for f in old] == ["SPMD201"]
+    assert stale == []
+    # f1 fixed -> its entry goes stale
+    new, old, stale = partition([f2], base)
+    assert len(stale) == 1 and "SPMD201" in stale[0]
+    with open(path) as fh:
+        assert json.load(fh)["version"] == 1
+
+
+def test_baseline_fingerprint_is_line_insensitive():
+    a = Finding(rule="SPMD201", path="a.py", line=3, message="m", context="f::print(x)")
+    b = Finding(rule="SPMD201", path="a.py", line=30, message="m", context="f::print(x)")
+    assert a.fingerprint() == b.fingerprint()
+
+
+# --------------------------------------------------------------------- #
+# the CI gate: the real tree is clean                                    #
+# --------------------------------------------------------------------- #
+def test_every_rule_is_registered():
+    assert [r.id for r in all_rules()] == [
+        "SPMD101", "SPMD102", "SPMD201", "SPMD301", "SPMD302", "SPMD401",
+    ]
+
+
+def test_real_tree_has_no_new_findings():
+    findings = analyze_paths([os.path.join(REPO, "heat_tpu")], root=REPO)
+    baseline = load_baseline(os.path.join(REPO, "spmdlint-baseline.json"))
+    new, _, _ = partition(findings, baseline)
+    assert new == [], "new spmdlint findings:\n" + "\n".join(f.render() for f in new)
+
+
+# --------------------------------------------------------------------- #
+# runtime ground truth: the builders the lint rule simulates             #
+# --------------------------------------------------------------------- #
+@pytest.mark.parametrize("size", MESH_SIZES)
+def test_zigzag_perms_are_bijections(size):
+    from heat_tpu.parallel.primitives import (
+        zigzag_chunk_owner,
+        zigzag_inverse_perms,
+        zigzag_perms,
+    )
+
+    for builder in (zigzag_perms, zigzag_inverse_perms):
+        for perm in builder(size):
+            assert check_partial_bijection(perm, size) is None
+            assert {d for _, d in perm} == set(range(size)), "must cover every device"
+    assert (
+        verify_zigzag_builders(
+            zigzag_perms, zigzag_inverse_perms, zigzag_chunk_owner, sizes=[size]
+        )
+        is None
+    )
+
+
+@pytest.mark.parametrize("size", MESH_SIZES)
+def test_ring_map_schedule_is_a_bijection(size):
+    from heat_tpu.parallel.primitives import ring_source
+
+    perm = [(i, (i + 1) % size) for i in range(size)]
+    assert check_partial_bijection(perm, size) is None
+    assert verify_ring_schedule(ring_source, sizes=[size]) is None
+    # every round of the ring visits each source exactly once per position
+    for pos in range(size):
+        sources = {ring_source(pos, r, size) for r in range(size)}
+        assert sources == set(range(size))
