@@ -94,6 +94,7 @@ _HEADLINE = {
     "kmedians_churn_iter_per_sec": True,
     "kmedoids_iter_per_sec": True,
     "eager_ops_per_sec": True,
+    "fused_pipeline_ms": False,
     "lasso_sweeps_per_sec": True,
     "qr_svd_tall_skinny_ms": False,
     "attention_tokens_per_sec": True,
@@ -138,6 +139,10 @@ _GOLDEN_MAP = {
     "kmedians_churn_iter_per_sec": ("reduce_gb_per_sec", "div"),
     "kmedoids_iter_per_sec": ("reduce_gb_per_sec", "div"),
     "eager_ops_per_sec": ("roundtrip_ms", "mul"),
+    # one dispatch per call: the metric IS a tunnel latency plus a small
+    # kernel, so its control is the latency golden ("div": two latencies
+    # move together under a slower tunnel, the ratio stays put)
+    "fused_pipeline_ms": ("roundtrip_ms", "div"),
     "lasso_sweeps_per_sec": ("reduce_gb_per_sec", "div"),
     # qr_svd is a single fused dispatch as of r6 (the whole QR+SVD
     # pipeline in one fenced fori_loop — see qr_svd_ms), so the metric is
@@ -253,6 +258,10 @@ _NOT_MODELED = {
         "medoid search is data-dependent argmin cascades, not fixed work",
     "eager_ops_per_sec":
         "dispatch-latency-bound by design (measures the wrapper, not the chip)",
+    "fused_pipeline_ms":
+        "dispatch-latency-bound by design: one fused dispatch per call on a "
+        "tiny operand — the headline is the latency collapse vs "
+        "eager_pipeline_ms, not chip throughput",
 }
 
 
@@ -353,6 +362,11 @@ _FLAG_DISPOSITIONS = {
         "measures 0.32-0.83 ms/op across runs (docs/design.md §3); the "
         "wrapper's own Python cost was profiled at ~116 us/op on r4 (was "
         "~400 in r3)",
+    "fused_pipeline_ms":
+        "new in r7 (the ht.fuse tentpole): one dispatch per 5-op pipeline; "
+        "no prior-round history — compare against the in-run "
+        "eager_pipeline_ms aux twin and the roundtrip_ms golden, and flag "
+        "only once r7 establishes a best",
     "global_sum_gb_per_sec":
         "bimodal by design of the hardware: ~690 GB/s when the 64 MB "
         "operand streams from HBM, 900-1900 when XLA keeps it VMEM-resident "
@@ -858,6 +872,55 @@ def eager_ops_per_sec(X):
     return _slope_rate(timed, *_win(100, 1200, 5))
 
 
+def _bench_pipeline(a, bb):
+    """The 5-op fused-vs-eager benchmark pipeline.  MODULE-LEVEL on
+    purpose: a nested def is a fresh closure per bench call, fails
+    ``cache_stable``, and makes every fused call a transient recompile
+    (~25 ms/call measured on the CPU smoke run) — the exact failure mode
+    the fuse cache key is designed to refuse to cache."""
+    import heat_tpu as ht
+
+    c = a + bb
+    d = c - a
+    e = ht.abs(d)
+    f = ht.sqrt(e)
+    return ht.minimum(f + c, bb * 2.0)
+
+
+def fused_pipeline_ms(X):
+    """Wall-clock per call of a 5-op DNDarray pipeline compiled by
+    ``ht.fuse`` into ONE device dispatch (the PR-3 tentpole), next to the
+    SAME pipeline run op-by-op through the eager API (~6 dispatches).
+    The eager twin ships as aux context (``eager_pipeline_ms``) so the
+    fused win is readable in one place; the dispatch-count identity
+    (fused == exactly 1) is asserted by tests/test_fuse.py, so this
+    metric purely tracks the latency it buys.  Chained ``y = fused(y,
+    b)`` calls serialize on the data dependency; slope over call counts
+    cancels the single readback fence."""
+    from heat_tpu.core.fuse import fuse
+
+    small = X[:1024]  # dispatch-dominated shards, as in eager_ops_per_sec
+    b = small * 0.5 + 1.5
+    pipeline = _bench_pipeline
+    fused = fuse(pipeline)
+
+    def chained(step):
+        def timed(n):
+            t0 = time.perf_counter()
+            y = small
+            for _ in range(n):
+                y = step(y, b)
+            np.asarray(y.larray[0, 0])  # fence
+            return time.perf_counter() - t0
+        return timed
+
+    # ~0.2 ms fused / ~1 ms eager per call: 400-call regions clear the
+    # ~100 ms tunnel round-trip for both
+    fused_rate, fused_spread = _slope_rate(chained(fused), *_win(40, 400, 5))
+    eager_rate, eager_spread = _slope_rate(chained(pipeline), *_win(40, 400, 5))
+    return (1e3 / fused_rate, fused_spread), (1e3 / eager_rate, eager_spread)
+
+
 def qr_svd_ms():
     """Tall-skinny QR + SVD wall-clock (BASELINE config 5: resplit-heavy
     linalg on a tall-skinny split DNDarray).
@@ -955,6 +1018,7 @@ _METRIC_GROUP = {
     "kmedians_churn_iter_per_sec": "medians",
     "kmedoids_iter_per_sec": "medians",
     "eager_ops_per_sec": "eager_lasso",
+    "fused_pipeline_ms": "eager_lasso",
     "lasso_sweeps_per_sec": "eager_lasso",
     "qr_svd_tall_skinny_ms": "qr",
     "attention_tokens_per_sec": "attention",
@@ -1020,6 +1084,10 @@ def main():
     ) = medians_medoids_rates(X, centers)
     golden.measure("eager_lasso")
     eager_rate, eager_spread = eager_ops_per_sec(X)
+    (
+        (fused_ms, fused_ms_spread),
+        (eager_pipe_ms, eager_pipe_spread),
+    ) = fused_pipeline_ms(X)
     lasso_sweeps, lasso_spread = lasso_rate(data, X)
     golden.measure("qr")
     qr_ms, qr_spread = qr_svd_ms()
@@ -1047,6 +1115,11 @@ def main():
                 "kmedians_churn_iter_per_sec": round(churn_rate, 2),
                 "kmedoids_iter_per_sec": round(medoid_rate, 2),
                 "eager_ops_per_sec": round(eager_rate, 2),
+                # PR-3 tentpole: ONE device dispatch for a 5-op DNDarray
+                # pipeline under ht.fuse; the aux twin below is the same
+                # pipeline through the eager per-op path (~6 dispatches)
+                "fused_pipeline_ms": round(fused_ms, 3),
+                "eager_pipeline_ms": round(eager_pipe_ms, 3),
                 "lasso_sweeps_per_sec": round(lasso_sweeps, 2),
                 "qr_svd_tall_skinny_ms": round(qr_ms, 2),
                 # sequence-parallel flagship: fused flash-attention
@@ -1069,6 +1142,8 @@ def main():
                     "kmedians_churn_iter_per_sec": churn_spread,
                     "kmedoids_iter_per_sec": medoid_spread,
                     "eager_ops_per_sec": eager_spread,
+                    "fused_pipeline_ms": fused_ms_spread,
+                    "eager_pipeline_ms": eager_pipe_spread,
                     "lasso_sweeps_per_sec": lasso_spread,
                     "qr_svd_tall_skinny_ms": qr_spread,
                     "attention_tokens_per_sec": attn_spread,

@@ -535,6 +535,135 @@ def check_trace_purity(ctx: FileContext) -> Iterable[Finding]:
 
 
 # --------------------------------------------------------------------- #
+# SPMD202: host-sync coercions on traced values                          #
+# --------------------------------------------------------------------- #
+#: method calls that materialize a device value on the host
+_SYNC_METHODS = {"item", "tolist", "numpy"}
+#: numpy entry points that pull a traced array back to host memory
+_NP_MATERIALIZERS = {"asarray", "array", "ascontiguousarray", "asfortranarray"}
+#: scalar coercions that force a device→host sync when fed a traced value
+_COERCIONS = {"float", "int", "bool", "complex"}
+#: attribute leaves that are compile-time metadata, not device values —
+#: coercing these is free and legitimate (``int(x.shape[0])``)
+_STATIC_ATTRS = {
+    "shape", "gshape", "lshape", "ndim", "size", "split", "itemsize",
+    "dtype", "balanced",
+}
+#: array-method reductions whose results are device values
+_REDUCTION_METHODS = {
+    "sum", "max", "min", "mean", "prod", "norm", "argmax", "argmin",
+    "all", "any", "std", "var", "dot", "astype",
+}
+
+
+def _is_static_expr(ctx: FileContext, expr: ast.AST, at: ast.AST, depth: int = 0) -> bool:
+    """True when ``expr`` is visibly compile-time metadata (shape/ndim
+    arithmetic, constants, ``len()``) — coercing it never touches the
+    device."""
+    if depth > 5:
+        return False
+    if isinstance(expr, ast.Constant):
+        return True
+    if isinstance(expr, ast.Attribute):
+        return expr.attr in _STATIC_ATTRS
+    if isinstance(expr, ast.Subscript):
+        return _is_static_expr(ctx, expr.value, at, depth + 1)
+    if isinstance(expr, ast.BinOp):
+        return _is_static_expr(ctx, expr.left, at, depth + 1) and _is_static_expr(
+            ctx, expr.right, at, depth + 1
+        )
+    if isinstance(expr, ast.UnaryOp):
+        return _is_static_expr(ctx, expr.operand, at, depth + 1)
+    if isinstance(expr, ast.Call):
+        return isinstance(expr.func, ast.Name) and expr.func.id == "len"
+    if isinstance(expr, ast.Name):
+        rec = ctx.lookup(expr.id, at)
+        if rec is not None and rec[0] == "expr":
+            return _is_static_expr(ctx, rec[1], at, depth + 1)
+    return False
+
+
+def _is_device_value_expr(ctx: FileContext, expr: ast.AST) -> bool:
+    """True when ``expr`` visibly produces a device value: any ``jax.*``
+    call, an array-method reduction, or a ``.larray``/``._buffer``
+    access anywhere inside it."""
+    for sub in ast.walk(expr):
+        if isinstance(sub, ast.Call):
+            dotted = ctx.resolve(sub.func) or ""
+            if dotted.startswith("jax.") or dotted == "jax":
+                return True
+            if isinstance(sub.func, ast.Attribute) and sub.func.attr in _REDUCTION_METHODS:
+                return True
+        elif isinstance(sub, ast.Attribute) and sub.attr in ("larray", "_buffer"):
+            return True
+    return False
+
+
+@rule("SPMD202", "no host-sync coercions of traced values inside traced functions")
+def check_host_sync(ctx: FileContext) -> Iterable[Finding]:
+    """Inside functions traced by ``jit``/``shard_map``/``fuse`` (or
+    nested in an op-engine ``jitted`` factory), value-forcing operations —
+    ``.item()``/``.tolist()``/``.numpy()``, ``np.asarray``/``np.array``,
+    and ``float()``/``int()``/``bool()``/``complex()`` of device values —
+    either crash on the tracer (``TracerConversionError`` / heat_tpu's
+    ``FuseTraceError``) or, worse, silently freeze a trace-time constant
+    into the compiled program.  Coercions of static metadata
+    (``int(x.shape[0])``) are exempt; a bare-name coercion is flagged only
+    when its assignment visibly produced a device value, so python-int
+    loop bookkeeping never trips it."""
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call) or not ctx.in_traced_context(node):
+            continue
+        if isinstance(node.func, ast.Attribute) and node.func.attr in _SYNC_METHODS:
+            yield ctx.finding(
+                "SPMD202", node,
+                f"host-sync method .{node.func.attr}() inside a traced function",
+                hint="the result is a tracer, not a value: keep the "
+                "computation on-device (jnp.where / lax.cond) or move "
+                "this step outside the traced function",
+            )
+            continue
+        dotted = ctx.resolve(node.func) or ""
+        leaf = dotted.rsplit(".", 1)[-1]
+        if leaf in _NP_MATERIALIZERS and dotted.startswith("numpy."):
+            yield ctx.finding(
+                "SPMD202", node,
+                f"numpy materialization {dotted!r} inside a traced function",
+                hint="np.asarray on a tracer forces a host copy (or "
+                "crashes); use jnp equivalents so the value stays in the "
+                "compiled program",
+            )
+            continue
+        if (
+            isinstance(node.func, ast.Name)
+            and node.func.id in _COERCIONS
+            and node.func.id not in ctx.aliases  # shadowed by an import
+            and len(node.args) == 1
+        ):
+            arg = node.args[0]
+            if _is_static_expr(ctx, arg, node):
+                continue
+            flagged = _is_device_value_expr(ctx, arg)
+            if not flagged and isinstance(arg, ast.Name):
+                rec = ctx.lookup(arg.id, node)
+                flagged = (
+                    rec is not None
+                    and rec[0] == "expr"
+                    and _is_device_value_expr(ctx, rec[1])
+                )
+            if flagged:
+                yield ctx.finding(
+                    "SPMD202", node,
+                    f"scalar coercion {node.func.id}() of a device value "
+                    "inside a traced function",
+                    hint="this blocks on device→host transfer per call (or "
+                    "raises under fuse); keep the decision on-device with "
+                    "jnp.where / lax.cond, or hoist the sync out of the "
+                    "traced region",
+                )
+
+
+# --------------------------------------------------------------------- #
 # SPMD301/302: Pallas tiling and grids                                   #
 # --------------------------------------------------------------------- #
 @rule("SPMD301", "Pallas BlockSpec tiles must respect the hardware tile grid")

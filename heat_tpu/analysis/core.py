@@ -11,9 +11,10 @@ rules need:
 - per-scope assignment tables (including tuple-unpacking, the
   ``mesh, name = comm.mesh, comm.axis_name`` idiom);
 - the set of TRACED functions: anything passed to ``jit`` / ``shard_map``
-  / ``pallas_call`` / ``lax.fori_loop``-family / ``vmap``/``grad``,
-  decorated with ``jax.jit`` (bare or via ``partial``), or nested inside a
-  factory handed to the op engine's ``jitted``;
+  / ``pallas_call`` / ``lax.fori_loop``-family / ``vmap``/``grad`` /
+  ``heat_tpu.fuse``, decorated with ``jax.jit`` or ``fuse`` (bare or via
+  ``partial``), or nested inside a factory handed to the op engine's
+  ``jitted``;
 - inline-suppression handling (``# spmdlint: disable=SPMD101`` on the
   finding's line or its statement's first line, ``# spmdlint: skip-file``
   in the header).
@@ -51,6 +52,9 @@ _TRACING_CALLS = {
     "value_and_grad": (0,),
     "checkpoint": (0,),
     "remat": (0,),
+    # heat_tpu.fuse: the whole-program compiler traces its function the
+    # same way jit does (core/fuse.py) — host syncs inside it are bugs
+    "fuse": (0,),
 }
 
 FuncNode = Union[ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda]
@@ -268,7 +272,7 @@ class FileContext:
                 leaf = dotted.rsplit(".", 1)[-1]
                 if leaf in _TRACING_CALLS and (
                     "jax" in dotted
-                    or leaf in ("shard_map", "pallas_call", "jit")
+                    or leaf in ("shard_map", "pallas_call", "jit", "fuse")
                     or dotted == leaf
                 ):
                     for idx in _TRACING_CALLS[leaf]:
@@ -291,7 +295,7 @@ class FileContext:
             elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
                 for dec in node.decorator_list:
                     target = dec.func if isinstance(dec, ast.Call) else dec
-                    if self.resolves_to(target, "jax.jit", "jit"):
+                    if self.resolves_to(target, "jax.jit", "jit", "fuse"):
                         traced.add(node)
                     elif (
                         isinstance(dec, ast.Call)

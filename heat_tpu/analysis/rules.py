@@ -7,6 +7,7 @@ stable id.  Ids are grouped by family so suppressions and docs stay legible:
 SPMD101    ppermute permutations must be valid (partial) bijections
 SPMD102    collective axis names must match the enclosing shard_map mesh
 SPMD201    trace purity: no host effects inside jit/shard_map/pallas fns
+SPMD202    no host-sync coercions (float()/.item()/np.asarray) on traced values
 SPMD301    Pallas BlockSpec tiles must respect the hardware tile grid
 SPMD302    pallas_call grids must be static (no traced values)
 SPMD401    jitted() cache keys: hashable, identity-stable parts only

@@ -18,10 +18,30 @@ import jax.numpy as jnp
 from ..core import factories, random, types
 from ..core.base import BaseEstimator, ClusteringMixin
 from ..core.dndarray import DNDarray
+from ..core.fuse import fuse
 
 __all__ = ["_KCluster"]
 
 import jax
+
+
+def _quadratic_cdist(x, y):
+    """Default k-clustering metric: pairwise squared-expansion distances.
+
+    Module-level (not a per-instance lambda) so its identity is
+    call-stable and the fused assignment program below caches across
+    estimators — see ``cache_stable`` in core/_compile.py.
+    """
+    from ..spatial import distance
+
+    return distance.cdist(x, y, quadratic_expansion=True)
+
+
+def _assign_program(x: DNDarray, centers: DNDarray, metric: Callable) -> DNDarray:
+    return metric(x, centers).argmin(axis=1)
+
+
+_fused_assign = fuse(_assign_program)
 
 
 @partial(jax.jit, static_argnames=("rep_sh",))
@@ -178,13 +198,16 @@ class _KCluster(ClusteringMixin, BaseEstimator):
         )
 
     def _assign_to_cluster(self, x: DNDarray) -> DNDarray:
-        """Nearest-centroid labels (reference _kcluster.py:192-204)."""
+        """Nearest-centroid labels (reference _kcluster.py:192-204) as one
+        fused program: distance matmul + argmin + layout commit in a single
+        device dispatch.  A custom per-instance metric (lambda/closure)
+        still works but compiles transiently per call; module-level metrics
+        (the default) cache."""
         if self._cluster_centers is None:
             raise RuntimeError(
                 f"{type(self).__name__} has no cluster centers — call fit() first"
             )
-        distances = self._metric(x, self._cluster_centers)
-        return distances.argmin(axis=1)
+        return _fused_assign(x, self._cluster_centers, self._metric)
 
     def fit(self, x: DNDarray):
         raise NotImplementedError()

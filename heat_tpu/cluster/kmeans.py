@@ -22,7 +22,7 @@ import jax.numpy as jnp
 from ..core.dndarray import DNDarray
 from ..core.sanitation import sanitize_in
 from ..spatial import distance
-from ._kcluster import _KCluster
+from ._kcluster import _KCluster, _quadratic_cdist
 
 __all__ = ["KMeans"]
 
@@ -50,7 +50,7 @@ class KMeans(_KCluster):
         random_state: Optional[int] = None,
     ):
         super().__init__(
-            metric=lambda x, y: distance.cdist(x, y, quadratic_expansion=True),
+            metric=_quadratic_cdist,  # module-level: fused-assign cache hit
             n_clusters=n_clusters,
             init=init,
             max_iter=max_iter,

@@ -26,7 +26,7 @@ import jax.numpy as jnp
 from ..core.dndarray import DNDarray
 from ..core.sanitation import sanitize_in
 from ..spatial import distance
-from ._kcluster import _KCluster
+from ._kcluster import _KCluster, _quadratic_cdist
 
 __all__ = ["KMedians"]
 
@@ -183,7 +183,7 @@ class KMedians(_KCluster):
         super().__init__(
             # quadratic expansion: assignment is one MXU matmul instead of an
             # (n, k, f) broadcast temporary
-            metric=lambda x, y: distance.cdist(x, y, quadratic_expansion=True),
+            metric=_quadratic_cdist,  # module-level: fused-assign cache hit
             n_clusters=n_clusters,
             init=init,
             max_iter=max_iter,

@@ -19,7 +19,7 @@ import jax.numpy as jnp
 from ..core.dndarray import DNDarray
 from ..core.sanitation import sanitize_in
 from ..spatial import distance
-from ._kcluster import _KCluster
+from ._kcluster import _KCluster, _quadratic_cdist
 
 __all__ = ["KMedoids"]
 
@@ -61,7 +61,7 @@ class KMedoids(_KCluster):
     ):
         super().__init__(
             # quadratic expansion: one MXU matmul, no (n, k, f) temporary
-            metric=lambda x, y: distance.cdist(x, y, quadratic_expansion=True),
+            metric=_quadratic_cdist,  # module-level: fused-assign cache hit
             n_clusters=n_clusters,
             init=init,
             max_iter=max_iter,

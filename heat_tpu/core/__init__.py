@@ -11,6 +11,8 @@ from .memory import *
 from . import sanitation
 from .sanitation import *
 from .dndarray import *
+from . import fuse as _fuse_module
+from .fuse import *
 from . import factories
 from .factories import *
 from . import arithmetics

@@ -25,9 +25,17 @@ import numpy as np
 
 import jax
 
+from ._tracing import record_dispatch
+
 __all__ = ["jitted", "cache_stable", "clear_cache", "cache_size"]
 
 _CACHE: Dict[Tuple, Any] = {}
+
+try:  # jax >= 0.4: True only outside any active jax trace
+    _trace_state_clean = jax.core.trace_state_clean
+except AttributeError:  # pragma: no cover - older jax
+    def _trace_state_clean() -> bool:
+        return True
 
 
 def cache_stable(fn: Any) -> bool:
@@ -64,10 +72,23 @@ def jitted(key: Tuple, make_fn: Callable[[], Callable]) -> Callable:
 
     ``make_fn`` is only invoked on a cache miss; it should return a function
     closing over all static parameters named in ``key``.
+
+    The cached entry is a thin wrapper that records one device dispatch per
+    eager invocation (see :mod:`heat_tpu.core._tracing`); calls made while a
+    trace is active — an enclosing ``ht.fuse`` program or any jax trace —
+    inline into the surrounding program and are not counted.
     """
     fn = _CACHE.get(key)
     if fn is None:
-        fn = jax.jit(make_fn())
+        jfn = jax.jit(make_fn())
+
+        def fn(*args, _jfn=jfn, **kwargs):
+            if _trace_state_clean():
+                record_dispatch()
+            return _jfn(*args, **kwargs)
+
+        fn.lower = jfn.lower  # HLO inspection passthrough (tests)
+        fn.jitted = jfn
         _CACHE[key] = fn
     return fn
 

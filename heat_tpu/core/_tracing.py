@@ -1,0 +1,116 @@
+"""Trace-mode state shared by the compile cache, the communication layer,
+DNDarray, and :mod:`heat_tpu.core.fuse`.
+
+``heat_tpu`` normally runs ops eagerly: every op commits its result's
+layout with a real ``device_put`` and any host-side inspection
+(``float(x)``, ``repr(x)``, ``x.numpy()``) simply reads the committed
+array back.  Under :func:`heat_tpu.fuse` the same library code runs once
+*inside* a ``jax.jit`` trace, where arrays are abstract tracers: committed
+shardings do not exist yet (layout requests become
+``jax.lax.with_sharding_constraint`` hints for GSPMD) and reading a value
+back is impossible by construction.  This module holds the process-global
+flag that tells the rest of the core which of the two worlds it is in,
+plus the diagnostic error raised when traced code demands a concrete
+value.
+
+It also hosts the *dispatch counter* — the test/bench shim that counts
+device program launches at the library level.  Counting at the jax/XLA
+layer is not reliable from Python (the C++ pjit fast path bypasses any
+Python wrapper after the first call), so the counter is incremented by the
+two places heat_tpu itself launches programs: the ``jitted()`` executable
+wrapper and the ``device_put``-based reshard in the communication layer.
+
+Kept free of jax imports so every core module can import it without
+ordering constraints.
+"""
+
+from __future__ import annotations
+
+import contextlib
+
+__all__ = [
+    "FuseTraceError",
+    "trace_mode",
+    "in_trace",
+    "require_concrete",
+    "record_dispatch",
+    "dispatch_count",
+    "reset_dispatch_count",
+]
+
+
+class FuseTraceError(RuntimeError):
+    """A value-forcing operation ran on a traced DNDarray.
+
+    Raised when code inside an ``ht.fuse``-compiled pipeline (or a
+    ``fuse.trace()`` block) tries to materialize a concrete value —
+    ``float(x)``, ``x.item()``, ``print(x)``, ``x.numpy()``, file I/O.
+    Inside a trace there is no value yet, only an abstract shape; the fix
+    is to keep the computation on-device (``jnp.where`` / ``lax.cond``
+    instead of Python ``if``), or to move the host-side step outside the
+    fused function.
+    """
+
+
+_trace_depth = 0
+
+
+def in_trace() -> bool:
+    """True while a ``fuse`` trace (or explicit ``fuse.trace()`` block)
+    is active on this thread of control."""
+    return _trace_depth > 0
+
+
+@contextlib.contextmanager
+def trace_mode():
+    """Enter tracing mode: the communication layer swaps committed-layout
+    inspection for ``with_sharding_constraint`` hints and value-forcing
+    DNDarray operations raise :class:`FuseTraceError`.  Re-entrant."""
+    global _trace_depth
+    _trace_depth += 1
+    try:
+        yield
+    finally:
+        _trace_depth -= 1
+
+
+def require_concrete(what: str) -> None:
+    """Raise the diagnostic :class:`FuseTraceError` if tracing is active.
+
+    Called by every value-forcing DNDarray entry point with a short
+    description of the operation (``"float()"``, ``".numpy()"`` …).
+    """
+    if _trace_depth > 0:
+        raise FuseTraceError(
+            f"{what} forces a concrete value, but this DNDarray is being "
+            "traced inside ht.fuse — no value exists yet. Keep the decision "
+            "on-device (jnp.where / lax.cond) or move this step outside the "
+            "fused function."
+        )
+
+
+# ---------------------------------------------------------------------- #
+# dispatch counting                                                       #
+# ---------------------------------------------------------------------- #
+_dispatches = 0
+
+
+def record_dispatch() -> None:
+    """Count one device program launch.
+
+    No-ops inside trace mode: a call that happens while tracing is being
+    inlined into the enclosing program, not dispatched.
+    """
+    global _dispatches
+    if _trace_depth == 0:
+        _dispatches += 1
+
+
+def dispatch_count() -> int:
+    """Device program launches recorded since the last reset."""
+    return _dispatches
+
+
+def reset_dispatch_count() -> None:
+    global _dispatches
+    _dispatches = 0

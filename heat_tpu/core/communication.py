@@ -57,6 +57,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 from ._compile import jitted
 from ._jax_compat import distributed_is_initialized, shard_map
+from ._tracing import in_trace, record_dispatch
 
 __all__ = [
     "Communication",
@@ -315,7 +316,19 @@ class XlaCommunication(Communication):
         GSPMD choose the closest valid layout (sharding is a performance
         hint, never a correctness constraint — the deliberate inversion of
         the reference, where layout errors corrupt results).
+
+        Under an ``ht.fuse`` trace there is no committed layout to inspect
+        or create — the request becomes a
+        :func:`jax.lax.with_sharding_constraint` hint that GSPMD resolves
+        when the whole program compiles.
         """
+        if isinstance(array, jax.core.Tracer):
+            return jax.lax.with_sharding_constraint(array, self.sharding(array.ndim, split))
+        if in_trace():
+            # concrete array inside a fuse.trace() block: same constraint
+            # semantics, via the compiled form (eager wsc commits a
+            # single-device layout, losing the mesh)
+            return _constrained_copy(array, self.sharding(array.ndim, split))
         if self.size == 1:
             # single device: every layout is trivially correct — skip the
             # device_put dispatch when the data already lives on our device
@@ -518,7 +531,14 @@ class XlaCommunication(Communication):
         return jitted(("comm.permute", self, perm), make)(array)
 
     def _split_axis_of(self, array: jax.Array) -> Optional[int]:
-        """The mesh-sharded axis of a global array, or None if replicated."""
+        """The mesh-sharded axis of a global array, or None if replicated.
+
+        Tracers never carry a committed sharding — under a fuse/jit trace
+        this reports None and callers degrade to their replicated-input
+        behavior (layout is a hint; GSPMD re-derives it at compile time).
+        """
+        if isinstance(array, jax.core.Tracer):
+            return None
         sharding = getattr(array, "sharding", None)
         spec = getattr(sharding, "spec", None)
         if spec is None:
@@ -675,7 +695,12 @@ def _reshard(array, sh: NamedSharding):
     raises in ``_different_device_order_reshard`` for computed GSPMD
     outputs), whereas a jitted sharding constraint lowers to the proper
     cross-host collective.  Host values (numpy / single-device arrays) keep
-    the device_put path everywhere."""
+    the device_put path everywhere.  Tracers (fuse / jit) get the in-program
+    form, a plain with_sharding_constraint."""
+    if isinstance(array, jax.core.Tracer):
+        return jax.lax.with_sharding_constraint(array, sh)
+    if in_trace():
+        return _constrained_copy(array, sh)
     if getattr(array, "sharding", None) == sh:
         # already laid out: device_put would no-op anyway but costs ~50 us
         # of dispatch per call — this check is ~0.1 us and sits on the
@@ -687,6 +712,7 @@ def _reshard(array, sh: NamedSharding):
         and len(getattr(array.sharding, "device_set", ())) > 1
     ):
         return _constrained_copy(array, sh)
+    record_dispatch()
     return jax.device_put(array, sh)
 
 

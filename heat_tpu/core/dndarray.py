@@ -54,6 +54,7 @@ import jax.numpy as jnp
 from . import types
 from ._compile import jitted
 from ._jax_compat import shard_map
+from ._tracing import require_concrete
 from .communication import Communication, sanitize_comm
 from .devices import Device
 from .stride_tricks import sanitize_axis
@@ -443,6 +444,7 @@ class DNDarray:
     def numpy(self) -> np.ndarray:
         """Gather to a host numpy array (reference dndarray.py: ``numpy`` —
         there an implicit resplit(None) + .numpy())."""
+        require_concrete(".numpy()")
         return np.asarray(self.larray)
 
     def copy(self) -> "DNDarray":
@@ -466,47 +468,57 @@ class DNDarray:
     def save(self, path: str, *args, **kwargs) -> None:
         """Save to HDF5/NetCDF/CSV by file extension (reference
         dndarray.py:3104)."""
+        require_concrete(".save()")
         from . import io
 
         io.save(self, path, *args, **kwargs)
 
     def save_hdf5(self, path: str, dataset: str, mode: str = "w", **kwargs) -> None:
         """Save to an HDF5 dataset (reference dndarray.py:3132)."""
+        require_concrete(".save_hdf5()")
         from . import io
 
         io.save_hdf5(self, path, dataset, mode, **kwargs)
 
     def save_netcdf(self, path: str, variable: str, mode: str = "w", **kwargs) -> None:
         """Save to a NetCDF variable (reference dndarray.py:3162)."""
+        require_concrete(".save_netcdf()")
         from . import io
 
         io.save_netcdf(self, path, variable, mode, **kwargs)
 
     def __array__(self, dtype=None):
+        require_concrete("np.asarray()")
         arr = np.asarray(self.larray)
         return arr.astype(dtype) if dtype is not None else arr
 
     def tolist(self, keepsplit: bool = False) -> list:
         """Nested python lists of the global data (reference dndarray.py:3718)."""
+        require_concrete(".tolist()")
         return np.asarray(self.larray).tolist()
 
     def item(self):
         """The single element of a size-1 array as a python scalar
         (reference dndarray.py:1754)."""
+        require_concrete(".item()")
         if self.size != 1:
             raise ValueError("only one-element DNDarrays can be converted to Python scalars")
         return self.larray.reshape(()).item()
 
     def __bool__(self) -> bool:
+        require_concrete("bool()")
         return bool(self.item())
 
     def __int__(self) -> int:
+        require_concrete("int()")
         return int(self.item())
 
     def __float__(self) -> float:
+        require_concrete("float()")
         return float(self.item())
 
     def __complex__(self) -> complex:
+        require_concrete("complex()")
         return complex(self.item())
 
     def __len__(self) -> int:
@@ -1039,11 +1051,13 @@ class DNDarray:
     # string representations                                             #
     # ------------------------------------------------------------------ #
     def __repr__(self) -> str:
+        require_concrete("repr()")
         from . import printing
 
         return printing.__str__(self)
 
     def __str__(self) -> str:
+        require_concrete("print()/str()")
         from . import printing
 
         return printing.__str__(self)

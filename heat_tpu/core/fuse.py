@@ -1,0 +1,252 @@
+"""Whole-program compilation over DNDarrays: ``ht.fuse``.
+
+``jitted()`` (:mod:`heat_tpu.core._compile`) compiles each *single* op's
+primitive chain, so an eager pipeline of N DNDarray ops still pays N
+host↔device round trips — the dispatch tax BENCH dispositions measure at
+~1 ms per launch on a tunneled TPU, dwarfing the device compute of small
+and medium ops.  ``fuse`` closes the gap the way "Automatic Full
+Compilation of Julia Programs and ML Models to Cloud TPUs"
+(arXiv:1810.09868) does for whole programs and "Large Scale Distributed
+Linear Algebra With TPUs" (arXiv:2112.09017) assumes for its kernels:
+trace the entire user pipeline once, compile it into ONE XLA executable,
+and replay that for every subsequent call.
+
+How it works
+------------
+``fuse(fn)`` returns a wrapper that, per call:
+
+1. flattens ``(args, kwargs)`` with DNDarray leaves kept whole, splitting
+   every leaf into a *dynamic* operand (the DNDarray's at-rest global
+   ``jax.Array`` buffer, or a raw ``jax.Array``/numpy leaf) plus *static*
+   metadata (gshape, split, heat dtype, balanced flag — and the value
+   itself for non-array leaves);
+2. looks up a compiled program keyed on
+   ``(fn identity, treedef, per-leaf avals/splits, statics, comm, donate)``
+   — ``fn`` identity follows :func:`~heat_tpu.core._compile.cache_stable`,
+   so module-level pipelines cache across calls while lambdas/closures get
+   a transient (per-call) compile;
+3. on a miss, traces ``fn`` once under :func:`~heat_tpu.core._tracing.
+   trace_mode`: DNDarrays are rebuilt around the traced buffers, the
+   communication layer swaps committed-layout work (``device_put``,
+   ``.sharding`` inspection) for ``jax.lax.with_sharding_constraint``
+   hints, and any value-forcing operation (``float()``, ``.item()``,
+   printing, I/O) raises :class:`FuseTraceError`;
+4. replays the compiled program — one device dispatch — and re-wraps the
+   output buffers as DNDarrays with the split metadata inferred at trace
+   time.
+
+Static metadata is part of the key, so python-scalar arguments that vary
+per call (thresholds, axes) each compile their own specialization — pass
+them as 0-d DNDarrays/jax arrays if they genuinely vary.
+
+``donate=True`` donates the input buffers to XLA (in-place pipelines):
+the caller's input DNDarrays are consumed and must not be used afterwards.
+
+``fuse.trace()`` exposes the bare tracing mode as a context manager — the
+communication-layer swap and the value-forcing guard without the
+compile-and-cache machinery — for embedding DNDarray code inside a wider
+``jax.jit``/``shard_map`` region of your own.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import numpy as np
+
+import jax
+
+from ._compile import cache_stable
+from ._tracing import (
+    FuseTraceError,
+    in_trace,
+    record_dispatch,
+    trace_mode,
+)
+from .dndarray import DNDarray
+
+__all__ = ["fuse", "FuseTraceError"]
+
+_FUSE_CACHE: Dict[Tuple, Any] = {}
+
+
+def _is_dnd(x: Any) -> bool:
+    return isinstance(x, DNDarray)
+
+
+class _Program:
+    """A traced-and-compiled pipeline plus its output re-wrap recipe."""
+
+    __slots__ = ("jfn", "out_treedef", "out_meta")
+
+    def __init__(self, jfn):
+        self.jfn = jfn
+        self.out_treedef = None
+        self.out_meta = None
+
+
+def _build(fn: Callable, slots: Tuple, treedef, donate: bool) -> _Program:
+    """Compile ``fn`` over the leaf layout described by ``slots``.
+
+    ``slots`` entries are ``("dnd", gshape, dtype, split, device, comm,
+    balanced)``, ``("arr",)``, or ``("static", value)``; dynamic operands
+    are threaded through in slot order.
+    """
+    program = _Program(None)
+
+    def _runner(operands):
+        it = iter(operands)
+        leaves = []
+        for slot in slots:
+            if slot[0] == "dnd":
+                _, gshape, dtype, split, device, comm, balanced = slot
+                leaves.append(DNDarray(next(it), gshape, dtype, split, device, comm, balanced))
+            elif slot[0] == "arr":
+                leaves.append(next(it))
+            else:
+                leaves.append(slot[1])
+        args, kwargs = jax.tree_util.tree_unflatten(treedef, leaves)
+        with trace_mode():
+            out = fn(*args, **kwargs)
+            out_leaves, out_treedef = jax.tree_util.tree_flatten(out, is_leaf=_is_dnd)
+            raws, meta = [], []
+            for leaf in out_leaves:
+                if isinstance(leaf, DNDarray):
+                    buf = leaf._buffer
+                    # pin the at-rest layout at the program boundary; the
+                    # buffer is canonically padded, so the split axis is
+                    # divisible and commits genuinely sharded
+                    sh = leaf.comm.sharding(buf.ndim, leaf.split)
+                    raws.append(jax.lax.with_sharding_constraint(buf, sh))
+                    meta.append(
+                        ("dnd", leaf.gshape, leaf.dtype, leaf.split, leaf.device,
+                         leaf.comm, leaf.balanced)
+                    )
+                elif isinstance(leaf, jax.Array):
+                    raws.append(leaf)
+                    meta.append(("raw",))
+                else:
+                    # trace-time constant (python scalar, string, None-like):
+                    # deterministic given the cache key, so bake it in
+                    meta.append(("const", leaf))
+        program.out_treedef = out_treedef
+        program.out_meta = tuple(meta)
+        return tuple(raws)
+
+    program.jfn = jax.jit(_runner, donate_argnums=(0,) if donate else ())
+    return program
+
+
+class _FusedFunction:
+    """The callable returned by :func:`fuse`."""
+
+    def __init__(self, fn: Callable, donate: bool = False):
+        self._fn = fn
+        self._donate = bool(donate)
+        self._stable = cache_stable(fn)
+        functools.update_wrapper(self, fn)
+
+    def __call__(self, *args, **kwargs):
+        if in_trace():
+            # nested fuse (or inside fuse.trace()): inline into the
+            # enclosing program instead of compiling a second one
+            return self._fn(*args, **kwargs)
+        leaves, treedef = jax.tree_util.tree_flatten((args, kwargs), is_leaf=_is_dnd)
+        operands, slots, keyparts = [], [], []
+        comm = None
+        for leaf in leaves:
+            if isinstance(leaf, DNDarray):
+                buf = leaf._buffer
+                operands.append(buf)
+                slots.append(
+                    ("dnd", leaf.gshape, leaf.dtype, leaf.split, leaf.device,
+                     leaf.comm, leaf.balanced)
+                )
+                keyparts.append(
+                    ("dnd", tuple(buf.shape), str(buf.dtype), leaf.gshape,
+                     leaf.dtype, leaf.split, leaf.balanced, leaf.comm)
+                )
+                comm = comm if comm is not None else leaf.comm
+            elif isinstance(leaf, (jax.Array, np.ndarray)):
+                operands.append(leaf)
+                slots.append(("arr",))
+                keyparts.append(("arr", tuple(leaf.shape), str(leaf.dtype)))
+            else:
+                slots.append(("static", leaf))
+                keyparts.append(("static", leaf))
+        slots = tuple(slots)
+
+        program = None
+        key = None
+        if self._stable and self._cacheable_statics(leaves):
+            key = (self._fn, self._donate, treedef, tuple(keyparts), comm)
+            try:
+                program = _FUSE_CACHE.get(key)
+            except TypeError:  # unhashable static leaf slipped through
+                key = None
+        if program is None:
+            program = _build(self._fn, slots, treedef, self._donate)
+            if key is not None:
+                _FUSE_CACHE[key] = program
+
+        raws = program.jfn(tuple(operands))
+        record_dispatch()
+
+        it = iter(raws)
+        out_leaves = []
+        for meta in program.out_meta:
+            if meta[0] == "dnd":
+                _, gshape, dtype, split, device, comm_, balanced = meta
+                out_leaves.append(DNDarray(next(it), gshape, dtype, split, device, comm_, balanced))
+            elif meta[0] == "raw":
+                out_leaves.append(next(it))
+            else:
+                out_leaves.append(meta[1])
+        return jax.tree_util.tree_unflatten(program.out_treedef, out_leaves)
+
+    @staticmethod
+    def _cacheable_statics(leaves) -> bool:
+        """Static leaves must be hashable, and callable statics must have a
+        call-stable identity — otherwise every call would add a dead cache
+        entry (same rule as jitted keys, spmdlint SPMD401)."""
+        for leaf in leaves:
+            if isinstance(leaf, (DNDarray, jax.Array, np.ndarray)):
+                continue
+            if callable(leaf) and not cache_stable(leaf):
+                return False
+            try:
+                hash(leaf)
+            except TypeError:
+                return False
+        return True
+
+
+def fuse(fn: Optional[Callable] = None, *, donate: bool = False):
+    """Compile a DNDarray pipeline into one XLA program (one dispatch).
+
+    Use as a decorator (``@ht.fuse`` / ``@ht.fuse(donate=True)``) or
+    inline (``fused = ht.fuse(my_pipeline)``).  See the module docstring
+    for caching, static-argument, and donation semantics.
+    """
+    if fn is None:
+        return functools.partial(fuse, donate=donate)
+    return _FusedFunction(fn, donate=donate)
+
+
+#: context-manager variant: bare tracing mode without compile-and-cache
+fuse.trace = trace_mode
+
+
+def fuse_cache_size() -> int:
+    """Number of cached fused programs (mainly for tests)."""
+    return len(_FUSE_CACHE)
+
+
+def fuse_clear_cache() -> None:
+    """Drop all cached fused programs (mainly for tests)."""
+    _FUSE_CACHE.clear()
+
+
+fuse.cache_size = fuse_cache_size
+fuse.clear_cache = fuse_clear_cache

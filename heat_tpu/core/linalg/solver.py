@@ -81,6 +81,61 @@ def cg(A: DNDarray, b: DNDarray, x0: DNDarray, out: Optional[DNDarray] = None) -
     return x
 
 
+@jax.jit
+def _lanczos_loop(arr, v, R):
+    """The whole Lanczos iteration as ONE device program.
+
+    The reference (solver.py:74-184) — and this module until the fuse PR —
+    decided breakdown-restart on the host with ``float(beta)``, a blocking
+    device→host sync per iteration.  Here the decision is a ``jnp.where``
+    select between the normal step and a restart candidate drawn from the
+    pre-generated random matrix ``R`` (one column per iteration), so the
+    m-step loop runs as a single ``fori_loop`` with zero host syncs.
+
+    The full re-orthogonalization projects against ALL m columns of V:
+    columns ≥ i are still zero, so their coefficients vanish and the
+    projection equals the reference's ``V[:, :i]`` slice — this is what
+    lets the loop body stay shape-static inside ``fori_loop``.
+    """
+    n, m = R.shape
+    V = jnp.zeros((n, m), dtype=arr.dtype)
+    T = jnp.zeros((m, m), dtype=arr.dtype)
+    V = V.at[:, 0].set(v)
+
+    w0 = arr @ v
+    alpha0 = jnp.dot(w0, v)
+    T = T.at[0, 0].set(alpha0)
+
+    def body(i, state):
+        V, T, w, v_prev = state
+        beta = jnp.linalg.norm(w)
+        breakdown = beta < 1e-10
+        # restart candidate: random column re-orthogonalized against V
+        # (reference :120-130); computed unconditionally — a lax.cond would
+        # re-trace both branches anyway and the extra matvec is noise next
+        # to the m host syncs this loop used to pay
+        vr = jnp.take(R, i, axis=1).astype(arr.dtype)
+        vr = vr - V @ (V.T @ vr)
+        vr_nrm = jnp.linalg.norm(vr)
+        vr = jnp.where(vr_nrm > 0, vr / vr_nrm, vr)
+        w = jnp.where(breakdown, vr, w / jnp.where(breakdown, 1.0, beta))
+        # full re-orthogonalization (reference :140-152)
+        w = w - V @ (V.T @ w)
+        nrm = jnp.linalg.norm(w)
+        w = jnp.where(nrm > 0, w / nrm, w)
+        V = V.at[:, i].set(w)
+        wnew = arr @ w
+        alpha = jnp.dot(wnew, w)
+        w_next = wnew - alpha * w - beta * v_prev
+        T = T.at[i, i].set(alpha)
+        T = T.at[i - 1, i].set(beta)
+        T = T.at[i, i - 1].set(beta)
+        return V, T, w_next, w
+
+    V, T, _, _ = jax.lax.fori_loop(1, m, body, (V, T, w0 - alpha0 * v, v))
+    return V, T
+
+
 def lanczos(
     A: DNDarray,
     m: int,
@@ -94,7 +149,9 @@ def lanczos(
 
     The reference re-orthogonalizes rank-locally and Allreduces dot
     products (:140-152); here the inner products on the sharded vectors
-    compile to all-reduces automatically.
+    compile to all-reduces automatically, and the whole m-step iteration —
+    including the breakdown-restart decision, formerly a ``float(beta)``
+    host sync per step — runs as one compiled device loop.
     """
     sanitize_in(A)
     if A.ndim != 2 or A.shape[0] != A.shape[1]:
@@ -105,46 +162,19 @@ def lanczos(
     n = A.shape[0]
     arr = A.larray.astype(jnp.float32 if types.heat_type_is_exact(A.dtype) else A.larray.dtype)
 
-    if v0 is None:
-        from .. import random
+    from .. import random
 
+    if v0 is None:
         v = random.rand(n, dtype=types.float32, device=A.device).larray
         v = v / jnp.linalg.norm(v)
     else:
         sanitize_in(v0)
         v = v0.larray / jnp.linalg.norm(v0.larray)
+    # breakdown-restart candidates, one per iteration (drawn per fit, used
+    # on device only when the matching step actually breaks down)
+    R = random.rand(n, m, dtype=types.float32, device=A.device).larray
 
-    V = jnp.zeros((n, m), dtype=arr.dtype)
-    T = jnp.zeros((m, m), dtype=arr.dtype)
-    V = V.at[:, 0].set(v)
-
-    w = arr @ v
-    alpha = jnp.dot(w, v)
-    w = w - alpha * v
-    T = T.at[0, 0].set(alpha)
-    for i in range(1, m):
-        beta = jnp.linalg.norm(w)
-        if float(beta) < 1e-10:
-            # breakdown: restart with a random orthogonal vector
-            from .. import random as _rnd
-
-            vr = _rnd.rand(n, dtype=types.float32, device=A.device).larray
-            # full re-orthogonalization against V (reference :120-130)
-            vr = vr - V[:, :i] @ (V[:, :i].T @ vr)
-            w = vr / jnp.linalg.norm(vr)
-        else:
-            w = w / beta
-        # full re-orthogonalization (reference :140-152)
-        w = w - V[:, :i] @ (V[:, :i].T @ w)
-        nrm = jnp.linalg.norm(w)
-        w = jnp.where(nrm > 0, w / nrm, w)
-        V = V.at[:, i].set(w)
-        wnew = arr @ w
-        alpha = jnp.dot(wnew, w)
-        w = wnew - alpha * w - beta * V[:, i - 1]
-        T = T.at[i, i].set(alpha)
-        T = T.at[i - 1, i].set(beta)
-        T = T.at[i, i - 1].set(beta)
+    V, T = _lanczos_loop(arr, v.astype(arr.dtype), R)
 
     comm, device = A.comm, A.device
     V_nd = DNDarray(comm.apply_sharding(V, 0 if A.split is not None else None), (n, m),

@@ -45,6 +45,7 @@ def _jitted_singvals(a):
 
 from .. import types
 from ..dndarray import DNDarray
+from ..fuse import fuse
 from ..sanitation import sanitize_in
 from .qr import qr as _qr
 
@@ -92,30 +93,18 @@ def _small_singvals(r: jnp.ndarray):
         return _jitted_singvals(r)
 
 
-def svd(a: DNDarray, full_matrices: bool = False, compute_uv: bool = True):
-    """Reduced SVD ``a = U @ diag(S) @ V.T``.
+def _svd_pipeline(a: DNDarray, osplit, dtype, compute_uv: bool):
+    """The tall (m ≥ n) QR-first SVD chain over a sanitized operand.
 
-    Returns the namedtuple ``SVD(U, S, V)``; with ``compute_uv=False`` only
-    ``S`` (as a DNDarray).
+    Module-level so :func:`heat_tpu.fuse` can compile the whole thing —
+    resplit heuristic, (TS)QR, small SVD, Q·Ur correction, layout commits —
+    into one program per (shape, split, dtype) signature; :func:`svd`
+    routes the host-SVD/f64 configurations through it eagerly instead
+    (their R factors round-trip through LAPACK, which cannot trace).
     """
-    sanitize_in(a)
-    if a.ndim != 2:
-        raise ValueError(f"svd requires a 2-D DNDarray, got {a.ndim}-d")
-    if full_matrices:
-        raise NotImplementedError("full_matrices=True is not supported (reduced SVD only)")
-
-    dtype = a.dtype if types.heat_type_is_inexact(a.dtype) else types.float32
     comm, device = a.comm, a.device
     m, n = a.shape
 
-    if m < n:
-        # wide: factor the transpose, swap U and V
-        if not compute_uv:
-            return svd(a.T, compute_uv=False)
-        res = svd(a.T, compute_uv=True)
-        return SVD(res.V, res.S, res.U)
-
-    osplit = a.split
     if (
         a.split == 0
         and comm.size > 1
@@ -150,3 +139,36 @@ def svd(a: DNDarray, full_matrices: bool = False, compute_uv: bool = True):
     v = jnp.transpose(vt).astype(dtype.jax_type())
     V = DNDarray(v, (n, n), dtype, None, device, comm, True)
     return SVD(U, S, V)
+
+
+_fused_svd_pipeline = fuse(_svd_pipeline)
+
+
+def svd(a: DNDarray, full_matrices: bool = False, compute_uv: bool = True):
+    """Reduced SVD ``a = U @ diag(S) @ V.T``.
+
+    Returns the namedtuple ``SVD(U, S, V)``; with ``compute_uv=False`` only
+    ``S`` (as a DNDarray).  The on-device configurations compile the whole
+    QR→SVD→correction chain into one fused program (one device dispatch
+    per call after warmup); the host-SVD escape hatch and float64 operands
+    keep the eager chain, since their small factor legitimately visits
+    LAPACK mid-pipeline.
+    """
+    sanitize_in(a)
+    if a.ndim != 2:
+        raise ValueError(f"svd requires a 2-D DNDarray, got {a.ndim}-d")
+    if full_matrices:
+        raise NotImplementedError("full_matrices=True is not supported (reduced SVD only)")
+
+    dtype = a.dtype if types.heat_type_is_inexact(a.dtype) else types.float32
+    m, n = a.shape
+
+    if m < n:
+        # wide: factor the transpose, swap U and V
+        if not compute_uv:
+            return svd(a.T, compute_uv=False)
+        res = svd(a.T, compute_uv=True)
+        return SVD(res.V, res.S, res.U)
+
+    impl = _svd_pipeline if _host_svd() or dtype is types.float64 else _fused_svd_pipeline
+    return impl(a, a.split, dtype, compute_uv)

@@ -20,6 +20,7 @@ import jax.numpy as jnp
 from . import _operations, factories, types
 from ._compile import jitted
 from .dndarray import DNDarray
+from .fuse import fuse
 from .sanitation import merge_keepdims, sanitize_in
 from .stride_tricks import sanitize_axis
 
@@ -216,11 +217,7 @@ def histogram(a: DNDarray, bins: int = 10, range=None, normed=None, weights=None
     )
 
 
-def kurtosis(x: DNDarray, axis=None, unbiased: bool = True, Fischer: bool = True):
-    """Fourth standardized moment (reference statistics.py:566-615; pairwise
-    moment merging :870-945 happens inside XLA's tree reduction)."""
-    sanitize_in(x)
-    axis = sanitize_axis(x.shape, axis)
+def _kurtosis_program(x: DNDarray, axis, unbiased: bool, Fischer: bool) -> DNDarray:
     arr = x.larray.astype(jnp.float64 if x.dtype is types.float64 else jnp.float32)
     mu = jnp.mean(arr, axis=axis, keepdims=True)
     diff = arr - mu
@@ -234,10 +231,19 @@ def kurtosis(x: DNDarray, axis=None, unbiased: bool = True, Fischer: bool = True
     return _wrap_reduced(x, res, axis)
 
 
-def skew(x: DNDarray, axis=None, unbiased: bool = True):
-    """Third standardized moment (reference statistics.py:1423-1465)."""
+_fused_kurtosis = fuse(_kurtosis_program)
+
+
+def kurtosis(x: DNDarray, axis=None, unbiased: bool = True, Fischer: bool = True):
+    """Fourth standardized moment (reference statistics.py:566-615; pairwise
+    moment merging :870-945 happens inside XLA's tree reduction).  The whole
+    moment chain compiles into one program via :func:`heat_tpu.fuse`."""
     sanitize_in(x)
     axis = sanitize_axis(x.shape, axis)
+    return _fused_kurtosis(x, axis, unbiased, Fischer)
+
+
+def _skew_program(x: DNDarray, axis, unbiased: bool) -> DNDarray:
     arr = x.larray.astype(jnp.float64 if x.dtype is types.float64 else jnp.float32)
     mu = jnp.mean(arr, axis=axis, keepdims=True)
     diff = arr - mu
@@ -248,6 +254,17 @@ def skew(x: DNDarray, axis=None, unbiased: bool = True):
     if unbiased and n > 2:
         g1 = g1 * jnp.sqrt(n * (n - 1.0)) / (n - 2.0)
     return _wrap_reduced(x, g1, axis)
+
+
+_fused_skew = fuse(_skew_program)
+
+
+def skew(x: DNDarray, axis=None, unbiased: bool = True):
+    """Third standardized moment (reference statistics.py:1423-1465), one
+    fused program per (shape, axis, flags) signature."""
+    sanitize_in(x)
+    axis = sanitize_axis(x.shape, axis)
+    return _fused_skew(x, axis, unbiased)
 
 
 def _nan_propagating(redfn):

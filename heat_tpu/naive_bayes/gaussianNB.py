@@ -22,7 +22,51 @@ import jax.numpy as jnp
 from ..core import factories, types
 from ..core.base import BaseEstimator, ClassificationMixin
 from ..core.dndarray import DNDarray
+from ..core.fuse import fuse
 from ..core.sanitation import sanitize_in
+
+
+def _joint_log_likelihood(x: DNDarray, theta, sigma, prior) -> jnp.ndarray:
+    """log P(c) + Σ_f log N(x_f | θ_cf, σ_cf) (reference
+    gaussianNB.py:383-400).  Module-level so the predict programs below
+    fuse it together with their argmax/normalization tails."""
+    arr = x.larray.astype(jnp.float64)
+    logprior = jnp.log(jnp.maximum(prior, 1e-300))
+    # (n, 1, f) vs (1, c, f)
+    diff = arr[:, None, :] - theta[None, :, :]
+    n_ij = -0.5 * jnp.sum(jnp.log(2.0 * jnp.pi * sigma), axis=1)  # (c,)
+    ll = n_ij[None, :] - 0.5 * jnp.sum(diff**2 / sigma[None, :, :], axis=2)
+    return logprior[None, :] + ll
+
+
+def _wrap_rows(x: DNDarray, garr, dtype) -> DNDarray:
+    split = x.split if x.split == 0 else None
+    garr = x.comm.apply_sharding(garr, split)
+    return DNDarray(garr, tuple(garr.shape), dtype, split, x.device, x.comm, True)
+
+
+def _nb_predict_program(x: DNDarray, theta, sigma, prior, classes) -> DNDarray:
+    jll = _joint_log_likelihood(x, theta, sigma, prior)
+    idx = jnp.argmax(jll, axis=1)
+    labels = classes[idx]
+    return _wrap_rows(x, labels, types.canonical_heat_type(labels.dtype))
+
+
+def _nb_log_proba_program(x: DNDarray, theta, sigma, prior) -> DNDarray:
+    jll = _joint_log_likelihood(x, theta, sigma, prior)
+    log_prob = jll - jax.nn.logsumexp(jll, axis=1, keepdims=True)
+    return _wrap_rows(x, log_prob.astype(jnp.float32), types.float32)
+
+
+def _nb_proba_program(x: DNDarray, theta, sigma, prior) -> DNDarray:
+    from ..core import exponential
+
+    return exponential.exp(_nb_log_proba_program(x, theta, sigma, prior))
+
+
+_fused_nb_predict = fuse(_nb_predict_program)
+_fused_nb_log_proba = fuse(_nb_log_proba_program)
+_fused_nb_proba = fuse(_nb_proba_program)
 
 __all__ = ["GaussianNB"]
 
@@ -156,43 +200,32 @@ class GaussianNB(ClassificationMixin, BaseEstimator):
         return self
 
     # ------------------------------------------------------------------ #
-    def __joint_log_likelihood(self, x: DNDarray) -> jnp.ndarray:
-        """log P(c) + Σ_f log N(x_f | θ_cf, σ_cf)
-        (reference gaussianNB.py:383-400)."""
-        arr = x.larray.astype(jnp.float64)
-        theta = jnp.asarray(self.theta_)
-        sigma = jnp.asarray(self.sigma_)
-        prior = jnp.log(jnp.maximum(jnp.asarray(self.class_prior_), 1e-300))
-        # (n, 1, f) vs (1, c, f)
-        diff = arr[:, None, :] - theta[None, :, :]
-        n_ij = -0.5 * jnp.sum(jnp.log(2.0 * jnp.pi * sigma), axis=1)  # (c,)
-        ll = n_ij[None, :] - 0.5 * jnp.sum(diff**2 / sigma[None, :, :], axis=2)
-        return prior[None, :] + ll
-
-    def _wrap_rows(self, x: DNDarray, garr, dtype) -> DNDarray:
-        split = x.split if x.split == 0 else None
-        garr = x.comm.apply_sharding(garr, split)
-        return DNDarray(garr, tuple(garr.shape), dtype, split, x.device, x.comm, True)
+    def _fit_params(self):
+        """The fitted parameters as arrays, the dynamic operands of the
+        fused predict programs (same shapes across refits → cache hits)."""
+        return (
+            np.asarray(self.theta_),
+            np.asarray(self.sigma_),
+            np.asarray(self.class_prior_),
+        )
 
     def predict(self, x: DNDarray) -> DNDarray:
-        """argmax-class labels (reference gaussianNB.py:475-500)."""
+        """argmax-class labels (reference gaussianNB.py:475-500), one fused
+        program: likelihood, argmax, class gather, and layout commit in a
+        single device dispatch."""
         sanitize_in(x)
-        jll = self.__joint_log_likelihood(x)
-        idx = jnp.argmax(jll, axis=1)
-        labels = jnp.asarray(self.classes_)[idx]
-        return self._wrap_rows(x, labels, types.canonical_heat_type(labels.dtype))
+        theta, sigma, prior = self._fit_params()
+        return _fused_nb_predict(x, theta, sigma, prior, np.asarray(self.classes_))
 
     def predict_log_proba(self, x: DNDarray) -> DNDarray:
         """Normalized log posteriors (reference gaussianNB.py:501-520; the
         distributed logsumexp :401-420 is one jax.nn.logsumexp here)."""
         sanitize_in(x)
-        jll = self.__joint_log_likelihood(x)
-        log_prob = jll - jax.nn.logsumexp(jll, axis=1, keepdims=True)
-        return self._wrap_rows(x, log_prob.astype(jnp.float32), types.float32)
+        theta, sigma, prior = self._fit_params()
+        return _fused_nb_log_proba(x, theta, sigma, prior)
 
     def predict_proba(self, x: DNDarray) -> DNDarray:
         """Posterior probabilities (reference gaussianNB.py:521-539)."""
-        lp = self.predict_log_proba(x)
-        from ..core import exponential
-
-        return exponential.exp(lp)
+        sanitize_in(x)
+        theta, sigma, prior = self._fit_params()
+        return _fused_nb_proba(x, theta, sigma, prior)

@@ -13,6 +13,11 @@ if ! python scripts/spmdlint.py --baseline; then
     echo "FAILED spmdlint"
     fail=1
 fi
+echo "=== fuse dispatch-count gate (one dispatch per fused pipeline) ==="
+if ! python -m pytest tests/test_fuse.py -q -k "dispatch or single_dispatch"; then
+    echo "FAILED fuse dispatch-count gate"
+    fail=1
+fi
 for n in "${sizes[@]}"; do
     echo "=== mesh size $n ==="
     if ! HEAT_TEST_DEVICES="$n" python -m pytest tests/ -q -x; then

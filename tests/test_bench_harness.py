@@ -153,6 +153,8 @@ def _fake_full_result():
         "kmedians_churn_iter_per_sec": 143.21,
         "kmedoids_iter_per_sec": 10466.7,
         "eager_ops_per_sec": 3021.9,
+        "fused_pipeline_ms": 0.42,
+        "eager_pipeline_ms": 2.31,
         "lasso_sweeps_per_sec": 1318.6,
         "qr_svd_tall_skinny_ms": 2.87,
         "attention_tokens_per_sec": 3400000.0,

@@ -240,6 +240,91 @@ def op(x):
 
 
 # --------------------------------------------------------------------- #
+# SPMD202: host-sync coercions on traced values                          #
+# --------------------------------------------------------------------- #
+def test_spmd202_triggers_on_float_of_device_value_under_fuse():
+    src = """
+import jax.numpy as jnp
+from heat_tpu.core.fuse import fuse
+
+@fuse
+def program(x):
+    beta = jnp.linalg.norm(x.larray)
+    if float(beta) < 1e-10:
+        return x
+    return x * 2.0
+"""
+    findings = lint(src, "SPMD202")
+    assert findings, "float(device value) under @fuse must fire SPMD202"
+    assert "float()" in findings[0].message
+
+
+def test_spmd202_triggers_on_item_and_asarray():
+    src = """
+import jax
+import numpy as np
+from heat_tpu.core.fuse import fuse
+
+def program(x):
+    return x.larray.sum().item()
+
+_fused = fuse(program)
+
+@jax.jit
+def f(x):
+    return np.asarray(x)
+"""
+    msgs = [f.message for f in lint(src, "SPMD202")]
+    assert any(".item()" in m for m in msgs)
+    assert any("numpy.asarray" in m for m in msgs)
+
+
+def test_spmd202_clean_on_static_metadata_and_host_code():
+    src = """
+import jax
+import numpy as np
+
+@jax.jit
+def f(x):
+    n = int(x.shape[0])
+    scale = float(n * 2 - 1)
+    return x * scale
+
+def host_helper(a):
+    # outside any traced context: syncs are the caller's business
+    v = float(a.larray.sum())
+    return np.asarray(a), a.item(), v
+"""
+    assert lint(src, "SPMD202") == []
+
+
+def test_spmd202_ignores_bare_names_without_device_evidence():
+    src = """
+import jax
+
+@jax.jit
+def f(x, steps):
+    # python-int bookkeeping: a bare-name coercion with no visible
+    # device-value assignment must NOT fire
+    count = steps - 1
+    return x * float(count)
+"""
+    assert lint(src, "SPMD202") == []
+
+
+def test_spmd202_recognizes_ht_fuse_decorator():
+    src = """
+import heat_tpu as ht
+
+@ht.fuse
+def program(x):
+    return x.larray.max().tolist()
+"""
+    findings = lint(src, "SPMD202")
+    assert findings and ".tolist()" in findings[0].message
+
+
+# --------------------------------------------------------------------- #
 # SPMD301/302: Pallas tiling and grids                                   #
 # --------------------------------------------------------------------- #
 def test_spmd301_triggers_on_off_tile_blocks():
@@ -400,7 +485,7 @@ def test_baseline_fingerprint_is_line_insensitive():
 # --------------------------------------------------------------------- #
 def test_every_rule_is_registered():
     assert [r.id for r in all_rules()] == [
-        "SPMD101", "SPMD102", "SPMD201", "SPMD301", "SPMD302", "SPMD401",
+        "SPMD101", "SPMD102", "SPMD201", "SPMD202", "SPMD301", "SPMD302", "SPMD401",
     ]
 
 
