@@ -48,6 +48,7 @@ from .version import __version__
 from . import core
 from .core import *
 from .core import linalg, random
+from . import comm
 from . import cluster
 from . import classification
 from . import parallel

@@ -352,6 +352,9 @@ _COLLECTIVES = {
     "psum": 1, "pmax": 1, "pmin": 1, "pmean": 1, "ppermute": 1,
     "all_gather": 1, "all_to_all": 1, "psum_scatter": 1, "pshuffle": 1,
     "pbroadcast": 1, "pcast": 1, "axis_index": 0,
+    # heat_tpu.comm.compressed ring collectives (in-kernel forms)
+    "ring_allreduce_q": 1, "ring_allreduce_q_ef": 2, "ring_allgather_q": 1,
+    "allreduce_q": 6,
 }
 
 
@@ -423,7 +426,13 @@ def check_axis_names(ctx: FileContext) -> Iterable[Finding]:
             leaf = dotted.rsplit(".", 1)[-1]
             if leaf not in _COLLECTIVES:
                 continue
-            if not ("jax" in dotted or "lax" in dotted or dotted == leaf or "_jax_compat" in dotted):
+            if not (
+                "jax" in dotted
+                or "lax" in dotted
+                or dotted == leaf
+                or "_jax_compat" in dotted
+                or "compressed" in dotted
+            ):
                 continue
             for expr in _axis_exprs_of_collective(sub, leaf):
                 if isinstance(expr, ast.Constant):
@@ -661,6 +670,96 @@ def check_host_sync(ctx: FileContext) -> Iterable[Finding]:
                     "jnp.where / lax.cond, or hoist the sync out of the "
                     "traced region",
                 )
+
+
+# --------------------------------------------------------------------- #
+# SPMD203: quantized collectives must carry inexact payloads             #
+# --------------------------------------------------------------------- #
+#: quantized-collective leaf name -> positional index of its payload
+_QUANTIZED_COLLECTIVES = {
+    "ring_allreduce_q": 0, "ring_allreduce_q_ef": 0, "ring_allgather_q": 0,
+    "allreduce_q": 0, "allgather_q": 0, "quantize_blocks": 0,
+}
+#: dtype leaves whose values must survive a collective bit-exactly
+_EXACT_DTYPE_LEAVES = {
+    "int8", "int16", "int32", "int64", "uint8", "uint16", "uint32",
+    "uint64", "bool_", "bool", "integer", "signedinteger",
+}
+
+
+def _exact_dtype_expr(ctx: FileContext, expr: ast.AST) -> Optional[str]:
+    """The integer/bool dtype named by ``expr`` (``jnp.int32``,
+    ``"int64"``, ...), or None when it is not visibly exact."""
+    if isinstance(expr, ast.Constant) and isinstance(expr.value, str):
+        return expr.value if expr.value in _EXACT_DTYPE_LEAVES else None
+    dotted = ctx.resolve(expr) or ""
+    leaf = dotted.rsplit(".", 1)[-1]
+    return leaf if leaf in _EXACT_DTYPE_LEAVES else None
+
+
+def _visibly_exact_payload(
+    ctx: FileContext, expr: ast.AST, at: ast.AST, depth: int = 0
+) -> Optional[str]:
+    """The exact dtype ``expr`` visibly carries, or None.  Follows
+    ``.astype(...)`` tails, ``dtype=`` keywords of constructors, and
+    single-assignment name bindings (same lookup discipline as SPMD202's
+    device-value tracking)."""
+    if depth > 5:
+        return None
+    if isinstance(expr, ast.Call):
+        if isinstance(expr.func, ast.Attribute) and expr.func.attr == "astype":
+            if expr.args:
+                return _exact_dtype_expr(ctx, expr.args[0])
+            for kw in expr.keywords:
+                if kw.arg == "dtype":
+                    return _exact_dtype_expr(ctx, kw.value)
+            return None
+        for kw in expr.keywords:
+            if kw.arg == "dtype":
+                return _exact_dtype_expr(ctx, kw.value)
+        return None
+    if isinstance(expr, ast.Name):
+        rec = ctx.lookup(expr.id, at)
+        if rec is not None and rec[0] == "expr":
+            return _visibly_exact_payload(ctx, rec[1], at, depth + 1)
+    return None
+
+
+@rule("SPMD203", "quantized collectives must not carry integer/exact-dtype payloads")
+def check_quantized_payload_dtype(ctx: FileContext) -> Iterable[Finding]:
+    """Block-scaled quantized collectives (``ring_allreduce_q`` and
+    friends) round their payload to int8-with-scales: floats degrade
+    gracefully, but integer/bool payloads — indices, counts, masks,
+    labels — silently corrupt, because a count that comes back 79.6
+    instead of 80 is not "less precise", it is wrong.  Flags any quantized
+    collective whose payload expression visibly carries an exact dtype
+    (``.astype(jnp.int32)``, a ``dtype=jnp.int64`` constructor, or a name
+    bound to one).  Exact payloads belong on ``jax.lax.psum`` — the
+    runtime twin of this rule is ``reduce_mode``'s TypeError on explicit
+    compression of exact dtypes."""
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        dotted = ctx.resolve(node.func) or ""
+        leaf = dotted.rsplit(".", 1)[-1]
+        if leaf not in _QUANTIZED_COLLECTIVES:
+            continue
+        if not ("compressed" in dotted or "comm" in dotted or dotted == leaf):
+            continue
+        idx = _QUANTIZED_COLLECTIVES[leaf]
+        if len(node.args) <= idx:
+            continue
+        dt = _visibly_exact_payload(ctx, node.args[idx], node)
+        if dt is not None:
+            yield ctx.finding(
+                "SPMD203", node,
+                f"quantized collective {leaf!r} payload visibly has exact "
+                f"dtype {dt!r}",
+                hint="int8 block-scaling rounds the payload: integer/bool "
+                "values (counts, indices, masks) corrupt silently.  Keep "
+                "exact dtypes on jax.lax.psum, or cast to float only if "
+                "approximate results are genuinely acceptable",
+            )
 
 
 # --------------------------------------------------------------------- #

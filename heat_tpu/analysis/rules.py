@@ -8,6 +8,7 @@ SPMD101    ppermute permutations must be valid (partial) bijections
 SPMD102    collective axis names must match the enclosing shard_map mesh
 SPMD201    trace purity: no host effects inside jit/shard_map/pallas fns
 SPMD202    no host-sync coercions (float()/.item()/np.asarray) on traced values
+SPMD203    quantized collectives must not carry integer/exact-dtype payloads
 SPMD301    Pallas BlockSpec tiles must respect the hardware tile grid
 SPMD302    pallas_call grids must be static (no traced values)
 SPMD401    jitted() cache keys: hashable, identity-stable parts only

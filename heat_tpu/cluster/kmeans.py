@@ -111,10 +111,99 @@ class KMeans(_KCluster):
         arr = x.larray.astype(jnp.float32)
         centers = self._cluster_centers.larray.astype(jnp.float32)
 
-        centers, labels, n_iter, inertia = KMeans._fit_loop(
+        loop = KMeans._fit_loop
+        comm = x.comm
+        if x.split == 0 and comm.size > 1 and int(x.shape[0]) % comm.size == 0:
+            from ..comm import compressed as _cq
+
+            k, f = int(centers.shape[0]), int(centers.shape[1])
+            mode = _cq.reduce_mode(jnp.float32, k * f * 4)
+            if mode is not None:
+                # collective-precision policy: the per-iteration (k, f)
+                # centroid-partial combine rides the quantized ring with
+                # an error-feedback accumulator in the loop carry
+                def loop(a, c, tol, mi):
+                    return _kmeans_loop_q(a, c, tol, mi, comm=comm, mode=mode)
+
+        centers, labels, n_iter, inertia = loop(
             arr, centers, jnp.float32(self.tol), jnp.int32(self.max_iter)
         )
         self._finalize_fit(x, centers, labels, n_iter)
         # device scalar; inertia_ property syncs lazily on access
         self._inertia = inertia
         return self
+
+
+def _kmeans_loop_q(arr, centers, tol, max_iter, *, comm, mode):
+    """Lloyd's algorithm with the centroid-partial combine on the
+    compressed ring: ONE compiled ``shard_map`` program over the row
+    shards.  Each step's ``(k, f)`` masked sums ride the quantized ring
+    while the ``(k,)`` counts stay exact (they divide the sums); the
+    error-feedback residual is part of the ``while_loop`` carry, so
+    quantization noise on the partials does not bias the centroid
+    trajectory.  Labels come back row-sharded, centers / n_iter / inertia
+    replicated (the ring's gather stage forwards identical bytes to every
+    device, and the scalar inertia combines with an exact ``psum``)."""
+    from jax.sharding import PartitionSpec
+
+    from ..comm.compressed import ring_allreduce_q_ef
+    from ..core._compile import jitted
+    from ..core._jax_compat import shard_map
+
+    n, f = int(arr.shape[0]), int(arr.shape[1])
+    k = int(centers.shape[0])
+    p = comm.size
+    mesh, name = comm._mesh, comm.axis_name
+
+    def make():
+        def kernel(a, c0, tol_, mi_):
+            def assign(c):
+                c2 = jnp.sum(c * c, axis=1)[None, :]
+                return jnp.argmin(c2 - 2.0 * jnp.matmul(a, c.T), axis=1)
+
+            def body(state):
+                it, c, _, e = state
+                labels = assign(c)
+                sel = jax.nn.one_hot(labels, k, dtype=a.dtype)
+                sums = jnp.matmul(sel.T, a)  # (k, f) local partial
+                # counts stay EXACT (they divide the centroid sums); only
+                # the (k, f) sums ride the quantized ring, with the EF
+                # residual carried in the loop state
+                gcounts = jax.lax.psum(jnp.sum(sel, axis=0), name)[:, None]
+                red, e2 = ring_allreduce_q_ef(
+                    sums.reshape(-1), e, name, size=p, mode=mode
+                )
+                gsums = red.reshape(k, f)
+                nc = jnp.where(gcounts > 0.5, gsums / jnp.maximum(gcounts, 1.0), c)
+                return it + 1, nc, jnp.sum((nc - c) ** 2), e2
+
+            def cond(state):
+                it, _, shift, _ = state
+                return jnp.logical_and(it < mi_, shift > tol_)
+
+            init = (
+                jnp.int32(0),
+                c0,
+                jnp.float32(jnp.inf),
+                jnp.zeros((k * f,), jnp.float32),
+            )
+            n_iter, c, _, _ = jax.lax.while_loop(cond, body, init)
+            labels = assign(c)
+            inertia = jax.lax.psum(jnp.sum((a - c[labels]) ** 2), name)
+            return c, labels, n_iter, inertia
+
+        rep = PartitionSpec()
+
+        def _f(a, c0, tol_, mi_):
+            return shard_map(
+                kernel,
+                mesh=mesh,
+                in_specs=(comm.spec(2, 0), rep, rep, rep),
+                out_specs=(rep, PartitionSpec(name), rep, rep),
+                check_vma=False,
+            )(a, c0, tol_, mi_)
+
+        return _f
+
+    fn = jitted(("kmeans.loop_q", comm, mode, n, f, k), make)
+    return fn(arr, centers, tol, max_iter)

@@ -1,0 +1,45 @@
+"""Compressed-collective layer under the comm seam.
+
+``ht.comm.set_collective_precision("int8_block")`` flips every eligible
+cross-device combine — the comm layer's ``allreduce``/``allgather``, the
+``_operations`` reduce paths, statistics moments, and the GaussianNB /
+Lasso / k-means fit loops — onto block-scaled quantized ring collectives
+with no call-site changes.  See :mod:`heat_tpu.comm.compressed` for the
+wire format and the error-feedback machinery.
+"""
+
+from . import compressed
+from .compressed import (
+    BLOCK,
+    allgather_q,
+    allreduce_q,
+    collective_precision,
+    dequantize_blocks,
+    get_collective_precision,
+    get_collective_threshold,
+    quantize_blocks,
+    reduce_mode,
+    ring_allgather_q,
+    ring_allreduce_q,
+    ring_allreduce_q_ef,
+    set_collective_precision,
+    set_collective_threshold,
+)
+
+__all__ = [
+    "BLOCK",
+    "allgather_q",
+    "allreduce_q",
+    "collective_precision",
+    "compressed",
+    "dequantize_blocks",
+    "get_collective_precision",
+    "get_collective_threshold",
+    "quantize_blocks",
+    "reduce_mode",
+    "ring_allgather_q",
+    "ring_allreduce_q",
+    "ring_allreduce_q_ef",
+    "set_collective_precision",
+    "set_collective_threshold",
+]

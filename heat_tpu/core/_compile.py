@@ -27,9 +27,37 @@ import jax
 
 from ._tracing import record_dispatch
 
-__all__ = ["jitted", "cache_stable", "clear_cache", "cache_size"]
+__all__ = [
+    "jitted",
+    "cache_stable",
+    "clear_cache",
+    "cache_size",
+    "register_key_context",
+    "context_token",
+]
 
 _CACHE: Dict[Tuple, Any] = {}
+
+#: Process-wide state whose value changes what a cached program MEANS
+#: (e.g. the collective-compression policy) registers a token provider
+#: here; its current token joins every ``jitted`` key, so flipping the
+#: state keys fresh entries instead of replaying stale programs.
+_KEY_CONTEXT: list = []
+
+
+def register_key_context(provider: Callable[[], Tuple]) -> Callable[[], Tuple]:
+    """Register a zero-arg provider whose tuple joins every cache key."""
+    if provider not in _KEY_CONTEXT:
+        _KEY_CONTEXT.append(provider)
+    return provider
+
+
+def context_token() -> Tuple:
+    """Concatenated tokens of all registered key-context providers."""
+    out: Tuple = ()
+    for provider in _KEY_CONTEXT:
+        out = out + tuple(provider())
+    return out
 
 try:  # jax >= 0.4: True only outside any active jax trace
     _trace_state_clean = jax.core.trace_state_clean
@@ -78,6 +106,8 @@ def jitted(key: Tuple, make_fn: Callable[[], Callable]) -> Callable:
     trace is active — an enclosing ``ht.fuse`` program or any jax trace —
     inline into the surrounding program and are not counted.
     """
+    if _KEY_CONTEXT:
+        key = key + context_token()
     fn = _CACHE.get(key)
     if fn is None:
         jfn = jax.jit(make_fn())

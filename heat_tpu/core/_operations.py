@@ -324,8 +324,48 @@ def __reduce_op(
     out_split_pad = split if padded else None
     comm = x.comm
     statics = _freeze(kwargs)
+
+    # collective-precision policy seam (heat_tpu.comm): a sum whose axes
+    # cover the split needs a cross-device combine — when the policy asks
+    # for compression, run local partials + the block-scaled quantized
+    # ring in ONE program instead of letting GSPMD insert an exact
+    # all-reduce.  Pad rows are zeros, so partial sums are exact; only
+    # sum compresses (max/min/prod are not pad-safe or not linear).
+    compressed = None
+    if (
+        split is None
+        and x.split is not None
+        and statics == ()
+        and reduction is jnp.sum
+        and comm.size > 1
+    ):
+        from ..comm import compressed as _cq
+
+        axes_t = (
+            (axis,)
+            if isinstance(axis, int)
+            else (tuple(range(x.ndim)) if axis is None else tuple(axis))
+        )
+        out_elems = 1
+        for d, s in enumerate(x.gshape):
+            if d not in axes_t:
+                out_elems *= int(s)
+        mode = _cq.reduce_mode(x._buffer.dtype, out_elems * 4)
+        if mode is not None:
+            compressed = _cq.reduce_q(
+                x._buffer,
+                comm=comm,
+                split=x.split,
+                axes=axes_t,
+                keepdims=keepdims,
+                mode=mode,
+                out_dtype=cast or x._buffer.dtype,
+            )
     # keyed on `reduction` only when cache-stable, else eager (SPMD401)
-    if statics is not None and cache_stable(reduction):
+    if compressed is not None:
+        result = compressed
+        padded = False
+    elif statics is not None and cache_stable(reduction):
         def make():
             def f(a):
                 if pad_in is not None:

@@ -46,6 +46,7 @@ from __future__ import annotations
 
 import math
 import os
+import sys
 import warnings
 from typing import List, Optional, Sequence, Tuple, Union
 
@@ -73,6 +74,36 @@ __all__ = [
 #: Name of the (single) mesh axis every DNDarray is sharded over.  The
 #: reference's "rank along MPI_COMM_WORLD" becomes "position along this axis".
 MESH_AXIS = "heat"
+
+_PKG_DIR = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _user_stacklevel() -> int:
+    """``warnings.warn`` stacklevel attributing to the first frame OUTSIDE
+    the heat_tpu package.
+
+    A fixed ``stacklevel=2`` is right only for direct callers; when a
+    comm method is reached through a wrapper (DNDarray method, fused
+    program, another comm method) the warning points inside the library.
+    Walking the stack from the warning site to the first external frame
+    makes the attribution correct in both cases.
+    """
+    level = 2  # stacklevel=2 == the caller of the method that warns
+    frame = sys._getframe(2)  # 0=this helper, 1=the warning method, 2=its caller
+    while frame is not None and os.path.abspath(frame.f_code.co_filename).startswith(
+        _PKG_DIR + os.sep
+    ):
+        frame = frame.f_back
+        level += 1
+    return level
+
+
+def _nbytes_of(array) -> int:
+    """Payload bytes from shape/dtype (tracers lack ``.nbytes``)."""
+    elems = 1
+    for s in tuple(getattr(array, "shape", ()) or ()):
+        elems *= int(s)
+    return elems * jnp.dtype(array.dtype).itemsize
 
 
 class Communication:
@@ -359,8 +390,24 @@ class XlaCommunication(Communication):
     def allgather(self, array: jax.Array, axis: int = 0) -> jax.Array:
         """Replicate a split array: the reference's ``Allgatherv``
         (communication.py:646-711) expressed as a reshard-to-replicated; XLA
-        emits a single all-gather over ICI."""
+        emits a single all-gather over ICI.
+
+        Consults the collective-precision policy
+        (:func:`heat_tpu.comm.set_collective_precision`): a compressible
+        payload on a canonically split axis rides the block-scaled
+        quantized ring instead (:func:`heat_tpu.comm.allgather_q`);
+        ``"f32"`` (the default), exact dtypes, ragged axes, and traced
+        inputs keep the exact reshard.
+        """
         del axis  # the global array already carries its own geometry
+        if self.size > 1 and getattr(array, "ndim", 0):
+            from ..comm import compressed as _cq
+
+            mode = _cq.reduce_mode(array.dtype, _nbytes_of(array))
+            if mode is not None:
+                src = self._split_axis_of(array)
+                if src is not None and int(array.shape[src]) % self.size == 0:
+                    return _cq.allgather_q(array, axis=src, comm=self, precision=mode)
         return _reshard(array, self.sharding(array.ndim, None))
 
     def alltoall(self, array: jax.Array, send_axis: int, recv_axis: int) -> jax.Array:
@@ -397,7 +444,7 @@ class XlaCommunication(Communication):
                     f"{recv_axis}; the global result is unaffected (layout is "
                     "a performance hint), but the caller's layout bookkeeping "
                     "may be stale",
-                    stacklevel=2,
+                    stacklevel=_user_stacklevel(),
                 )
         return self.apply_sharding(array, send_axis)
 
@@ -439,6 +486,16 @@ class XlaCommunication(Communication):
             )
         if n == 1:
             return jnp.squeeze(array, axis=0)
+        if op == "sum":
+            # collective-precision policy seam: compressible sum payloads
+            # ride the block-scaled quantized ring (heat_tpu.comm) — the
+            # default "f32" policy answers None and keeps this path
+            # bit-identical
+            from ..comm import compressed as _cq
+
+            mode = _cq.reduce_mode(array.dtype, _nbytes_of(array) // n)
+            if mode is not None:
+                return _cq.allreduce_q(array, op=op, comm=self, precision=mode)
         mesh, name = self._mesh, self.axis_name
 
         def make():

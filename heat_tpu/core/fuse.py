@@ -57,6 +57,7 @@ import numpy as np
 
 import jax
 
+from . import _compile
 from ._compile import cache_stable
 from ._tracing import (
     FuseTraceError,
@@ -180,7 +181,11 @@ class _FusedFunction:
         program = None
         key = None
         if self._stable and self._cacheable_statics(leaves):
-            key = (self._fn, self._donate, treedef, tuple(keyparts), comm)
+            # context_token(): process-wide state (collective-compression
+            # policy) that changes what the traced program computes —
+            # fused programs re-trace under a new policy, never replay
+            key = (self._fn, self._donate, treedef, tuple(keyparts), comm,
+                   _compile.context_token())
             try:
                 program = _FUSE_CACHE.get(key)
             except TypeError:  # unhashable static leaf slipped through

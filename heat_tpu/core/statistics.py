@@ -15,6 +15,7 @@ from typing import Optional, Sequence, Tuple, Union
 
 import numpy as np
 
+import jax
 import jax.numpy as jnp
 
 from . import _operations, factories, types
@@ -127,6 +128,49 @@ def _wrap_reduced(x: DNDarray, garr, axis, keepdims: bool = False) -> DNDarray:
         x.device,
         x.comm,
         True,
+    )
+
+
+def _compressed_moment(x: DNDarray, axis, keepdims: bool, kind: str, ddof: int = 0):
+    """Collective-precision policy seam for mean/var/std whose axes cover
+    the split: local partials + the block-scaled quantized ring in one
+    program (:mod:`heat_tpu.comm.compressed`), instead of GSPMD's exact
+    all-reduce.  Returns the replicated result, or None when the policy
+    (or the geometry) keeps the exact path.  var/std combine the first
+    moment exactly and compress only the centered second moment (see
+    :func:`heat_tpu.comm.compressed.moments_q`)."""
+    if x.split is None or x.comm.size <= 1 or types.heat_type_is_exact(x.dtype):
+        return None
+    axes = (
+        tuple(range(x.ndim))
+        if axis is None
+        else ((axis,) if isinstance(axis, int) else tuple(axis))
+    )
+    if x.split not in axes:
+        return None
+    from ..comm import compressed as _cq
+
+    buf = x._buffer
+    out_elems = 1
+    for d, s in enumerate(x.gshape):
+        if d not in axes:
+            out_elems *= int(s)
+    payload = out_elems * 4
+    mode = _cq.reduce_mode(buf.dtype, payload)
+    if mode is None:
+        return None
+    true_n = 1
+    for a in axes:
+        true_n *= int(x.gshape[a])
+    if kind == "mean":
+        return _cq.reduce_q(
+            buf, comm=x.comm, split=x.split, axes=axes, keepdims=keepdims,
+            mode=mode, mean_n=true_n, out_dtype=buf.dtype,
+        )
+    return _cq.moments_q(
+        buf, comm=x.comm, split=x.split, axes=axes, keepdims=keepdims,
+        mode=mode, true_n=true_n, split_valid=int(x.gshape[x.split]),
+        ddof=ddof, finalize=kind, out_dtype=buf.dtype,
     )
 
 
@@ -310,13 +354,16 @@ def mean(x, axis=None, keepdims=None, keepdim=None):
     sanitize_in(x)
     axis = sanitize_axis(x.shape, axis)
     cast = jnp.float32 if types.heat_type_is_exact(x.dtype) else None
-    fn = jitted(
-        ("stat.mean", axis, cast, keepdims),
-        lambda: lambda a: jnp.mean(
-            a.astype(cast) if cast else a, axis=axis, keepdims=keepdims
-        ),
-    )
-    return _wrap_reduced(x, fn(x.larray), axis, keepdims=keepdims)
+    res = _compressed_moment(x, axis, keepdims, kind="mean")
+    if res is None:
+        fn = jitted(
+            ("stat.mean", axis, cast, keepdims),
+            lambda: lambda a: jnp.mean(
+                a.astype(cast) if cast else a, axis=axis, keepdims=keepdims
+            ),
+        )
+        res = fn(x.larray)
+    return _wrap_reduced(x, res, axis, keepdims=keepdims)
 
 
 def median(x: DNDarray, axis=None, keepdim=None, out=None, keepdims=None):
@@ -352,7 +399,11 @@ def percentile(x: DNDarray, q, axis=None, out=None, interpolation: str = "linear
     sanitize_in(x)
     axis = sanitize_axis(x.shape, axis)
     method = {"linear": "linear", "lower": "lower", "higher": "higher", "midpoint": "midpoint", "nearest": "nearest"}[interpolation]
-    qa = jnp.asarray(q, dtype=jnp.float64)
+    # interpolation dtype follows the x64 state: requesting float64 with
+    # x64 off silently downcasts to f32 AND trips jax's dtype warning —
+    # ask for what the backend can actually represent
+    wide = jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
+    qa = jnp.asarray(q, dtype=wide)
     reduced_empty = (
         x.size == 0 if axis is None else any(x.shape[a] == 0 for a in (
             (axis,) if isinstance(axis, int) else axis
@@ -360,11 +411,11 @@ def percentile(x: DNDarray, q, axis=None, out=None, interpolation: str = "linear
     )
     # interpolation dtype only — materializing the (possibly ragged) true
     # view or an f64 copy up front would defeat the padded fast paths below
-    idt = jnp.float64 if types.heat_type_is_exact(x.dtype) else x._buffer.dtype
+    idt = wide if types.heat_type_is_exact(x.dtype) else x._buffer.dtype
 
     def _cast_view():
         arr = x.larray
-        return arr.astype(jnp.float64) if types.heat_type_is_exact(x.dtype) else arr
+        return arr.astype(wide) if types.heat_type_is_exact(x.dtype) else arr
 
     from ..parallel import sort as _parallel_sort  # lazy: parallel imports core
 
@@ -492,13 +543,18 @@ def _moment2(x, axis, ddof, kwargs, name, finalize):
     if kwargs:
         raise TypeError(f"unexpected keyword arguments: {sorted(kwargs)}")
     cast = jnp.float32 if types.heat_type_is_exact(x.dtype) else None
-    fn = jitted(
-        ("stat.moment2", name, axis, ddof, cast, keepdims),
-        lambda: lambda a: finalize(
-            jnp.var(a.astype(cast) if cast else a, axis=axis, ddof=ddof, keepdims=keepdims)
-        ),
+    res = _compressed_moment(
+        x, axis, keepdims, kind=("std" if name == "stat.std" else "var"), ddof=ddof
     )
-    return _wrap_reduced(x, fn(x.larray), axis, keepdims=keepdims)
+    if res is None:
+        fn = jitted(
+            ("stat.moment2", name, axis, ddof, cast, keepdims),
+            lambda: lambda a: finalize(
+                jnp.var(a.astype(cast) if cast else a, axis=axis, ddof=ddof, keepdims=keepdims)
+            ),
+        )
+        res = fn(x.larray)
+    return _wrap_reduced(x, res, axis, keepdims=keepdims)
 
 
 def std(x, axis=None, ddof: int = 0, **kwargs):

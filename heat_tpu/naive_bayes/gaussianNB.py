@@ -176,9 +176,31 @@ class GaussianNB(ClassificationMixin, BaseEstimator):
         member = jax.nn.one_hot(class_idx, k, dtype=arr.dtype)  # (n, k)
         if sw is not None:
             member = member * jnp.asarray(sw, dtype=arr.dtype)[:, None]
-        n_new_k = np.asarray(jnp.sum(member, axis=0))  # (k,)
-        sums = np.asarray(jnp.matmul(member.T, arr))  # (k, f)
-        sqsums = np.asarray(jnp.matmul(member.T, arr * arr))  # (k, f)
+        routed = False
+        if x.split == 0 and x.comm.size > 1 and int(x.shape[0]) % x.comm.size == 0:
+            from ..comm import compressed as _cq
+
+            mode = _cq.reduce_mode(x._buffer.dtype, 2 * k * int(x.shape[1]) * 4)
+            if mode is not None:
+                # collective-precision policy seam: the centered per-class
+                # second-moment partials combine over the block-scaled
+                # quantized ring in ONE program; counts and first moments
+                # stay exact (they divide and center the moments — see
+                # class_moments_q).  Reconstruct the raw sqsums the merge
+                # loop expects via sq = ssd + sums^2/n, exact in f64.
+                cnts, qsums, qssd = _cq.class_moments_q(
+                    x.larray, member.astype(jnp.float32), comm=x.comm, mode=mode
+                )
+                n_new_k = np.asarray(cnts, dtype=np.float64)
+                sums = np.asarray(qsums, dtype=np.float64)
+                sqsums = np.asarray(qssd, dtype=np.float64) + sums**2 / np.maximum(
+                    n_new_k, 1.0
+                )[:, None]
+                routed = True
+        if not routed:
+            n_new_k = np.asarray(jnp.sum(member, axis=0))  # (k,)
+            sums = np.asarray(jnp.matmul(member.T, arr))  # (k, f)
+            sqsums = np.asarray(jnp.matmul(member.T, arr * arr))  # (k, f)
 
         for ci in range(k):
             n_new = float(n_new_k[ci])

@@ -18,6 +18,11 @@ if ! python -m pytest tests/test_fuse.py -q -k "dispatch or single_dispatch"; th
     echo "FAILED fuse dispatch-count gate"
     fail=1
 fi
+echo "=== compressed collectives (parity, error bounds, policy routing) ==="
+if ! python -m pytest tests/test_compressed_collectives.py -q; then
+    echo "FAILED compressed collectives"
+    fail=1
+fi
 for n in "${sizes[@]}"; do
     echo "=== mesh size $n ==="
     if ! HEAT_TEST_DEVICES="$n" python -m pytest tests/ -q -x; then

@@ -149,6 +149,9 @@ def _fake_full_result():
         "cdist_gb_per_sec": 1354.12,
         "moments_gb_per_sec": 797.33,
         "global_sum_gb_per_sec": 694.01,
+        "allreduce_q_gbps": 212.5,
+        "allreduce_exact_gb_per_sec": 80.3,
+        "allreduce_q_vs_exact": 2.646,
         "kmedians_iter_per_sec": 1063.5,
         "kmedians_churn_iter_per_sec": 143.21,
         "kmedoids_iter_per_sec": 10466.7,

@@ -1,0 +1,377 @@
+"""Compressed collectives: parity vs exact, error bounds, error feedback,
+the precision policy, and the satellites that ride along (percentile x64
+dtype, alltoall warning attribution).
+
+Error bound used throughout (documented in docs/design.md): one int8
+block-scale quantization rounds each element by at most ``scale/2 =
+absmax_block/254``; a p-device ring performs at most p quantizations per
+chunk, and every intermediate partial sum's block absmax is bounded by
+``M = sum_i max|x_i|`` over the mesh positions.  So
+
+    max|allreduce_q - exact|  <=  p * M / 254      (int8_block)
+    max|allreduce_q - exact|  <=  p * M * 2**-8    (bf16: 8 mantissa bits)
+
+The bounds are loose by design — the tests assert the contract, the bench
+measures typical error (orders of magnitude tighter on real data).
+"""
+
+import os
+import warnings
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import heat_tpu as ht
+from heat_tpu.comm import compressed as cq
+from heat_tpu.core import _tracing
+from heat_tpu.core.communication import XlaCommunication
+
+RNG = np.random.default_rng(7)
+
+
+def _sub_comm(k):
+    devs = jax.devices()
+    if len(devs) < k:
+        pytest.skip(f"needs {k} devices")
+    return XlaCommunication(devs[:k])
+
+
+def _err_bound(stacked: np.ndarray, p: int, mode: str) -> float:
+    m = float(np.sum(np.max(np.abs(stacked.reshape(p, -1)), axis=1)))
+    per_hop = m / 254.0 if mode == "int8_block" else m * 2.0**-8
+    return max(p * per_hop, 1e-6)
+
+
+# --------------------------------------------------------------------- #
+# allreduce_q / allgather_q parity vs exact                              #
+# --------------------------------------------------------------------- #
+@pytest.mark.parametrize("mesh_size", [1, 2, 4, 8])
+@pytest.mark.parametrize("dtype", [np.float32, "bfloat16"])
+@pytest.mark.parametrize("mode", ["bf16", "int8_block"])
+def test_allreduce_q_parity(mesh_size, dtype, mode):
+    comm = _sub_comm(mesh_size)
+    jdt = jnp.bfloat16 if dtype == "bfloat16" else jnp.float32
+    data = RNG.normal(size=(mesh_size, 37, 5)).astype(np.float32)
+    x = jnp.asarray(data).astype(jdt)
+    exact = np.asarray(comm.allreduce(x, "sum"), dtype=np.float64)
+    got = np.asarray(cq.allreduce_q(x, comm=comm, precision=mode), dtype=np.float64)
+    err = np.max(np.abs(got - exact))
+    assert err <= _err_bound(data, mesh_size, mode), (err, mode, mesh_size)
+
+
+@pytest.mark.parametrize("mesh_size", [1, 2, 4, 8])
+@pytest.mark.parametrize("mode", ["bf16", "int8_block"])
+def test_allgather_q_parity(mesh_size, mode):
+    comm = _sub_comm(mesh_size)
+    data = RNG.normal(size=(mesh_size * 6, 9)).astype(np.float32)
+    x = comm.apply_sharding(jnp.asarray(data), 0)
+    got = np.asarray(cq.allgather_q(x, axis=0, comm=comm, precision=mode))
+    # gather quantizes each shard exactly once: single-hop bound
+    bound = float(np.max(np.abs(data))) * (1 / 254.0 if mode == "int8_block" else 2.0**-8)
+    assert got.shape == data.shape
+    assert np.max(np.abs(got - data)) <= max(bound, 1e-6)
+
+
+def test_allgather_q_is_bit_identical_across_positions():
+    """All devices decode the SAME bytes — replication is exact."""
+    comm = _sub_comm(4)
+    data = RNG.normal(size=(8, 3)).astype(np.float32)
+    x = comm.apply_sharding(jnp.asarray(data), 0)
+    out = cq.allgather_q(x, axis=0, comm=comm, precision="int8_block")
+    shards = [np.asarray(s.data) for s in out.addressable_shards]
+    for s in shards[1:]:
+        np.testing.assert_array_equal(shards[0], s)
+
+
+def test_allreduce_q_one_dispatch():
+    comm = _sub_comm(4)
+    x = jnp.asarray(RNG.normal(size=(4, 64)).astype(np.float32))
+    cq.allreduce_q(x, comm=comm, precision="int8_block")  # warm the cache
+    _tracing.reset_dispatch_count()
+    cq.allreduce_q(x, comm=comm, precision="int8_block")
+    assert _tracing.dispatch_count() == 1
+
+
+def test_allreduce_q_rejects_bad_leading_axis():
+    comm = _sub_comm(2)
+    x = jnp.ones((3, 8), jnp.float32)
+    with pytest.raises(ValueError, match="mesh size"):
+        cq.allreduce_q(x, comm=comm, precision="int8_block")
+
+
+def test_allreduce_q_non_sum_falls_back_exact():
+    comm = _sub_comm(4)
+    x = jnp.asarray(RNG.normal(size=(4, 16)).astype(np.float32))
+    got = cq.allreduce_q(x, op="max", comm=comm, precision="int8_block")
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(comm.allreduce(x, "max")))
+    with pytest.raises(ValueError, match="op='sum'"):
+        cq.allreduce_q(x, op="max", comm=comm, error=jnp.zeros_like(x))
+
+
+# --------------------------------------------------------------------- #
+# error feedback                                                         #
+# --------------------------------------------------------------------- #
+def test_error_feedback_residual_compensates():
+    """Accumulated error of an EF sum over many iterations stays near the
+    single-shot error (the residual telescopes), instead of growing
+    linearly the way independent quantizations would."""
+    comm = _sub_comm(8)
+    p = comm.size
+    data = RNG.normal(size=(p, 256)).astype(np.float32)
+    x = jnp.asarray(data)
+    err = jnp.zeros_like(x)
+    acc = np.zeros(256, dtype=np.float64)
+    for _ in range(50):
+        red, err = cq.allreduce_q(x, comm=comm, precision="int8_block", error=err)
+        acc += np.asarray(red, dtype=np.float64)
+    exact = 50.0 * data.sum(axis=0).astype(np.float64)
+    accumulated = np.max(np.abs(acc - exact))
+    single = _err_bound(data, p, "int8_block")
+    # 50 independent quantized sums could drift ~50x the single-shot
+    # bound; EF must hold the accumulated error well under that
+    assert accumulated <= 5.0 * single, (accumulated, single)
+
+
+def test_error_feedback_exact_policy_is_exact():
+    """EF with the policy left exact must add no noise (and zero residual)."""
+    comm = _sub_comm(4)
+    data = RNG.normal(size=(4, 32)).astype(np.float32)
+    x = jnp.asarray(data)
+    red, err = cq.allreduce_q(x, comm=comm, precision="f32", error=jnp.zeros_like(x))
+    np.testing.assert_allclose(np.asarray(red), data.sum(axis=0), rtol=1e-6)
+    np.testing.assert_array_equal(np.asarray(err), 0.0)
+
+
+def test_lasso_gd_int8_matches_exact_loss():
+    """End-to-end EF convergence: ISTA with the gradient combine on the
+    int8 ring reaches the same loss as the exact solver."""
+    n, m = 64, 6
+    A = RNG.normal(size=(n, m)).astype(np.float32)
+    theta_true = np.array([0.0, 2.0, -3.0, 0.0, 1.5, 0.0], np.float32)
+    yv = A @ theta_true + 0.01 * RNG.normal(size=n).astype(np.float32)
+    X = ht.array(A, split=0)
+    Y = ht.array(yv, split=0)
+
+    def loss(est):
+        r = A @ np.asarray(est.theta.numpy()).reshape(-1)[1:] + float(
+            np.asarray(est.theta.numpy()).reshape(-1)[0]
+        ) - yv
+        th = np.asarray(est.theta.numpy()).reshape(-1)
+        return 0.5 * np.mean(r * r) + 0.1 * np.sum(np.abs(th[1:]))
+
+    exact = ht.regression.Lasso(lam=0.1, max_iter=2000, tol=1e-8, solver="gd").fit(X, Y)
+    with cq.collective_precision("int8_block"):
+        comp = ht.regression.Lasso(lam=0.1, max_iter=2000, tol=1e-8, solver="gd").fit(X, Y)
+    assert abs(loss(comp) - loss(exact)) <= 1e-3 * max(loss(exact), 1e-6)
+
+
+# --------------------------------------------------------------------- #
+# block-scaled quantization kernel                                       #
+# --------------------------------------------------------------------- #
+def test_quantize_blocks_pallas_roundtrip():
+    """rows % 32 == 0 engages the fused Pallas kernel (interpret mode on
+    CPU); the roundtrip must respect the per-block bound and preserve
+    exact zeros and block maxima."""
+    rows = 32
+    x = RNG.normal(size=(rows * cq.BLOCK,)).astype(np.float32)
+    x[::17] = 0.0
+    q, s = cq.quantize_blocks(jnp.asarray(x))
+    assert q.shape == (rows, cq.BLOCK) and q.dtype == jnp.int8
+    assert s.shape == (rows, 1) and s.dtype == jnp.float32
+    back = np.asarray(cq.dequantize_blocks(q, s))
+    blocks = x.reshape(rows, cq.BLOCK)
+    bound = np.abs(blocks).max(axis=1, keepdims=True) / 254.0
+    assert np.all(np.abs(back.reshape(rows, cq.BLOCK) - blocks) <= bound + 1e-7)
+    np.testing.assert_array_equal(back[::17], 0.0)  # exact zeros survive
+    # each block's absmax element is +-127 * scale == itself
+    amax_idx = np.abs(blocks).argmax(axis=1)
+    np.testing.assert_allclose(
+        back.reshape(rows, cq.BLOCK)[np.arange(rows), amax_idx],
+        blocks[np.arange(rows), amax_idx],
+        rtol=1e-6,
+    )
+
+
+def test_quantize_blocks_jnp_fallback_matches_pallas():
+    """Non-conforming rows take the jnp path: identical numerics."""
+    x = RNG.normal(size=(3 * cq.BLOCK,)).astype(np.float32)  # 3 rows: jnp path
+    q1, s1 = cq.quantize_blocks(jnp.asarray(x))
+    x32 = np.tile(x, 32)  # 96 rows: pallas path
+    q2, s2 = cq.quantize_blocks(jnp.asarray(x32))
+    np.testing.assert_array_equal(np.asarray(q1), np.asarray(q2)[:3])
+    np.testing.assert_array_equal(np.asarray(s1), np.asarray(s2)[:3])
+
+
+def test_all_zero_block_roundtrips_exactly():
+    x = jnp.zeros((cq.BLOCK,), jnp.float32)
+    q, s = cq.quantize_blocks(x)
+    np.testing.assert_array_equal(np.asarray(s), 1.0)  # guarded scale
+    np.testing.assert_array_equal(np.asarray(cq.dequantize_blocks(q, s)), 0.0)
+
+
+# --------------------------------------------------------------------- #
+# precision policy                                                       #
+# --------------------------------------------------------------------- #
+def test_policy_validation():
+    with pytest.raises(ValueError, match="unknown collective precision"):
+        cq.set_collective_precision("int4")
+    with pytest.raises(ValueError, match="non-negative"):
+        cq.set_collective_threshold(-1)
+    assert cq.get_collective_precision() == "f32"  # default untouched
+
+
+def test_explicit_compression_of_exact_dtype_raises():
+    with pytest.raises(TypeError, match="SPMD203"):
+        cq.reduce_mode(jnp.int32, 1 << 20, "int8_block")
+    # policy-driven (non-explicit) exact dtypes silently stay exact
+    with cq.collective_precision("int8_block"):
+        assert cq.reduce_mode(jnp.int32, 1 << 20) is None
+        assert cq.reduce_mode(jnp.float64, 1 << 20) is None
+
+
+def test_auto_mode_thresholds_on_payload_bytes():
+    prev = cq.get_collective_threshold()
+    try:
+        cq.set_collective_threshold(1 << 10)
+        with cq.collective_precision("auto"):
+            assert cq.reduce_mode(jnp.float32, 1 << 10) == "int8_block"
+            assert cq.reduce_mode(jnp.float32, (1 << 10) - 1) is None
+    finally:
+        cq.set_collective_threshold(prev)
+
+
+def test_policy_is_part_of_compiled_program_cache_key():
+    from heat_tpu.core._compile import context_token
+
+    t0 = context_token()
+    with cq.collective_precision("int8_block"):
+        t1 = context_token()
+    assert t0 != t1 and context_token() == t0
+
+
+def test_f32_default_is_bit_identical():
+    """The default policy must keep comm.allreduce bit-identical to the
+    seed path — same program, same bits."""
+    comm = _sub_comm(8)
+    x = jnp.asarray(RNG.normal(size=(8, 33)).astype(np.float32))
+    a = np.asarray(comm.allreduce(x, "sum"))
+    with cq.collective_precision("f32"):
+        b = np.asarray(comm.allreduce(x, "sum"))
+    np.testing.assert_array_equal(a, b)
+
+
+def test_comm_allreduce_respects_policy():
+    """No call-site changes: the policy seam lives inside
+    XlaCommunication.allreduce."""
+    comm = _sub_comm(8)
+    data = RNG.normal(size=(8, 4096)).astype(np.float32)
+    x = jnp.asarray(data)
+    exact = data.sum(axis=0).astype(np.float64)
+    with cq.collective_precision("int8_block"):
+        got = np.asarray(comm.allreduce(x, "sum"), dtype=np.float64)
+    err = np.max(np.abs(got - exact))
+    assert 0 < err <= _err_bound(data, 8, "int8_block")  # compressed, in bound
+
+
+# --------------------------------------------------------------------- #
+# the no-call-site-changes hooks: stats / ML paths under the policy      #
+# --------------------------------------------------------------------- #
+def test_var_std_centered_wire_on_noncentered_data():
+    """var/std must survive non-centered data: E[x^2]-mu^2 cancellation
+    would let quantization noise exceed the variance outright; the
+    centered second-moment wire keeps the error relative to var itself."""
+    data = (RNG.normal(size=(64, 7)) * 0.5 + 100.0).astype(np.float32)
+    x = ht.array(data, split=0)
+    ev = np.asarray(ht.var(x, axis=0).numpy())
+    es = np.asarray(ht.std(x, axis=0).numpy())
+    with cq.collective_precision("int8_block"):
+        qv = np.asarray(ht.var(x, axis=0).numpy())
+        qs = np.asarray(ht.std(x, axis=0).numpy())
+    assert np.max(np.abs(qv - ev) / ev) < 0.05
+    assert np.max(np.abs(qs - es) / es) < 0.05
+
+
+def test_mean_sum_compressed_parity_ragged():
+    data = (RNG.normal(size=(61,)) * 2.0 + 50.0).astype(np.float32)
+    x = ht.array(data, split=0)
+    with cq.collective_precision("int8_block"):
+        qm = float(ht.mean(x).numpy())
+        qsum = float(ht.sum(x).numpy())
+    assert abs(qm - data.mean()) / abs(data.mean()) < 0.05
+    assert abs(qsum - data.sum()) / abs(data.sum()) < 0.05
+
+
+def test_kmeans_int8_reaches_same_optimum():
+    cs = np.array([[0, 0], [6, 6], [-6, 5]], np.float32)
+    pts = np.concatenate(
+        [RNG.normal(size=(80, 2)).astype(np.float32) * 0.5 + c for c in cs]
+    )
+    pts = pts[RNG.permutation(240)]
+    X = ht.array(pts, split=0)
+    init = ht.array(cs + 0.3, split=None)
+    exact = ht.cluster.KMeans(n_clusters=3, init=init, max_iter=100, tol=1e-6).fit(X)
+    with cq.collective_precision("int8_block"):
+        comp = ht.cluster.KMeans(n_clusters=3, init=init, max_iter=100, tol=1e-6).fit(X)
+    e_c = np.asarray(exact.cluster_centers_.numpy())
+    q_c = np.asarray(comp.cluster_centers_.numpy())
+    assert np.max(np.abs(e_c - q_c)) < 0.1
+    assert float(comp.inertia_) <= float(exact.inertia_) * 1.05
+
+
+def test_gaussian_nb_int8_parity():
+    cs = np.array([[0, 0], [6, 6], [-6, 5]], np.float32)
+    pts = np.concatenate(
+        [RNG.normal(size=(80, 2)).astype(np.float32) * 0.5 + c for c in cs]
+    )
+    labels = np.repeat([0, 1, 2], 80)
+    perm = RNG.permutation(240)
+    X = ht.array(pts[perm], split=0)
+    Y = ht.array(labels[perm].astype(np.int32), split=0)
+    exact = ht.naive_bayes.GaussianNB().fit(X, Y)
+    with cq.collective_precision("int8_block"):
+        comp = ht.naive_bayes.GaussianNB().fit(X, Y)
+    # counts + first moments are exact on the wire; theta must match
+    np.testing.assert_allclose(comp.theta_, exact.theta_, atol=1e-5)
+    # centered second moments: small relative noise only
+    assert np.max(np.abs(comp.sigma_ - exact.sigma_) / exact.sigma_) < 0.05
+    pred = np.asarray(comp.predict(X).numpy()).reshape(-1)
+    assert (pred == labels[perm]).mean() > 0.99
+
+
+# --------------------------------------------------------------------- #
+# satellites: percentile x64 dtype, alltoall warning attribution        #
+# --------------------------------------------------------------------- #
+@pytest.mark.filterwarnings("error")
+def test_percentile_respects_x64_state():
+    """Interpolation dtype follows the x64 state: no 'requested float64'
+    warning with x64 off, full-width f64 interpolation with it on."""
+    data = RNG.normal(size=(40,)).astype(np.float32)
+    x = ht.array(data, split=0)
+    res = np.asarray(ht.percentile(x, 32.5).numpy())
+    np.testing.assert_allclose(res, np.percentile(np.float64(data), 32.5), rtol=1e-6)
+    prev = jax.config.jax_enable_x64
+    try:
+        jax.config.update("jax_enable_x64", False)
+        res32 = np.asarray(ht.percentile(x, 32.5).numpy())  # must not warn
+        np.testing.assert_allclose(
+            res32, np.percentile(np.float64(data), 32.5), rtol=1e-5
+        )
+    finally:
+        jax.config.update("jax_enable_x64", prev)
+
+
+def test_alltoall_warning_attributed_to_caller():
+    """The stale-recv_axis warning must point at THIS file, not at a
+    frame inside heat_tpu (the stacklevel fix)."""
+    comm = _sub_comm(4)
+    data = RNG.normal(size=(8, 8)).astype(np.float32)
+    x = comm.apply_sharding(jnp.asarray(data), 0)
+    with warnings.catch_warnings(record=True) as rec:
+        warnings.simplefilter("always")
+        comm.alltoall(x, send_axis=1, recv_axis=1)
+    rec = [r for r in rec if "alltoall" in str(r.message)]
+    assert rec, "stale recv_axis must warn"
+    assert os.path.abspath(rec[0].filename) == os.path.abspath(__file__)

@@ -173,6 +173,48 @@ def helper_passthrough(s, axis_name):
     assert lint(src, "SPMD102") == []
 
 
+def test_spmd102_knows_compressed_ring_collectives():
+    src = """
+import jax
+from jax.sharding import PartitionSpec
+from jax.experimental.shard_map import shard_map
+from heat_tpu.comm.compressed import ring_allreduce_q
+
+def f(x, mesh, comm):
+    name = comm.axis_name
+    def kernel(s):
+        return ring_allreduce_q(s, "rogue", size=8, mode="int8_block")
+    return shard_map(
+        kernel, mesh=mesh,
+        in_specs=PartitionSpec(name), out_specs=PartitionSpec(),
+    )(x)
+"""
+    findings = lint(src, "SPMD102")
+    assert findings and "ring_allreduce_q" in findings[0].message
+
+
+def test_spmd102_clean_on_compressed_ring_with_axis_binding():
+    src = """
+import jax
+from jax.sharding import PartitionSpec
+from jax.experimental.shard_map import shard_map
+from heat_tpu.comm.compressed import ring_allgather_q, ring_allreduce_q_ef
+
+def f(x, e, mesh, comm):
+    name = comm.axis_name
+    def kernel(s, err):
+        g = ring_allgather_q(s, name, size=8, mode="bf16")
+        r, e2 = ring_allreduce_q_ef(s, err, name, size=8, mode="int8_block")
+        return g, r, e2
+    return shard_map(
+        kernel, mesh=mesh,
+        in_specs=(PartitionSpec(name), PartitionSpec(name)),
+        out_specs=(PartitionSpec(), PartitionSpec(), PartitionSpec(name)),
+    )(x, e)
+"""
+    assert lint(src, "SPMD102") == []
+
+
 # --------------------------------------------------------------------- #
 # SPMD201: trace purity                                                  #
 # --------------------------------------------------------------------- #
@@ -322,6 +364,49 @@ def program(x):
 """
     findings = lint(src, "SPMD202")
     assert findings and ".tolist()" in findings[0].message
+
+
+# --------------------------------------------------------------------- #
+# SPMD203: quantized collectives on exact dtypes                         #
+# --------------------------------------------------------------------- #
+def test_spmd203_triggers_on_astype_int_payload():
+    src = """
+import jax.numpy as jnp
+from heat_tpu.comm.compressed import ring_allreduce_q
+
+def kernel(v, name):
+    counts = v.astype(jnp.int32)
+    return ring_allreduce_q(counts, name, size=8, mode="int8_block")
+"""
+    findings = lint(src, "SPMD203")
+    assert findings and "'int32'" in findings[0].message
+
+
+def test_spmd203_triggers_on_integer_constructor_payload():
+    src = """
+import jax.numpy as jnp
+from heat_tpu.comm import compressed
+
+def kernel(name):
+    mask = jnp.zeros((128,), dtype=jnp.bool_)
+    return compressed.ring_allgather_q(mask, name, size=4, mode="int8_block")
+"""
+    findings = lint(src, "SPMD203")
+    assert findings and "'bool_'" in findings[0].message
+
+
+def test_spmd203_clean_on_float_payloads():
+    src = """
+import jax.numpy as jnp
+from heat_tpu.comm.compressed import ring_allreduce_q, ring_allreduce_q_ef
+
+def kernel(a, e, name):
+    sums = jnp.matmul(a.T, a)
+    r = ring_allreduce_q(sums.reshape(-1), name, size=8, mode="int8_block")
+    g, e2 = ring_allreduce_q_ef(a.astype(jnp.float32), e, name, size=8, mode="bf16")
+    return r, g, e2
+"""
+    assert lint(src, "SPMD203") == []
 
 
 # --------------------------------------------------------------------- #
@@ -485,7 +570,8 @@ def test_baseline_fingerprint_is_line_insensitive():
 # --------------------------------------------------------------------- #
 def test_every_rule_is_registered():
     assert [r.id for r in all_rules()] == [
-        "SPMD101", "SPMD102", "SPMD201", "SPMD202", "SPMD301", "SPMD302", "SPMD401",
+        "SPMD101", "SPMD102", "SPMD201", "SPMD202", "SPMD203", "SPMD301",
+        "SPMD302", "SPMD401",
     ]
 
 
