@@ -55,6 +55,7 @@ from . import parallel
 from . import graph
 from . import naive_bayes
 from . import regression
+from . import resilience
 from . import spatial
 from . import utils
 from . import datasets

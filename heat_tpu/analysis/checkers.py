@@ -763,6 +763,90 @@ def check_quantized_payload_dtype(ctx: FileContext) -> Iterable[Finding]:
 
 
 # --------------------------------------------------------------------- #
+# SPMD204: quantized collectives in guard-disabled regions               #
+# --------------------------------------------------------------------- #
+def _guard_off_call(ctx: FileContext, expr: ast.AST, leaf_name: str) -> bool:
+    """True when ``expr`` is a ``guard("off")`` / ``set_guard_policy("off")``
+    call (positionally or via ``policy=``) from the resilience layer (or a
+    bare name, the fixture/test spelling)."""
+    if not isinstance(expr, ast.Call):
+        return False
+    dotted = ctx.resolve(expr.func) or ""
+    if dotted.rsplit(".", 1)[-1] != leaf_name:
+        return False
+    if not (
+        dotted == leaf_name
+        or "resilience" in dotted
+        or "guards" in dotted
+        or "heat_tpu" in dotted
+    ):
+        return False
+    policy = expr.args[0] if expr.args else None
+    if policy is None:
+        for kw in expr.keywords:
+            if kw.arg == "policy":
+                policy = kw.value
+    return isinstance(policy, ast.Constant) and policy.value == "off"
+
+
+@rule("SPMD204", "quantized collectives in guard-disabled regions need an explicit suppression")
+def check_guard_disabled_collectives(ctx: FileContext) -> Iterable[Finding]:
+    """A quantized collective under ``guard("off")`` runs with its
+    numerical health checks stripped: non-finite or saturated payloads
+    pass through the int8 ring unchallenged, which is precisely the
+    failure mode the guards exist to catch.  Flags any quantized
+    collective call (``allreduce_q`` and friends, the SPMD203 set) that
+    is lexically inside a ``with guard("off")`` block or follows a
+    ``set_guard_policy("off")`` call in the same scope, unless the line
+    carries ``# spmdlint: disable=SPMD204`` — disabling guards around a
+    compressed collective must be a visible, deliberate decision."""
+    off_sets: List[Tuple[ast.AST, int]] = []
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Call) and _guard_off_call(ctx, node, "set_guard_policy"):
+            encl = ctx.enclosing_functions(node)
+            off_sets.append((encl[0] if encl else ctx.tree, node.lineno))
+
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        dotted = ctx.resolve(node.func) or ""
+        leaf = dotted.rsplit(".", 1)[-1]
+        if leaf not in _QUANTIZED_COLLECTIVES:
+            continue
+        if not ("compressed" in dotted or "comm" in dotted or dotted == leaf):
+            continue
+        reason = None
+        cur: Optional[ast.AST] = node
+        while cur is not None:
+            cur = ctx.parents.get(cur)
+            if isinstance(cur, ast.With):
+                for item in cur.items:
+                    if _guard_off_call(ctx, item.context_expr, "guard"):
+                        reason = 'a `with guard("off")` block'
+                        break
+            if reason:
+                break
+        if reason is None:
+            encl = ctx.enclosing_functions(node)
+            scope = encl[0] if encl else ctx.tree
+            for s, ln in off_sets:
+                if s is scope and ln < node.lineno:
+                    reason = 'a set_guard_policy("off") call above it'
+                    break
+        if reason:
+            yield ctx.finding(
+                "SPMD204", node,
+                f"quantized collective {leaf!r} runs inside {reason} "
+                "with numerical health guards disabled",
+                hint="compressed collectives silently propagate non-finite "
+                "or saturated payloads when unguarded; re-enable guards "
+                "(policy 'raise'/'warn'/'degrade'), or mark the call with "
+                "`# spmdlint: disable=SPMD204` if running unguarded is a "
+                "deliberate, reviewed decision",
+            )
+
+
+# --------------------------------------------------------------------- #
 # SPMD301/302: Pallas tiling and grids                                   #
 # --------------------------------------------------------------------- #
 @rule("SPMD301", "Pallas BlockSpec tiles must respect the hardware tile grid")

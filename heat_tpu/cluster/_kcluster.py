@@ -105,6 +105,8 @@ class _KCluster(ClusteringMixin, BaseEstimator):
         max_iter: int,
         tol: float,
         random_state: Optional[int],
+        checkpoint_every: int = 0,
+        checkpoint_path: Optional[str] = None,
     ):
         # isinstance guard: DNDarray overloads == elementwise
         if isinstance(init, str) and init == self._init_plus_plus_alias:
@@ -114,11 +116,22 @@ class _KCluster(ClusteringMixin, BaseEstimator):
         self.max_iter = max_iter
         self.tol = tol
         self.random_state = random_state
+        self.checkpoint_every = checkpoint_every
+        self.checkpoint_path = checkpoint_path
         self._metric = metric
         self._cluster_centers = None
         self._labels = None
         self._inertia = None
         self._n_iter = None
+
+    def _checkpointer(self, algo: str, meta: dict):
+        """The loop-snapshot driver for resumable fits (KMeans; the other
+        k-clusterers accept the parameters but run unsegmented)."""
+        from ..resilience.resume import LoopCheckpointer
+
+        return LoopCheckpointer(
+            self.checkpoint_path, self.checkpoint_every, algo, meta
+        )
 
     def _checkpoint_attrs(self):
         # fitted state lives in private storage behind the *_ properties

@@ -37,6 +37,15 @@ class KMeans(_KCluster):
     max_iter : int
     tol : float — convergence threshold on centroid shift
     random_state : int or None
+    checkpoint_every : int — snapshot the Lloyd-loop carry every N
+        iterations (0, the default, disables checkpointing).  The loop
+        runs in N-iteration segments of the SAME compiled program, so a
+        fit killed at a segment boundary and restarted with
+        ``fit(..., resume=True)`` replays the identical float trajectory
+        — centers bitwise-equal to never having been interrupted.  The
+        quantized-ring form snapshots the error-feedback residual too.
+    checkpoint_path : str or None — HDF5 snapshot target (atomic writes;
+        required when ``checkpoint_every > 0``).
     """
 
     _init_plus_plus_alias = "kmeans++"
@@ -48,6 +57,8 @@ class KMeans(_KCluster):
         max_iter: int = 300,
         tol: float = 1e-4,
         random_state: Optional[int] = None,
+        checkpoint_every: int = 0,
+        checkpoint_path: Optional[str] = None,
     ):
         super().__init__(
             metric=_quadratic_cdist,  # module-level: fused-assign cache hit
@@ -56,24 +67,29 @@ class KMeans(_KCluster):
             max_iter=max_iter,
             tol=tol,
             random_state=random_state,
+            checkpoint_every=checkpoint_every,
+            checkpoint_path=checkpoint_path,
         )
 
     @staticmethod
     @jax.jit
-    def _fit_loop(arr, centers, tol, max_iter):
-        """The ENTIRE Lloyd fit as one compiled program: a
-        ``lax.while_loop`` over fused assign+update steps, the final
-        labels, and the inertia.  One dispatch, zero host syncs per fit —
-        the host never sees intermediate state (the reference's per-epoch
+    def _fit_segment(arr, tol, stop, carry):
+        """Lloyd iterations as ONE compiled ``lax.while_loop`` program,
+        re-enterable: the carry ``(it, centers, shift)`` comes in
+        explicitly and steps run while ``it < stop`` — the whole fit is
+        one segment with ``stop = max_iter``; checkpointed fits replay
+        THIS program segment by segment, which is what makes resume
+        bitwise-exact.  One dispatch, zero host syncs per segment — the
+        host never sees intermediate state (the reference's per-epoch
         convergence check, kmeans.py:106-118, costs a device round trip
-        per iteration; on a remote/tunneled TPU that round trip dwarfs the
-        step kernel itself).  The |x|² row norms are omitted from the
-        assignment entirely: they are constant across the k candidates, so
-        ``argmin_k(|x|² + |c|² − 2x·c) == argmin_k(|c|² − 2x·c)`` exactly.
-        Dropping them removes a full HBM pass over ``arr`` and lets XLA
-        fuse the whole step — distance matmul, argmin, one-hot masked-sum
-        matmul — into one row-blocked sweep: 141.7 → 65.1 µs/iter on TPU
-        v5e (~2.2x), right at the single-pass bandwidth roofline."""
+        per iteration).  The |x|² row norms are omitted from the
+        assignment entirely: they are constant across the k candidates,
+        so ``argmin_k(|x|² + |c|² − 2x·c) == argmin_k(|c|² − 2x·c)``
+        exactly.  Dropping them removes a full HBM pass over ``arr`` and
+        lets XLA fuse the whole step — distance matmul, argmin, one-hot
+        masked-sum matmul — into one row-blocked sweep: 141.7 →
+        65.1 µs/iter on TPU v5e (~2.2x), right at the single-pass
+        bandwidth roofline."""
 
         def step(c):
             c2 = jnp.sum(c * c, axis=1)[None, :]  # (1, k)
@@ -87,7 +103,7 @@ class KMeans(_KCluster):
 
         def cond(state):
             it, _, shift = state
-            return jnp.logical_and(it < max_iter, shift > tol)
+            return jnp.logical_and(it < stop, shift > tol)
 
         def body(state):
             it, c, _ = state
@@ -95,55 +111,114 @@ class KMeans(_KCluster):
             shift = jnp.sum((nc - c) ** 2)
             return it + 1, nc, shift
 
-        init = (jnp.int32(0), centers, jnp.float32(jnp.inf))
-        n_iter, centers, _ = jax.lax.while_loop(cond, body, init)
-        labels, _ = step(centers)
-        inertia = jnp.sum((arr - centers[labels]) ** 2)
-        return centers, labels, n_iter, inertia
+        return jax.lax.while_loop(cond, body, carry)
 
-    def fit(self, x: DNDarray) -> "KMeans":
+    @staticmethod
+    @jax.jit
+    def _finalize(arr, centers):
+        """Final labels + inertia for the converged centers — the tail of
+        the fit, split out of the loop program so segments stay cheap."""
+        c2 = jnp.sum(centers * centers, axis=1)[None, :]
+        labels = jnp.argmin(c2 - 2.0 * jnp.matmul(arr, centers.T), axis=1)
+        inertia = jnp.sum((arr - centers[labels]) ** 2)
+        return labels, inertia
+
+    def fit(self, x: DNDarray, resume: bool = False) -> "KMeans":
         """Lloyd iterations until centroid shift ≤ tol (reference
-        kmeans.py:87-120), as a single on-device loop."""
+        kmeans.py:87-120), as a single on-device loop.
+
+        With ``checkpoint_every=N`` the loop runs in N-iteration segments
+        of the same compiled program, snapshotting the carry between
+        segments; ``resume=True`` restarts from the snapshot (skipping
+        center initialization) and finishes bitwise-identical to an
+        uninterrupted fit.
+        """
         sanitize_in(x)
         if x.ndim != 2:
             raise ValueError(f"input needs to be 2D, but was {x.ndim}D")
-        self._initialize_cluster_centers(x)
         arr = x.larray.astype(jnp.float32)
-        centers = self._cluster_centers.larray.astype(jnp.float32)
-
-        loop = KMeans._fit_loop
         comm = x.comm
-        if x.split == 0 and comm.size > 1 and int(x.shape[0]) % comm.size == 0:
+        n, f = int(x.shape[0]), int(x.shape[1])
+        k = self.n_clusters
+
+        mode = None
+        if x.split == 0 and comm.size > 1 and n % comm.size == 0:
             from ..comm import compressed as _cq
 
-            k, f = int(centers.shape[0]), int(centers.shape[1])
+            # collective-precision policy: the per-iteration (k, f)
+            # centroid-partial combine rides the quantized ring with an
+            # error-feedback accumulator in the loop carry
             mode = _cq.reduce_mode(jnp.float32, k * f * 4)
-            if mode is not None:
-                # collective-precision policy: the per-iteration (k, f)
-                # centroid-partial combine rides the quantized ring with
-                # an error-feedback accumulator in the loop carry
-                def loop(a, c, tol, mi):
-                    return _kmeans_loop_q(a, c, tol, mi, comm=comm, mode=mode)
+        use_q = mode is not None
 
-        centers, labels, n_iter, inertia = loop(
-            arr, centers, jnp.float32(self.tol), jnp.int32(self.max_iter)
-        )
-        self._finalize_fit(x, centers, labels, n_iter)
+        meta = {
+            "n": n, "f": f, "k": k, "tol": float(self.tol),
+            "max_iter": int(self.max_iter),
+        }
+        if use_q:
+            meta.update(mesh=comm.size, mode=mode)
+        ckpt = self._checkpointer("kmeans-q" if use_q else "kmeans", meta)
+
+        if resume:
+            state, _ = ckpt.load()
+            carry = (
+                jnp.int32(state["it"]),
+                jnp.asarray(state["centers"], jnp.float32),
+                jnp.asarray(state["shift"], jnp.float32),
+            )
+            if use_q:
+                carry = carry + (jnp.asarray(state["error"], jnp.float32),)
+        else:
+            self._initialize_cluster_centers(x)
+            centers0 = self._cluster_centers.larray.astype(jnp.float32)
+            carry = (jnp.int32(0), centers0, jnp.float32(jnp.inf))
+            if use_q:
+                carry = carry + (jnp.zeros((comm.size, k * f), jnp.float32),)
+
+        tol = jnp.float32(self.tol)
+        while True:
+            it0 = int(carry[0])
+            stop = ckpt.stop(it0, self.max_iter)
+            if use_q:
+                carry = _kmeans_segment_q(
+                    arr, tol, jnp.int32(stop), carry, comm=comm, mode=mode
+                )
+            else:
+                carry = KMeans._fit_segment(arr, tol, jnp.int32(stop), carry)
+            it = int(carry[0])
+            if it >= self.max_iter or it < stop:
+                # out of iterations, or converged before the boundary
+                break
+            snap = {"it": carry[0], "centers": carry[1], "shift": carry[2]}
+            if use_q:
+                snap["error"] = carry[3]
+            ckpt.tick(it, snap)
+
+        centers = carry[1]
+        if use_q:
+            labels, inertia = _kmeans_finalize_q(arr, centers, comm=comm)
+        else:
+            labels, inertia = KMeans._finalize(arr, centers)
+        self._finalize_fit(x, centers, labels, carry[0])
         # device scalar; inertia_ property syncs lazily on access
         self._inertia = inertia
         return self
 
 
-def _kmeans_loop_q(arr, centers, tol, max_iter, *, comm, mode):
+def _kmeans_segment_q(arr, tol, stop, carry, *, comm, mode):
     """Lloyd's algorithm with the centroid-partial combine on the
-    compressed ring: ONE compiled ``shard_map`` program over the row
-    shards.  Each step's ``(k, f)`` masked sums ride the quantized ring
-    while the ``(k,)`` counts stay exact (they divide the sums); the
-    error-feedback residual is part of the ``while_loop`` carry, so
-    quantization noise on the partials does not bias the centroid
-    trajectory.  Labels come back row-sharded, centers / n_iter / inertia
-    replicated (the ring's gather stage forwards identical bytes to every
-    device, and the scalar inertia combines with an exact ``psum``)."""
+    compressed ring: each segment is ONE compiled ``shard_map`` program
+    over the row shards.  Each step's ``(k, f)`` masked sums ride the
+    quantized ring while the ``(k,)`` counts stay exact (they divide the
+    sums); the error-feedback residual is part of the ``while_loop``
+    carry, so quantization noise on the partials does not bias the
+    centroid trajectory.
+
+    The carry is ``(it, centers, shift, error)`` with ``error`` in its
+    host-visible stacked form ``(p, k*f)`` — one EF residual row per mesh
+    position, sharded in and out over the mesh axis — precisely so the
+    checkpointing driver can snapshot it between segments and a resumed
+    fit replays the identical quantized trajectory."""
     from jax.sharding import PartitionSpec
 
     from ..comm.compressed import ring_allreduce_q_ef
@@ -151,19 +226,16 @@ def _kmeans_loop_q(arr, centers, tol, max_iter, *, comm, mode):
     from ..core._jax_compat import shard_map
 
     n, f = int(arr.shape[0]), int(arr.shape[1])
-    k = int(centers.shape[0])
+    k = int(carry[1].shape[0])
     p = comm.size
     mesh, name = comm._mesh, comm.axis_name
 
     def make():
-        def kernel(a, c0, tol_, mi_):
-            def assign(c):
-                c2 = jnp.sum(c * c, axis=1)[None, :]
-                return jnp.argmin(c2 - 2.0 * jnp.matmul(a, c.T), axis=1)
-
+        def kernel(a, tol_, stop_, it0, c0, shift0, e0):
             def body(state):
                 it, c, _, e = state
-                labels = assign(c)
+                c2 = jnp.sum(c * c, axis=1)[None, :]
+                labels = jnp.argmin(c2 - 2.0 * jnp.matmul(a, c.T), axis=1)
                 sel = jax.nn.one_hot(labels, k, dtype=a.dtype)
                 sums = jnp.matmul(sel.T, a)  # (k, f) local partial
                 # counts stay EXACT (they divide the centroid sums); only
@@ -179,31 +251,61 @@ def _kmeans_loop_q(arr, centers, tol, max_iter, *, comm, mode):
 
             def cond(state):
                 it, _, shift, _ = state
-                return jnp.logical_and(it < mi_, shift > tol_)
+                return jnp.logical_and(it < stop_, shift > tol_)
 
-            init = (
-                jnp.int32(0),
-                c0,
-                jnp.float32(jnp.inf),
-                jnp.zeros((k * f,), jnp.float32),
-            )
-            n_iter, c, _, _ = jax.lax.while_loop(cond, body, init)
-            labels = assign(c)
-            inertia = jax.lax.psum(jnp.sum((a - c[labels]) ** 2), name)
-            return c, labels, n_iter, inertia
+            init = (it0, c0, shift0, jnp.squeeze(e0, axis=0))
+            it, c, shift, e = jax.lax.while_loop(cond, body, init)
+            return it, c, shift, e[None]
 
         rep = PartitionSpec()
 
-        def _f(a, c0, tol_, mi_):
+        def _f(a, tol_, stop_, it0, c0, shift0, e0):
             return shard_map(
                 kernel,
                 mesh=mesh,
-                in_specs=(comm.spec(2, 0), rep, rep, rep),
-                out_specs=(rep, PartitionSpec(name), rep, rep),
+                in_specs=(comm.spec(2, 0), rep, rep, rep, rep, rep, comm.spec(2, 0)),
+                out_specs=(rep, rep, rep, PartitionSpec(name)),
                 check_vma=False,
-            )(a, c0, tol_, mi_)
+            )(a, tol_, stop_, it0, c0, shift0, e0)
 
         return _f
 
-    fn = jitted(("kmeans.loop_q", comm, mode, n, f, k), make)
-    return fn(arr, centers, tol, max_iter)
+    fn = jitted(("kmeans.seg_q", comm, mode, n, f, k), make)
+    it0, c0, shift0, e0 = carry
+    return fn(arr, tol, stop, it0, c0, shift0, e0)
+
+
+def _kmeans_finalize_q(arr, centers, *, comm):
+    """Row-sharded labels + exact-psum inertia for the converged centers
+    (the tail of the quantized fit, split out of the segment program)."""
+    from jax.sharding import PartitionSpec
+
+    from ..core._compile import jitted
+    from ..core._jax_compat import shard_map
+
+    n, f = int(arr.shape[0]), int(arr.shape[1])
+    k = int(centers.shape[0])
+    mesh, name = comm._mesh, comm.axis_name
+
+    def make():
+        def kernel(a, c):
+            c2 = jnp.sum(c * c, axis=1)[None, :]
+            labels = jnp.argmin(c2 - 2.0 * jnp.matmul(a, c.T), axis=1)
+            inertia = jax.lax.psum(jnp.sum((a - c[labels]) ** 2), name)
+            return labels, inertia
+
+        rep = PartitionSpec()
+
+        def _f(a, c):
+            return shard_map(
+                kernel,
+                mesh=mesh,
+                in_specs=(comm.spec(2, 0), rep),
+                out_specs=(PartitionSpec(name), rep),
+                check_vma=False,
+            )(a, c)
+
+        return _f
+
+    fn = jitted(("kmeans.fin_q", comm, n, f, k), make)
+    return fn(arr, centers)

@@ -48,6 +48,14 @@ documented worst-case bound on the reduced value is
 ``p * max_k(absmax_k) / 254`` per element (k ranging over the blocks that
 position contributed to) — in practice far smaller, and zero for all-zero
 blocks (exact zeros survive quantization exactly).
+
+Non-finite payloads: a block containing NaN/±Inf has a non-finite absmax;
+that absmax itself is transmitted as the block scale (with q == 1), so the
+decoded block is uniformly that non-finite value — deterministic
+propagation instead of an implementation-defined int8 pattern.  Likewise a
+reduce-scatter partial sum that overflows f32 propagates as ±Inf.  The
+numerical health guards (:mod:`heat_tpu.resilience.guards`) detect both at
+the host boundary and can degrade the affected call to the exact f32 path.
 """
 
 from __future__ import annotations
@@ -220,8 +228,19 @@ def _interpret() -> bool:
 def _q_kernel(x_ref, q_ref, s_ref):
     x = x_ref[:]
     absmax = jnp.max(jnp.abs(x), axis=1, keepdims=True)
-    scale = jnp.where(absmax > 0.0, absmax / 127.0, jnp.float32(1.0))
-    q_ref[:] = jnp.round(x / scale).astype(jnp.int8)
+    finite = jnp.isfinite(absmax)
+    # Non-finite payloads must quantize DETERMINISTICALLY: casting a NaN
+    # (round(NaN/scale)) to int8 is implementation-defined, so a block
+    # whose absmax is NaN/Inf instead emits q == 1 with the non-finite
+    # absmax itself as the scale — dequantize yields the whole block as
+    # that non-finite value (propagation, not silent garbage).  Finite
+    # blocks take the exact pre-existing formula, bit for bit.
+    scale = jnp.where(
+        jnp.logical_and(finite, absmax > 0.0),
+        absmax / 127.0,
+        jnp.where(finite, jnp.float32(1.0), absmax),
+    )
+    q_ref[:] = jnp.where(finite, jnp.round(x / scale), jnp.float32(1.0)).astype(jnp.int8)
     s_ref[:] = scale
 
 
@@ -258,9 +277,16 @@ def quantize_blocks(x, block: int = BLOCK):
             interpret=_interpret(),
         )(x2)
         return q, s
+    # identical formulation to _q_kernel, including the deterministic
+    # non-finite propagation (Pallas/jnp bit-parity is load-bearing)
     absmax = jnp.max(jnp.abs(x2), axis=1, keepdims=True)
-    scale = jnp.where(absmax > 0.0, absmax / 127.0, jnp.float32(1.0))
-    return jnp.round(x2 / scale).astype(jnp.int8), scale
+    finite = jnp.isfinite(absmax)
+    scale = jnp.where(
+        jnp.logical_and(finite, absmax > 0.0),
+        absmax / 127.0,
+        jnp.where(finite, jnp.float32(1.0), absmax),
+    )
+    return jnp.where(finite, jnp.round(x2 / scale), jnp.float32(1.0)).astype(jnp.int8), scale
 
 
 def dequantize_blocks(q, scales):
@@ -402,6 +428,16 @@ def ring_allgather_q(value, axis_name, *, size: int, mode: str, block: int = BLO
 # --------------------------------------------------------------------- #
 # host-level collectives (XlaCommunication calling conventions)         #
 # --------------------------------------------------------------------- #
+def _resilience():
+    """The fault-injection and health-guard seams.  Imported lazily: the
+    resilience package sits ABOVE the comm layer in the import graph, and
+    with no plans armed and guards off the seams cost two truthiness
+    checks per call."""
+    from ..resilience import faults, guards
+
+    return faults, guards
+
+
 def allreduce_q(
     array,
     op: str = "sum",
@@ -452,7 +488,11 @@ def allreduce_q(
             raise ValueError(f"error feedback requires op='sum', got {op!r}")
         return comm.allreduce(array, op)
     if mode is None and error is None:
-        return comm.allreduce(array, op)
+        # pin the ambient policy: comm.allreduce re-consults it, and an
+        # explicit precision="f32" here (the guard's degrade path) must
+        # stay exact even under a compressed ambient policy
+        with collective_precision("f32"):
+            return comm.allreduce(array, op)
     p = comm.size
     if int(array.shape[0]) != p:
         raise ValueError(
@@ -511,7 +551,32 @@ def allreduce_q(
         return _f
 
     fn = jitted(("commq.allreduce", comm, wire, blk, shape, dt, edt), make)
-    return fn(array, error) if has_err else fn(array)
+    faults, guards = _resilience()
+    # the seams only exist at the eager host boundary: under a trace
+    # (ht.fuse / user jit) injection would bake faults into the compiled
+    # program and the health check cannot concretize — there the fused
+    # program's own health output covers the call
+    eager = not isinstance(array, jax.core.Tracer)
+    payload = faults.comm_input("allreduce_q", array) if eager and faults.any_active() else array
+    out = fn(payload, error) if has_err else fn(payload)
+    if eager and faults.any_active():
+        if has_err:
+            out = (faults.comm_output("allreduce_q", out[0]), out[1])
+        else:
+            out = faults.comm_output("allreduce_q", out)
+    if eager and wire is not None and guards.active():
+        values = out if has_err else (out,)
+        if not guards.is_healthy(*values):
+            def _exact():
+                # bit-identical to what set_collective_precision("f32")
+                # would have produced for THIS call; uses the original
+                # (pre-injection) operands
+                return allreduce_q(
+                    array, op, comm, precision="f32", error=error, block=block
+                )
+
+            return guards.handle("allreduce_q", out, _exact)
+    return out
 
 
 def _payload_nbytes(array, stacked: bool) -> int:
@@ -546,10 +611,15 @@ def allgather_q(
         getattr(array, "dtype", jnp.float32), _payload_nbytes(array, stacked=False), precision
     )
     if mode is None or p == 1 or ndim == 0:
-        return comm.allgather(array, axis=axis)
+        # pin the policy for the same reason as allreduce_q: an explicit
+        # precision="f32" must not bounce back through comm.allgather's
+        # policy seam onto the quantized ring
+        with collective_precision("f32"):
+            return comm.allgather(array, axis=axis)
     axis = int(axis) % ndim
     if int(array.shape[axis]) % p != 0:
-        return comm.allgather(array, axis=axis)
+        with collective_precision("f32"):
+            return comm.allgather(array, axis=axis)
     mesh, name = comm._mesh, comm.axis_name
     blk = int(block or BLOCK)
     shape = tuple(int(s) for s in array.shape)
@@ -574,7 +644,20 @@ def allgather_q(
         return _f
 
     fn = jitted(("commq.allgather", comm, mode, blk, axis, shape, dt), make)
-    return fn(array)
+    faults, guards = _resilience()
+    eager = not isinstance(array, jax.core.Tracer)  # see allreduce_q
+    payload = faults.comm_input("allgather_q", array) if eager and faults.any_active() else array
+    out = fn(payload)
+    if eager and faults.any_active():
+        out = faults.comm_output("allgather_q", out)
+    if eager and guards.active() and not guards.is_healthy(out):
+        # the exact all-gather is precisely the "f32" policy's path
+        return guards.handle(
+            "allgather_q",
+            out,
+            lambda: allgather_q(array, axis=axis, comm=comm, precision="f32"),
+        )
+    return out
 
 
 # --------------------------------------------------------------------- #

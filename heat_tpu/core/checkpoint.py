@@ -44,7 +44,11 @@ from .dndarray import DNDarray
 __all__ = ["save_estimator", "load_estimator"]
 
 _MANIFEST_ATTR = "heat_tpu_estimator"
+#: manifest schema version this build WRITES (as ``format_version``);
+#: v1 manifests (which carried the version under the legacy ``format``
+#: key) remain readable — the entry kinds are a superset-compatible set
 _FORMAT_VERSION = 2
+_READABLE_VERSIONS = (1, 2)
 #: inline-manifest budget for host numpy arrays; anything bigger spills
 #: to an HDF5 dataset instead of the JSON attribute
 _NPARRAY_INLINE_MAX = 16384
@@ -215,7 +219,7 @@ def save_estimator(est: BaseEstimator, path: str) -> None:
 
     ctx = _SaveContext()
     manifest = {
-        "format": _FORMAT_VERSION,
+        "format_version": _FORMAT_VERSION,
         "root": _manifest(est, "", ctx),
     }
     _io._save_hdf5_many(
@@ -256,13 +260,25 @@ def _decode(entry: Dict[str, Any], path: str, cache: Dict[str, Any]):
         key = entry["key"]
         if key not in cache:
             dtype = getattr(types, entry["dtype"])
-            cache[key] = _io.load_hdf5(path, key, dtype=dtype, split=entry["split"])
+            try:
+                cache[key] = _io.load_hdf5(path, key, dtype=dtype, split=entry["split"])
+            except KeyError as e:
+                raise ValueError(
+                    f"{path}: checkpoint dataset {key!r} is missing "
+                    "(truncated or corrupted save)"
+                ) from e
         return cache[key]
     if kind == "nparray_dataset":
         key = entry["key"]
         if key not in cache:
             dtype = getattr(types, entry["heat_dtype"])
-            loaded = _io.load_hdf5(path, key, dtype=dtype, split=None)
+            try:
+                loaded = _io.load_hdf5(path, key, dtype=dtype, split=None)
+            except KeyError as e:
+                raise ValueError(
+                    f"{path}: checkpoint dataset {key!r} is missing "
+                    "(truncated or corrupted save)"
+                ) from e
             cache[key] = loaded.numpy().astype(np.dtype(entry["dtype"]))
         return cache[key]
     if kind == "estimator":
@@ -294,11 +310,24 @@ def load_estimator(path: str) -> BaseEstimator:
         raise RuntimeError("h5py is required for estimator checkpointing")
     import h5py
 
-    with h5py.File(path, "r") as f:
+    _io._faults().io_open(path)
+    try:
+        f = h5py.File(path, "r")
+    except OSError as e:
+        raise ValueError(
+            f"{path} is not a readable estimator checkpoint (missing, "
+            f"truncated, or not HDF5): {e}"
+        ) from e
+    with f:
         raw = f.attrs.get(_MANIFEST_ATTR)
         if raw is None:
             raise ValueError(f"{path} is not an estimator checkpoint")
         manifest = json.loads(raw)
-    if manifest.get("format") != _FORMAT_VERSION:
-        raise ValueError(f"unsupported checkpoint format {manifest.get('format')!r}")
+    # v2 writes format_version; v1 recorded it under the legacy "format"
+    version = manifest.get("format_version", manifest.get("format"))
+    if version not in _READABLE_VERSIONS:
+        raise ValueError(
+            f"{path}: unsupported checkpoint format_version {version!r} "
+            f"(this build reads versions {list(_READABLE_VERSIONS)})"
+        )
     return _instantiate(manifest["root"], path, {})

@@ -76,15 +76,31 @@ def _is_dnd(x: Any) -> bool:
     return isinstance(x, DNDarray)
 
 
-class _Program:
-    """A traced-and-compiled pipeline plus its output re-wrap recipe."""
+def _guards():
+    """Lazy import of the health-guard seam (the resilience package sits
+    above core in the import graph)."""
+    from ..resilience import guards
 
-    __slots__ = ("jfn", "out_treedef", "out_meta")
+    return guards
+
+
+class _Program:
+    """A traced-and-compiled pipeline plus its output re-wrap recipe.
+
+    ``guarded`` marks programs traced under an active health-guard
+    policy: they carry one extra output, the on-device health flag over
+    every inexact result buffer.  The guard policy is part of the fuse
+    cache key (:func:`heat_tpu.core._compile.context_token`), so a
+    guarded and an unguarded trace of the same pipeline never collide.
+    """
+
+    __slots__ = ("jfn", "out_treedef", "out_meta", "guarded")
 
     def __init__(self, jfn):
         self.jfn = jfn
         self.out_treedef = None
         self.out_meta = None
+        self.guarded = False
 
 
 def _build(fn: Callable, slots: Tuple, treedef, donate: bool) -> _Program:
@@ -133,6 +149,13 @@ def _build(fn: Callable, slots: Tuple, treedef, donate: bool) -> _Program:
                     meta.append(("const", leaf))
         program.out_treedef = out_treedef
         program.out_meta = tuple(meta)
+        if _guards().active():
+            # one extra scalar output: the fused-program health flag —
+            # all(isfinite) and below the overflow limit, over every
+            # inexact result buffer, computed on device in the same
+            # dispatch
+            raws.append(_guards().health_flag(raws))
+            program.guarded = True
         return tuple(raws)
 
     program.jfn = jax.jit(_runner, donate_argnums=(0,) if donate else ())
@@ -198,6 +221,11 @@ class _FusedFunction:
         raws = program.jfn(tuple(operands))
         record_dispatch()
 
+        flag = None
+        if program.guarded:
+            flag = raws[-1]
+            raws = raws[:-1]
+
         it = iter(raws)
         out_leaves = []
         for meta in program.out_meta:
@@ -208,7 +236,26 @@ class _FusedFunction:
                 out_leaves.append(next(it))
             else:
                 out_leaves.append(meta[1])
-        return jax.tree_util.tree_unflatten(program.out_treedef, out_leaves)
+        result = jax.tree_util.tree_unflatten(program.out_treedef, out_leaves)
+
+        if flag is not None and not bool(flag):
+            if self._donate:
+                # the unhealthy program consumed its input buffers —
+                # there is nothing left to re-run the exact path on
+                degrade_fn = None
+            else:
+                def degrade_fn():
+                    from ..comm.compressed import collective_precision
+
+                    # exact-collective re-trace: the policy change flows
+                    # into the cache key, so this compiles (and caches)
+                    # its own program instead of mutating the fast one
+                    with collective_precision("f32"):
+                        return self(*args, **kwargs)
+
+            site = f"fuse:{getattr(self._fn, '__name__', '<pipeline>')}"
+            return _guards().handle(site, result, degrade_fn)
+        return result
 
     @staticmethod
     def _cacheable_statics(leaves) -> bool:

@@ -17,6 +17,7 @@ the reference's try-import gating (io.py:26-41).
 from __future__ import annotations
 
 import os
+import shutil
 from typing import List, Optional, Tuple, Union
 
 import numpy as np
@@ -79,6 +80,47 @@ def supports_netcdf() -> bool:
     return nc is not None or _scipy_nc is not None
 
 
+def _faults():
+    """Lazy import of the fault-injection seams (the resilience package
+    imports this module, so the dependency must stay one-way at import
+    time)."""
+    from ..resilience import faults
+
+    return faults
+
+
+# --------------------------------------------------------------------- #
+# atomic writes                                                          #
+# --------------------------------------------------------------------- #
+# Every writer path stages into a same-directory temp file and commits
+# with os.replace only after a successful close: a crash (or injected
+# preemption) anywhere mid-save leaves the previous file byte-identical.
+# Append modes first copy the existing file into the temp so the commit
+# is still all-or-nothing.
+def _atomic_begin(path: str, mode: str = "w") -> str:
+    """Start an atomic write of ``path``: returns the temp path to write
+    to.  Same directory as the target so :func:`os.replace` stays a
+    rename, never a copy."""
+    tmp = f"{path}.tmp-{os.getpid()}"
+    if mode not in ("w", "w-") and os.path.exists(path):
+        shutil.copyfile(path, tmp)
+    return tmp
+
+
+def _atomic_commit(tmp: str, path: str) -> None:
+    """Publish a finished atomic write (rename over the target)."""
+    os.replace(tmp, path)
+
+
+def _atomic_abort(tmp: Optional[str]) -> None:
+    """Discard a failed atomic write; the target was never touched."""
+    if tmp is not None:
+        try:
+            os.remove(tmp)
+        except OSError:
+            pass
+
+
 def _sharded_from_reader(shape, np_dtype, split, device, comm, read_slices):
     """Build a sharded global jax.Array by reading only each shard's slab
     (the parallel-read core; reference io.py:104-111 per-rank slab read)."""
@@ -117,6 +159,7 @@ def load_hdf5(
         raise TypeError(f"dataset must be str, not {type(dataset)}")
     dtype = types.canonical_heat_type(dtype)
 
+    _faults().io_open(path)
     with h5py.File(path, "r") as handle:
         data = handle[dataset]
         gshape = tuple(data.shape)
@@ -149,6 +192,7 @@ def _emit_slabs(data: DNDarray, write):
         # replicated arrays are addressable everywhere — direct fetch
         if write is not None:
             try:
+                _faults().preempt_point("save-slab")
                 write(tuple(slice(0, s) for s in data.shape), np.asarray(data.larray))
             except Exception as e:  # noqa: BLE001 — deferred to the caller
                 err = e
@@ -164,6 +208,11 @@ def _emit_slabs(data: DNDarray, write):
             block = multihost_utils.process_allgather(block, tiled=True)
         if write is not None and err is None:
             try:
+                # the simulated-preemption seam sits INSIDE the deferred-
+                # error block: a writer killed between two slab writes
+                # still reaches the barrier, the staged temp file is
+                # discarded, and the previous file survives untouched
+                _faults().preempt_point("save-slab")
                 write(slices, np.asarray(block))
             except Exception as e:  # noqa: BLE001 — deferred to the caller
                 err = e
@@ -198,25 +247,38 @@ def _finish_save(err: Optional[BaseException]) -> None:
         )
 
 
-def _writer_save(data: DNDarray, prepare) -> None:
-    """Writer-side half of a cross-process save.  ``prepare`` returns
-    ``(write, close)`` for the target file; any error — open, dataset
-    creation, or a slab write — is DEFERRED until the slab fetches and the
-    barrier have run, because those are collectives the other processes
-    are already executing (an early raise on the writer would hang the
-    cluster in the next allgather)."""
-    err, write, close = None, None, None
+def _writer_save(data: DNDarray, prepare, path: str, mode: str = "w") -> None:
+    """Writer-side half of a cross-process save.  ``prepare(target)``
+    returns ``(write, close)`` for the staged temp file ``target``; any
+    error — open, dataset creation, or a slab write — is DEFERRED until
+    the slab fetches and the barrier have run, because those are
+    collectives the other processes are already executing (an early raise
+    on the writer would hang the cluster in the next allgather).  The
+    temp is committed over ``path`` only after a clean close; on any
+    error it is discarded and the previous file survives."""
+    err, write, close, tmp = None, None, None, None
     try:
-        write, close = prepare()
+        _faults().io_open(path)
+        tmp = _atomic_begin(path, mode)
+        write, close = prepare(tmp)
     except Exception as e:  # noqa: BLE001 — deferred past the collectives
         err = e
     werr = _emit_slabs(data, write)
+    err = err or werr
     if close is not None:
         try:
             close()
         except Exception as e:  # noqa: BLE001
             err = err or e
-    _finish_save(err or werr)
+    if tmp is not None:
+        if err is None:
+            try:
+                _atomic_commit(tmp, path)
+            except Exception as e:  # noqa: BLE001
+                err = e
+        else:
+            _atomic_abort(tmp)
+    _finish_save(err)
 
 
 def _save_hdf5_many(path: str, datasets, attrs=None, mode: str = "w") -> None:
@@ -229,9 +291,11 @@ def _save_hdf5_many(path: str, datasets, attrs=None, mode: str = "w") -> None:
     :func:`save_hdf5` (via that helper) and estimator checkpointing."""
     datasets = list(datasets)
     if jax.process_index() == 0:
-        err, f = None, None
+        err, f, tmp = None, None, None
         try:
-            f = h5py.File(path, mode)
+            _faults().io_open(path)
+            tmp = _atomic_begin(path, mode)
+            f = h5py.File(tmp, mode)
         except Exception as e:  # noqa: BLE001 — deferred past the collectives
             err = e
         for key, arr in datasets:
@@ -257,6 +321,14 @@ def _save_hdf5_many(path: str, datasets, attrs=None, mode: str = "w") -> None:
                 f.close()
             except Exception as e:  # noqa: BLE001
                 err = err or e
+        if tmp is not None:
+            if err is None:
+                try:
+                    _atomic_commit(tmp, path)
+                except Exception as e:  # noqa: BLE001
+                    err = e
+            else:
+                _atomic_abort(tmp)
         _finish_save(err)
     else:
         for _, arr in datasets:
@@ -274,8 +346,8 @@ def save_hdf5(data: DNDarray, path: str, dataset: str, mode: str = "w", **kwargs
     if not isinstance(path, str):
         raise TypeError(f"path must be str, not {type(path)}")
 
-    def prepare():
-        f = h5py.File(path, mode)
+    def prepare(target):
+        f = h5py.File(target, mode)
         try:
             dset = f.create_dataset(
                 dataset, data.shape, dtype=np.dtype(data.dtype._np_type), **kwargs
@@ -286,7 +358,7 @@ def save_hdf5(data: DNDarray, path: str, dataset: str, mode: str = "w", **kwargs
         return dset.__setitem__, f.close
 
     if jax.process_index() == 0:
-        _writer_save(data, prepare)
+        _writer_save(data, prepare, path, mode)
     else:
         _emit_slabs(data, None)
         _finish_save(None)
@@ -306,6 +378,7 @@ def load_netcdf(
     dtype = types.canonical_heat_type(dtype)
     np_dtype = np.dtype(dtype._np_type)
 
+    _faults().io_open(path)
     if nc is not None:
         with nc.Dataset(path, "r") as handle:
             gshape = tuple(handle.variables[variable].shape)
@@ -356,11 +429,11 @@ def save_netcdf(
                 "install netCDF4"
             )
 
-    def prepare():
+    def prepare(target):
         f = (
-            nc.Dataset(path, mode)
+            nc.Dataset(target, mode)
             if nc is not None
-            else _scipy_nc(path, "w" if mode == "w" else "a")
+            else _scipy_nc(target, "w" if mode == "w" else "a")
         )
         try:
             for name, length in zip(dimension_names, data.shape):
@@ -376,7 +449,7 @@ def save_netcdf(
         return var.__setitem__, f.close
 
     if jax.process_index() == 0:
-        _writer_save(data, prepare)
+        _writer_save(data, prepare, path, mode)
     else:
         _emit_slabs(data, None)
         _finish_save(None)
@@ -446,12 +519,18 @@ def save_csv(
     fmt = f"%.{decimals}f" if decimals >= 0 else "%s"
     err = None
     if jax.process_index() == 0:
+        tmp = None
         try:
+            _faults().io_open(path)
+            tmp = _atomic_begin(path)
+            _faults().preempt_point("save-slab")
             np.savetxt(
-                path, arr, delimiter=sep, header=header_lines or "", fmt=fmt, encoding=encoding
+                tmp, arr, delimiter=sep, header=header_lines or "", fmt=fmt, encoding=encoding
             )
+            _atomic_commit(tmp, path)
         except Exception as e:  # noqa: BLE001 — deferred past the collectives
             err = e
+            _atomic_abort(tmp)
     _finish_save(err)
 
 

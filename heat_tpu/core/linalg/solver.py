@@ -82,29 +82,27 @@ def cg(A: DNDarray, b: DNDarray, x0: DNDarray, out: Optional[DNDarray] = None) -
 
 
 @jax.jit
-def _lanczos_loop(arr, v, R):
-    """The whole Lanczos iteration as ONE device program.
+def _lanczos_segment(arr, R, start, stop, carry):
+    """Lanczos steps ``[start, stop)`` as ONE device program.
 
     The reference (solver.py:74-184) — and this module until the fuse PR —
     decided breakdown-restart on the host with ``float(beta)``, a blocking
     device→host sync per iteration.  Here the decision is a ``jnp.where``
     select between the normal step and a restart candidate drawn from the
     pre-generated random matrix ``R`` (one column per iteration), so the
-    m-step loop runs as a single ``fori_loop`` with zero host syncs.
+    steps run as a single ``fori_loop`` with zero host syncs.
+
+    Re-enterable: the carry ``(V, T, w, v_prev)`` comes in explicitly and
+    the ``fori_loop`` bounds are dynamic — a plain call runs one segment
+    with ``(1, m)``; a checkpointed call replays THIS program segment by
+    segment (snapshotting the carry plus the restart matrix ``R`` between
+    segments), which is what makes resume bitwise-exact.
 
     The full re-orthogonalization projects against ALL m columns of V:
     columns ≥ i are still zero, so their coefficients vanish and the
     projection equals the reference's ``V[:, :i]`` slice — this is what
     lets the loop body stay shape-static inside ``fori_loop``.
     """
-    n, m = R.shape
-    V = jnp.zeros((n, m), dtype=arr.dtype)
-    T = jnp.zeros((m, m), dtype=arr.dtype)
-    V = V.at[:, 0].set(v)
-
-    w0 = arr @ v
-    alpha0 = jnp.dot(w0, v)
-    T = T.at[0, 0].set(alpha0)
 
     def body(i, state):
         V, T, w, v_prev = state
@@ -132,8 +130,7 @@ def _lanczos_loop(arr, v, R):
         T = T.at[i, i - 1].set(beta)
         return V, T, w_next, w
 
-    V, T, _, _ = jax.lax.fori_loop(1, m, body, (V, T, w0 - alpha0 * v, v))
-    return V, T
+    return jax.lax.fori_loop(start, stop, body, carry)
 
 
 def lanczos(
@@ -142,6 +139,9 @@ def lanczos(
     v0: Optional[DNDarray] = None,
     V_out: Optional[DNDarray] = None,
     T_out: Optional[DNDarray] = None,
+    checkpoint_every: int = 0,
+    checkpoint_path: Optional[str] = None,
+    resume: bool = False,
 ) -> Tuple[DNDarray, DNDarray]:
     """Lanczos tridiagonalization with full re-orthogonalization
     (reference solver.py:74-184).  Returns (V, T) with ``T = V.T A V``
@@ -152,6 +152,12 @@ def lanczos(
     compile to all-reduces automatically, and the whole m-step iteration —
     including the breakdown-restart decision, formerly a ``float(beta)``
     host sync per step — runs as one compiled device loop.
+
+    With ``checkpoint_every=N`` the iteration runs in N-step segments of
+    the same compiled program, snapshotting the carry (and the
+    breakdown-restart matrix, so restart draws replay too) to
+    ``checkpoint_path`` between segments; ``resume=True`` restarts from
+    the snapshot and finishes bitwise-identical to an uninterrupted run.
     """
     sanitize_in(A)
     if A.ndim != 2 or A.shape[0] != A.shape[1]:
@@ -163,18 +169,52 @@ def lanczos(
     arr = A.larray.astype(jnp.float32 if types.heat_type_is_exact(A.dtype) else A.larray.dtype)
 
     from .. import random
+    from ...resilience.resume import LoopCheckpointer
 
-    if v0 is None:
-        v = random.rand(n, dtype=types.float32, device=A.device).larray
-        v = v / jnp.linalg.norm(v)
+    ckpt = LoopCheckpointer(
+        checkpoint_path, checkpoint_every, "lanczos", {"n": int(n), "m": int(m)}
+    )
+    if resume:
+        state, _ = ckpt.load()
+        R = jnp.asarray(state["R"], jnp.float32)
+        carry = (
+            jnp.asarray(state["V"], arr.dtype),
+            jnp.asarray(state["T"], arr.dtype),
+            jnp.asarray(state["w"], arr.dtype),
+            jnp.asarray(state["v_prev"], arr.dtype),
+        )
+        it = int(state["i"])
     else:
-        sanitize_in(v0)
-        v = v0.larray / jnp.linalg.norm(v0.larray)
-    # breakdown-restart candidates, one per iteration (drawn per fit, used
-    # on device only when the matching step actually breaks down)
-    R = random.rand(n, m, dtype=types.float32, device=A.device).larray
+        if v0 is None:
+            v = random.rand(n, dtype=types.float32, device=A.device).larray
+            v = v / jnp.linalg.norm(v)
+        else:
+            sanitize_in(v0)
+            v = v0.larray / jnp.linalg.norm(v0.larray)
+        v = v.astype(arr.dtype)
+        # breakdown-restart candidates, one per iteration (drawn per fit,
+        # used on device only when the matching step actually breaks down)
+        R = random.rand(n, m, dtype=types.float32, device=A.device).larray
 
-    V, T = _lanczos_loop(arr, v.astype(arr.dtype), R)
+        V = jnp.zeros((n, m), dtype=arr.dtype).at[:, 0].set(v)
+        w0 = arr @ v
+        alpha0 = jnp.dot(w0, v)
+        T = jnp.zeros((m, m), dtype=arr.dtype).at[0, 0].set(alpha0)
+        carry = (V, T, w0 - alpha0 * v, v)
+        it = 1
+
+    while it < m:
+        stop = ckpt.stop(it, m)
+        carry = _lanczos_segment(arr, R, jnp.int32(it), jnp.int32(stop), carry)
+        it = stop
+        if it >= m:
+            break
+        ckpt.tick(
+            it,
+            {"i": jnp.int32(it), "V": carry[0], "T": carry[1],
+             "w": carry[2], "v_prev": carry[3], "R": R},
+        )
+    V, T = carry[0], carry[1]
 
     comm, device = A.comm, A.device
     V_nd = DNDarray(comm.apply_sharding(V, 0 if A.split is not None else None), (n, m),

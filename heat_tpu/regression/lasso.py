@@ -37,10 +37,26 @@ class Lasso(RegressionMixin, BaseEstimator):
         error-feedback accumulator when the collective-precision policy
         (:func:`heat_tpu.comm.set_collective_precision`) asks for it, so
         quantization error does not bias convergence.
+    checkpoint_every : int — snapshot the fit-loop carry every N
+        iterations (0, the default, disables checkpointing).  The loop
+        runs in segments of N iterations of the SAME compiled program, so
+        a fit killed at a segment boundary and restarted with
+        ``fit(..., resume=True)`` replays the identical float trajectory
+        — bitwise-equal to never having been interrupted.  For the
+        quantized-ring gd solver the snapshot includes the error-feedback
+        residual.
+    checkpoint_path : str or None — HDF5 snapshot target (atomic writes;
+        required when ``checkpoint_every > 0``).
     """
 
     def __init__(
-        self, lam: float = 0.1, max_iter: int = 100, tol: float = 1e-6, solver: str = "cd"
+        self,
+        lam: float = 0.1,
+        max_iter: int = 100,
+        tol: float = 1e-6,
+        solver: str = "cd",
+        checkpoint_every: int = 0,
+        checkpoint_path: Optional[str] = None,
     ):
         if solver not in ("cd", "gd"):
             raise ValueError(f"solver must be 'cd' or 'gd', got {solver!r}")
@@ -48,6 +64,8 @@ class Lasso(RegressionMixin, BaseEstimator):
         self.max_iter = max_iter
         self.tol = tol
         self.solver = solver
+        self.checkpoint_every = checkpoint_every
+        self.checkpoint_path = checkpoint_path
         self.__theta = None
         self.n_iter = None
 
@@ -85,13 +103,26 @@ class Lasso(RegressionMixin, BaseEstimator):
         diff = gt.larray.reshape(-1) - yest.larray.reshape(-1)
         return float(jnp.sqrt(jnp.mean(diff * diff)))
 
-    def fit(self, x: DNDarray, y: DNDarray) -> "Lasso":
+    def _checkpointer(self, algo: str, meta: dict):
+        """The segmentation driver for this fit configuration."""
+        from ..resilience.resume import LoopCheckpointer
+
+        return LoopCheckpointer(
+            self.checkpoint_path, self.checkpoint_every, algo, meta
+        )
+
+    def fit(self, x: DNDarray, y: DNDarray, resume: bool = False) -> "Lasso":
         """Cyclic coordinate descent (reference lasso.py:104-156).
 
         The per-coordinate update loop is expressed as ``lax.fori_loop``
         over columns so one XLA computation performs a full sweep on the
         sharded data (the reference launches a distributed matvec + mean
         per coordinate).
+
+        With ``checkpoint_every=N`` the sweep loop runs in N-iteration
+        segments of the same compiled program, snapshotting the carry
+        between segments; ``resume=True`` restarts from the snapshot and
+        finishes bitwise-identical to an uninterrupted fit.
         """
         sanitize_in(x)
         sanitize_in(y)
@@ -107,35 +138,66 @@ class Lasso(RegressionMixin, BaseEstimator):
         yv = y.larray.reshape(-1).astype(jnp.float32)
 
         if self.solver == "gd":
-            theta, n_iter = self._fit_gd(x, arr, yv)
+            theta, n_iter = self._fit_gd(x, arr, yv, resume)
         else:
-            theta, n_iter = Lasso._fit_loop(
-                arr,
-                yv,
-                jnp.float32(self.__lam),
-                jnp.float32(self.tol),
-                jnp.int32(self.max_iter),
-            )
+            theta, n_iter = self._fit_cd(arr, yv, resume)
         self.n_iter = int(n_iter)
         self.__theta = factories.array(
             np.asarray(theta).reshape(-1, 1), dtype=types.float32, device=x.device, comm=x.comm
         )
         return self
 
+    def _fit_cd(self, arr, yv, resume: bool):
+        """Segment-driven coordinate descent: the plain fit is one
+        segment with ``stop = max_iter``, a checkpointed fit re-enters
+        the same compiled program every ``checkpoint_every`` sweeps."""
+        m = int(arr.shape[1])
+        ckpt = self._checkpointer(
+            "lasso-cd",
+            {
+                "n": int(arr.shape[0]), "m": m, "lam": float(self.__lam),
+                "tol": float(self.tol), "max_iter": int(self.max_iter),
+            },
+        )
+        if resume:
+            state, _ = ckpt.load()
+            carry = (
+                jnp.int32(state["it"]),
+                jnp.asarray(state["theta"], jnp.float32),
+                jnp.asarray(state["delta"], jnp.float32),
+            )
+        else:
+            carry = (jnp.int32(0), jnp.zeros((m,), jnp.float32), jnp.float32(jnp.inf))
+        lam, tol = jnp.float32(self.__lam), jnp.float32(self.tol)
+        while True:
+            it0 = int(carry[0])
+            stop = ckpt.stop(it0, self.max_iter)
+            carry = Lasso._fit_segment(arr, yv, lam, tol, jnp.int32(stop), carry)
+            it = int(carry[0])
+            if it >= self.max_iter or it < stop:
+                # out of iterations, or converged before the boundary
+                break
+            ckpt.tick(it, {"it": carry[0], "theta": carry[1], "delta": carry[2]})
+        return carry[1], carry[0]
+
     @staticmethod
     @jax.jit
-    def _fit_loop(arr, yv, lam, tol, max_iter):
-        """The entire cyclic coordinate descent as ONE compiled program
-        (reference lasso.py:104-156 runs a distributed matvec + mean per
-        coordinate and a host convergence check per sweep).
+    def _fit_segment(arr, yv, lam, tol, stop, carry):
+        """Cyclic coordinate descent as ONE compiled program (reference
+        lasso.py:104-156 runs a distributed matvec + mean per coordinate
+        and a host convergence check per sweep), re-enterable: the carry
+        ``(it, theta, delta)`` comes in explicitly and sweeps run while
+        ``it < stop`` — the whole fit is one segment with
+        ``stop = max_iter``; checkpointed fits replay THIS program
+        segment by segment, which is what makes resume bitwise-exact.
 
-        Two structural changes, both value-preserving:
+        Two structural changes vs the reference, both value-preserving:
         - the residual vector is maintained incrementally across
           coordinates (when θ_j moves by Δ, resid -= x_j Δ), so a full
           sweep costs O(n·m) instead of the reference's O(n·m²) fresh
           matvec per coordinate;
         - sweeps run under ``lax.while_loop`` with the tol check on
-          device, so the host syncs once per fit, not once per sweep.
+          device, so the host syncs once per segment, not once per sweep.
         """
         m = arr.shape[1]
         z = jnp.maximum(jnp.mean(arr * arr, axis=0), 1e-12)  # loop-invariant
@@ -162,33 +224,87 @@ class Lasso(RegressionMixin, BaseEstimator):
 
         def cond(state):
             it, _, delta = state
-            return jnp.logical_and(it < max_iter, delta > tol)
+            return jnp.logical_and(it < stop, delta > tol)
 
-        init = (jnp.int32(0), jnp.zeros((m,), jnp.float32), jnp.float32(jnp.inf))
-        n_iter, theta, _ = lax.while_loop(cond, body_sweep, init)
-        return theta, n_iter
+        return lax.while_loop(cond, body_sweep, carry)
 
-    def _fit_gd(self, x: DNDarray, arr, yv):
+    def _fit_gd(self, x: DNDarray, arr, yv, resume: bool = False):
         """Proximal-gradient (ISTA) fit: θ ← prox_{sλ}(θ − s∇f(θ)) with
         step ``s = 1/L`` from power iteration.  When the
         collective-precision policy compresses and the rows split
         canonically, the per-shard gradient partials ``A_pᵀ r_p`` combine
         on the block-scaled quantized ring with an error-feedback
         accumulator carried in the loop state — otherwise one exact
-        compiled program."""
+        compiled program.  Both forms run segment-by-segment under
+        ``checkpoint_every`` (the quantized form snapshots the EF
+        residual as part of the carry)."""
         n, m = int(arr.shape[0]), int(arr.shape[1])
         step = jnp.float32(1.0) / Lasso._lipschitz(arr)
         lam = jnp.float32(self.__lam)
         tol = jnp.float32(self.tol)
-        mi = jnp.int32(self.max_iter)
         comm = x.comm
+        meta = {
+            "n": n, "m": m, "lam": float(self.__lam), "tol": float(self.tol),
+            "max_iter": int(self.max_iter),
+        }
         if x.split == 0 and comm.size > 1 and n % comm.size == 0:
             from ..comm import compressed as _cq
 
             mode = _cq.reduce_mode(jnp.float32, m * 4)
             if mode is not None:
-                return _gd_loop_q(arr, yv, lam, tol, mi, step, comm=comm, mode=mode)
-        return Lasso._fit_loop_gd(arr, yv, lam, tol, mi, step)
+                ckpt = self._checkpointer(
+                    "lasso-gd-q", {**meta, "mesh": comm.size, "mode": mode}
+                )
+                if resume:
+                    state, _ = ckpt.load()
+                    carry = (
+                        jnp.int32(state["it"]),
+                        jnp.asarray(state["theta"], jnp.float32),
+                        jnp.asarray(state["delta"], jnp.float32),
+                        jnp.asarray(state["error"], jnp.float32),
+                    )
+                else:
+                    carry = (
+                        jnp.int32(0),
+                        jnp.zeros((m,), jnp.float32),
+                        jnp.float32(jnp.inf),
+                        jnp.zeros((comm.size, m), jnp.float32),
+                    )
+                while True:
+                    it0 = int(carry[0])
+                    stop = ckpt.stop(it0, self.max_iter)
+                    carry = _gd_segment_q(
+                        arr, yv, lam, tol, jnp.int32(stop), step, carry,
+                        comm=comm, mode=mode,
+                    )
+                    it = int(carry[0])
+                    if it >= self.max_iter or it < stop:
+                        break
+                    ckpt.tick(
+                        it,
+                        {"it": carry[0], "theta": carry[1], "delta": carry[2],
+                         "error": carry[3]},
+                    )
+                return carry[1], carry[0]
+        ckpt = self._checkpointer("lasso-gd", meta)
+        if resume:
+            state, _ = ckpt.load()
+            carry = (
+                jnp.int32(state["it"]),
+                jnp.asarray(state["theta"], jnp.float32),
+                jnp.asarray(state["delta"], jnp.float32),
+            )
+        else:
+            carry = (jnp.int32(0), jnp.zeros((m,), jnp.float32), jnp.float32(jnp.inf))
+        while True:
+            it0 = int(carry[0])
+            stop = ckpt.stop(it0, self.max_iter)
+            carry = Lasso._gd_segment(arr, yv, lam, tol, jnp.int32(stop), step, carry)
+            it = int(carry[0])
+            if it >= self.max_iter or it < stop:
+                break
+            ckpt.tick(it, {"it": carry[0], "theta": carry[1], "delta": carry[2]})
+        return carry[1], carry[0]
 
     @staticmethod
     @jax.jit
@@ -206,9 +322,11 @@ class Lasso(RegressionMixin, BaseEstimator):
 
     @staticmethod
     @jax.jit
-    def _fit_loop_gd(arr, yv, lam, tol, max_iter, step):
-        """Exact ISTA: the whole iteration under one ``lax.while_loop``
-        (GSPMD inserts the gradient all-reduce on sharded rows)."""
+    def _gd_segment(arr, yv, lam, tol, stop, step, carry):
+        """Exact ISTA under one ``lax.while_loop`` (GSPMD inserts the
+        gradient all-reduce on sharded rows), re-enterable via the
+        explicit ``(it, theta, delta)`` carry and dynamic ``stop`` — see
+        :meth:`_fit_segment` for the segmentation contract."""
         n = arr.shape[0]
 
         def body(state):
@@ -220,12 +338,9 @@ class Lasso(RegressionMixin, BaseEstimator):
 
         def cond(state):
             it, _, delta = state
-            return jnp.logical_and(it < max_iter, delta > tol)
+            return jnp.logical_and(it < stop, delta > tol)
 
-        m = arr.shape[1]
-        init = (jnp.int32(0), jnp.zeros((m,), jnp.float32), jnp.float32(jnp.inf))
-        n_iter, theta, _ = lax.while_loop(cond, body, init)
-        return theta, n_iter
+        return lax.while_loop(cond, body, carry)
 
     def predict(self, x: DNDarray) -> DNDarray:
         """ŷ = [1, X] θ (reference lasso.py:157-170)."""
@@ -244,15 +359,22 @@ class Lasso(RegressionMixin, BaseEstimator):
         )
 
 
-def _gd_loop_q(arr, yv, lam, tol, max_iter, step, *, comm, mode):
+def _gd_segment_q(arr, yv, lam, tol, stop, step, carry, *, comm, mode):
     """ISTA with the cross-shard gradient combine on the compressed ring.
 
-    The whole fit is ONE compiled ``shard_map`` program: each device holds
-    a row shard, computes its gradient partial ``A_pᵀ (A_p θ − y_p)``, and
-    the partials sum over the block-scaled quantized ring with an
-    error-feedback accumulator carried in the ``while_loop`` state — the
-    untransmitted quantization residual re-enters the next step's
-    gradient, so compression adds noise but no bias to the iterates.
+    Each segment is ONE compiled ``shard_map`` program: every device
+    holds a row shard, computes its gradient partial ``A_pᵀ (A_p θ −
+    y_p)``, and the partials sum over the block-scaled quantized ring
+    with an error-feedback accumulator carried in the ``while_loop``
+    state — the untransmitted quantization residual re-enters the next
+    step's gradient, so compression adds noise but no bias to the
+    iterates.
+
+    The carry is ``(it, theta, delta, error)`` with ``error`` in its
+    host-visible stacked form ``(p, m)`` — one EF residual row per mesh
+    position, sharded in and out over the mesh axis — precisely so the
+    checkpointing driver can snapshot it between segments and a resumed
+    fit replays the identical quantized trajectory.
     """
     from jax.sharding import PartitionSpec
 
@@ -265,7 +387,7 @@ def _gd_loop_q(arr, yv, lam, tol, max_iter, step, *, comm, mode):
     mesh, name = comm._mesh, comm.axis_name
 
     def make():
-        def kernel(a, y0, lam_, tol_, mi_, step_):
+        def kernel(a, y0, lam_, tol_, stop_, step_, it0, th0, delta0, e0):
             def body(state):
                 it, th, _, e = state
                 g_part = a.T @ (a @ th - y0)
@@ -278,29 +400,28 @@ def _gd_loop_q(arr, yv, lam, tol, max_iter, step, *, comm, mode):
 
             def cond(state):
                 it, _, delta, _ = state
-                return jnp.logical_and(it < mi_, delta > tol_)
+                return jnp.logical_and(it < stop_, delta > tol_)
 
-            init = (
-                jnp.int32(0),
-                jnp.zeros((m,), jnp.float32),
-                jnp.float32(jnp.inf),
-                jnp.zeros((m,), jnp.float32),
-            )
-            n_iter, th, _, _ = lax.while_loop(cond, body, init)
-            return th, n_iter
+            init = (it0, th0, delta0, jnp.squeeze(e0, axis=0))
+            it, th, delta, e = lax.while_loop(cond, body, init)
+            return it, th, delta, e[None]
 
         rep = PartitionSpec()
 
-        def _f(a, y0, lam_, tol_, mi_, step_):
+        def _f(a, y0, lam_, tol_, stop_, step_, it0, th0, delta0, e0):
             return shard_map(
                 kernel,
                 mesh=mesh,
-                in_specs=(comm.spec(2, 0), comm.spec(1, 0), rep, rep, rep, rep),
-                out_specs=(rep, rep),
+                in_specs=(
+                    comm.spec(2, 0), comm.spec(1, 0), rep, rep, rep, rep,
+                    rep, rep, rep, comm.spec(2, 0),
+                ),
+                out_specs=(rep, rep, rep, PartitionSpec(name)),
                 check_vma=False,
-            )(a, y0, lam_, tol_, mi_, step_)
+            )(a, y0, lam_, tol_, stop_, step_, it0, th0, delta0, e0)
 
         return _f
 
     fn = jitted(("lasso.gd_q", comm, mode, n, m), make)
-    return fn(arr, yv, lam, tol, max_iter, step)
+    it0, th0, delta0, e0 = carry
+    return fn(arr, yv, lam, tol, stop, step, it0, th0, delta0, e0)

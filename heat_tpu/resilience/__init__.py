@@ -1,0 +1,66 @@
+"""Resilience layer: fault injection, health guards, training resume.
+
+Production TPU fleets fail in ways the HeAT reference's batch-job world
+never had to model: preemptible VMs disappear mid-fit, a single Inf
+poisons a quantized block scale, a crash mid-``ht.save`` truncates the
+only copy of a checkpoint.  This package is the reproduction's answer,
+three subsystems sharing one seam discipline (everything operates at
+host-visible boundaries, so compiled-program caches stay clean):
+
+:mod:`~heat_tpu.resilience.faults`
+    ``ht.resilience.inject(kind, seed=...)`` — seeded, deterministic
+    fault injection against the compressed-collective boundary, the
+    HDF5/NetCDF open and slab-write sites, and the training-loop
+    checkpoint tick.  Pytest fixtures live in
+    ``heat_tpu.resilience.fixtures``.
+
+:mod:`~heat_tpu.resilience.guards`
+    ``ht.resilience.guard(policy)`` — cheap on-device
+    finiteness/overflow checks on compressed collectives and ``fuse``
+    program outputs; ``"degrade"`` falls back to the exact f32 path for
+    the affected call (cache-key-safe) and records a structured
+    incident.
+
+:mod:`~heat_tpu.resilience.resume`
+    ``checkpoint_every=N`` / ``resume=True`` on the iterative solvers:
+    segment-executed fit loops whose carry (including the error-feedback
+    residual) snapshots atomically through the parallel-IO layer, with
+    bitwise-identical resume.
+
+See docs/design.md (resilience section) for the fault model and the
+resume determinism contract.
+"""
+
+from __future__ import annotations
+
+from .faults import Preempted, inject
+from .guards import (
+    GuardWarning,
+    NumericalHealthError,
+    get_guard_policy,
+    guard,
+    set_guard_policy,
+)
+from .incidents import Incident, clear_incident_log, incident_log
+from .resume import LoopCheckpointer, load_loop_state, save_loop_state
+from . import faults, guards, incidents, resume
+
+__all__ = [
+    "GuardWarning",
+    "Incident",
+    "LoopCheckpointer",
+    "NumericalHealthError",
+    "Preempted",
+    "clear_incident_log",
+    "faults",
+    "get_guard_policy",
+    "guard",
+    "guards",
+    "incident_log",
+    "incidents",
+    "inject",
+    "load_loop_state",
+    "resume",
+    "save_loop_state",
+    "set_guard_policy",
+]

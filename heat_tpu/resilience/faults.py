@@ -1,0 +1,220 @@
+"""Seeded, deterministic fault injection: ``ht.resilience.inject(...)``.
+
+A fault plan is a context manager that arms one fault *kind* against the
+seams the library exposes for it — the compressed-collective boundary in
+:mod:`heat_tpu.comm.compressed`, the file-open and slab-write sites in
+:mod:`heat_tpu.core.io`, and the between-segments checkpoint tick of the
+resumable training loops.  Whether a given trigger opportunity actually
+fires is decided by a ``numpy`` generator seeded per plan, so a fault
+schedule is a pure function of ``(seed, rate/nth, the sequence of
+trigger opportunities)`` — the same test run replays the same faults,
+bit for bit.
+
+Kinds
+-----
+``"nonfinite"``
+    Overwrites the first element of a compressed-collective input with a
+    non-finite value (NaN by default; pass ``value=float("inf")``).
+``"saturate"``
+    Multiplies the compressed-collective input by ``factor`` (default
+    1e36), driving block absmax — and with it the wire scales and the
+    ring's partial sums — into overflow.
+``"bitflip"``
+    Flips bit 30 (the high exponent bit) of one f32 word of the
+    collective's decoded result, at the program boundary — the observable
+    effect of an exponent bit-flip in a forwarded wire scale: a
+    finite-but-~2^64-inflated value the guard's overflow clause exists to
+    catch.
+``"io_error"``
+    Raises a transient ``OSError`` (EIO) at an HDF5/NetCDF open site.
+``"preempt"``
+    Raises :class:`Preempted` at a preemption point: the checkpoint tick
+    between training-loop segments (``site="iteration"``) or between two
+    slab writes inside a save (``site="save-slab"``).
+
+All injection happens at host-visible boundaries (eager ops on the
+arrays entering/leaving a compiled collective), so armed plans never leak
+into the compiled-program caches — an injected run and a clean run replay
+the same executables.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import errno
+from typing import List, Optional, Sequence, Union
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["Preempted", "inject", "any_active", "clear"]
+
+_KINDS = ("nonfinite", "saturate", "bitflip", "io_error", "preempt")
+
+#: trigger sites, by kind, that consume one schedule decision per call
+_COMM_INPUT_KINDS = ("nonfinite", "saturate")
+_COMM_OUTPUT_KINDS = ("bitflip",)
+
+
+class Preempted(RuntimeError):
+    """Simulated preemption: the process was 'killed' at a preemption
+    point (between training iterations, or mid-save between two slab
+    writes).  Catch it, then call ``fit(..., resume=True)`` / re-run the
+    save — exactly the SIGTERM-then-reschedule lifecycle of a preemptible
+    TPU VM."""
+
+
+class _Plan:
+    """One armed fault: kind + deterministic fire schedule."""
+
+    def __init__(
+        self,
+        kind: str,
+        seed: int,
+        rate: float,
+        nth: Optional[Union[int, Sequence[int]]],
+        value: float,
+        factor: float,
+        max_faults: Optional[int],
+        site: Optional[str],
+    ):
+        if kind not in _KINDS:
+            raise ValueError(f"unknown fault kind {kind!r}: expected one of {_KINDS}")
+        if not 0.0 <= rate <= 1.0:
+            raise ValueError(f"rate must be in [0, 1], got {rate}")
+        self.kind = kind
+        self.seed = int(seed)
+        self.rate = float(rate)
+        self.nth = (
+            None
+            if nth is None
+            else frozenset([int(nth)] if isinstance(nth, int) else [int(i) for i in nth])
+        )
+        self.value = float(value)
+        self.factor = float(factor)
+        self.max_faults = max_faults
+        self.site = site
+        self.rng = np.random.default_rng(self.seed)
+        self.calls = 0  # trigger opportunities seen
+        self.fired = 0  # faults actually injected
+
+    def should_fire(self, site: Optional[str] = None) -> bool:
+        """One schedule decision.  Every trigger opportunity advances the
+        call counter AND the RNG stream (even under ``nth``), so a plan's
+        fire pattern depends only on the opportunity sequence."""
+        if self.site is not None and site is not None and site != self.site:
+            return False
+        self.calls += 1
+        draw = float(self.rng.random())
+        if self.max_faults is not None and self.fired >= self.max_faults:
+            return False
+        hit = self.calls in self.nth if self.nth is not None else draw < self.rate
+        if hit:
+            self.fired += 1
+        return hit
+
+
+_PLANS: List[_Plan] = []
+
+
+def any_active() -> bool:
+    """True when at least one fault plan is armed (the fast-path gate the
+    injection seams check before doing any work)."""
+    return bool(_PLANS)
+
+
+def clear() -> None:
+    """Disarm every fault plan (test teardown)."""
+    _PLANS.clear()
+
+
+@contextlib.contextmanager
+def inject(
+    kind: str,
+    *,
+    seed: int = 0,
+    rate: float = 1.0,
+    nth: Optional[Union[int, Sequence[int]]] = None,
+    value: float = float("nan"),
+    factor: float = 1e36,
+    max_faults: Optional[int] = None,
+    site: Optional[str] = None,
+):
+    """Arm one deterministic fault plan for the duration of the block.
+
+    ``nth`` (1-based call index, or a collection of them) pins faults to
+    exact trigger opportunities; otherwise each opportunity fires with
+    probability ``rate`` from the plan's seeded stream.  ``max_faults``
+    caps total injections (a *transient* fault: fail N times, then heal —
+    the shape retry logic must survive).  ``site`` restricts a
+    ``"preempt"`` plan to one preemption point (``"iteration"`` or
+    ``"save-slab"``).  Plans nest; each keeps its own counters.
+    """
+    plan = _Plan(kind, seed, rate, nth, value, factor, max_faults, site)
+    _PLANS.append(plan)
+    try:
+        yield plan
+    finally:
+        try:
+            _PLANS.remove(plan)
+        except ValueError:  # already cleared by faults.clear()
+            pass
+
+
+# --------------------------------------------------------------------- #
+# trigger seams (called by comm/io/resume — no-ops when nothing is armed)
+# --------------------------------------------------------------------- #
+def comm_input(site: str, array):
+    """Corrupt a compressed collective's input per the armed plans.
+    Applied eagerly at the host boundary; the compiled ring program
+    itself is untouched."""
+    for plan in list(_PLANS):
+        if plan.kind not in _COMM_INPUT_KINDS or not plan.should_fire():
+            continue
+        if plan.kind == "saturate":
+            array = (array * jnp.asarray(plan.factor, dtype=array.dtype)).astype(array.dtype)
+        else:  # nonfinite
+            flat = jnp.ravel(array)
+            flat = flat.at[0].set(jnp.asarray(plan.value, dtype=array.dtype))
+            array = flat.reshape(array.shape)
+    return array
+
+
+def comm_output(site: str, array):
+    """Flip the high exponent bit of one f32 word of the collective's
+    decoded result — the boundary-visible signature of a bit-flip in a
+    forwarded wire scale."""
+    for plan in list(_PLANS):
+        if plan.kind not in _COMM_OUTPUT_KINDS or not plan.should_fire():
+            continue
+        shape, dtype = array.shape, array.dtype
+        flat = jnp.ravel(array).astype(jnp.float32)
+        n = int(flat.shape[0]) if flat.shape else 1
+        idx = int(plan.rng.integers(n))
+        bits = jax.lax.bitcast_convert_type(flat, jnp.uint32)
+        bits = bits.at[idx].set(bits[idx] ^ jnp.uint32(1 << 30))
+        array = jax.lax.bitcast_convert_type(bits, jnp.float32).reshape(shape).astype(dtype)
+    return array
+
+
+def io_open(path: str) -> None:
+    """Transient-``OSError`` seam at an HDF5/NetCDF open site."""
+    for plan in list(_PLANS):
+        if plan.kind == "io_error" and plan.should_fire():
+            raise OSError(
+                errno.EIO, f"injected transient IO fault (seed={plan.seed})", path
+            )
+
+
+def preempt_point(site: str) -> None:
+    """Simulated-preemption seam; ``site`` is ``"iteration"`` (the
+    checkpoint tick between loop segments) or ``"save-slab"`` (between
+    two slab writes inside a save)."""
+    for plan in list(_PLANS):
+        if plan.kind == "preempt" and plan.should_fire(site):
+            raise Preempted(
+                f"injected preemption at {site} (seed={plan.seed}, "
+                f"opportunity #{plan.calls})"
+            )

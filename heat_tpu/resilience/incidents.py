@@ -1,0 +1,79 @@
+"""Structured incident log for the resilience layer.
+
+Every guard intervention (a raised abort, a warned-and-continued call, a
+degraded-to-exact fallback) and every unrecoverable health failure is
+recorded here as an :class:`Incident` — a small frozen record the
+operator (or a test) can assert on after the fact.  The log is
+process-wide and append-only between explicit :func:`clear_incident_log`
+calls; it never touches the device, so recording is free relative to the
+collectives it describes.
+"""
+
+from __future__ import annotations
+
+import itertools
+import time
+from dataclasses import dataclass, field
+from typing import List, Tuple
+
+__all__ = ["Incident", "record", "incident_log", "clear_incident_log"]
+
+_SEQ = itertools.count()
+_LOG: List["Incident"] = []
+
+
+@dataclass(frozen=True)
+class Incident:
+    """One guard intervention.
+
+    ``seq`` is a process-wide monotone counter (stable ordering for
+    tests), ``kind`` the detected condition (``"nonfinite"`` /
+    ``"overflow"`` / ``"nonfinite-or-overflow"``), ``site`` the
+    collective or program that tripped the guard (``"allreduce_q"``,
+    ``"allgather_q"``, ``"fuse:<fn>"``), ``policy`` the guard policy in
+    force, and ``action`` what the guard actually did (``"raised"`` /
+    ``"warned"`` / ``"degraded"`` / ``"unrecoverable"`` — the last when a
+    degrade re-run was itself unhealthy or no exact fallback exists).
+    """
+
+    seq: int
+    kind: str
+    site: str
+    policy: str
+    action: str
+    detail: str = ""
+    #: wall-clock seconds (host time); informational only — never part of
+    #: equality-sensitive test assertions
+    timestamp: float = field(default=0.0, compare=False)
+
+    def render(self) -> str:
+        out = f"[{self.seq}] {self.site}: {self.kind} -> {self.action} (policy={self.policy})"
+        if self.detail:
+            out += f" — {self.detail}"
+        return out
+
+
+def record(kind: str, site: str, policy: str, action: str, detail: str = "") -> Incident:
+    """Append one incident to the process-wide log and return it."""
+    inc = Incident(
+        seq=next(_SEQ),
+        kind=kind,
+        site=site,
+        policy=policy,
+        action=action,
+        detail=detail,
+        timestamp=time.time(),
+    )
+    _LOG.append(inc)
+    return inc
+
+
+def incident_log() -> Tuple[Incident, ...]:
+    """Snapshot of all incidents since the last clear (oldest first)."""
+    return tuple(_LOG)
+
+
+def clear_incident_log() -> None:
+    """Drop all recorded incidents (the sequence counter keeps running,
+    so incident identities never repeat within a process)."""
+    _LOG.clear()

@@ -23,6 +23,17 @@ if ! python -m pytest tests/test_compressed_collectives.py -q; then
     echo "FAILED compressed collectives"
     fail=1
 fi
+# chaos lane: the resilience suite under a seeded fault schedule.  The
+# whole injected schedule is a pure function of HEAT_CHAOS_SEED (export a
+# different value to explore other schedules; every failure reproduces
+# exactly by re-running with the printed seed).  Includes the
+# resume-equivalence gate: preempted+resumed fits must be bitwise-equal
+# to uninterrupted ones.
+echo "=== chaos lane (seed=${HEAT_CHAOS_SEED:-0}: fault injection, guards, resume) ==="
+if ! HEAT_CHAOS_SEED="${HEAT_CHAOS_SEED:-0}" python -m pytest tests/test_resilience.py -q; then
+    echo "FAILED chaos lane (reproduce with HEAT_CHAOS_SEED=${HEAT_CHAOS_SEED:-0})"
+    fail=1
+fi
 for n in "${sizes[@]}"; do
     echo "=== mesh size $n ==="
     if ! HEAT_TEST_DEVICES="$n" python -m pytest tests/ -q -x; then

@@ -410,6 +410,68 @@ def kernel(a, e, name):
 
 
 # --------------------------------------------------------------------- #
+# SPMD204: quantized collectives in guard-disabled regions               #
+# --------------------------------------------------------------------- #
+def test_spmd204_triggers_inside_guard_off_block():
+    src = """
+from heat_tpu.comm.compressed import allreduce_q
+from heat_tpu.resilience import guard
+
+def combine(x, comm):
+    with guard("off"):
+        return allreduce_q(x, comm=comm)
+"""
+    findings = lint(src, "SPMD204")
+    assert findings and "allreduce_q" in findings[0].message
+    assert "guard" in findings[0].message
+
+
+def test_spmd204_triggers_after_set_guard_policy_off():
+    src = """
+from heat_tpu.comm import compressed
+from heat_tpu.resilience.guards import set_guard_policy
+
+def combine(x, comm):
+    set_guard_policy(policy="off")
+    return compressed.allgather_q(x, axis=0, comm=comm)
+"""
+    findings = lint(src, "SPMD204")
+    assert findings and "allgather_q" in findings[0].message
+
+
+def test_spmd204_suppression_comment_silences():
+    src = """
+from heat_tpu.comm.compressed import allreduce_q
+from heat_tpu.resilience import guard
+
+def combine(x, comm):
+    with guard("off"):
+        return allreduce_q(x, comm=comm)  # spmdlint: disable=SPMD204
+"""
+    assert lint(src, "SPMD204") == []
+
+
+def test_spmd204_clean_when_guards_active_or_absent():
+    src = """
+from heat_tpu.comm.compressed import allreduce_q
+from heat_tpu.resilience import guard
+
+def plain(x, comm):
+    return allreduce_q(x, comm=comm)
+
+def guarded(x, comm):
+    with guard("degrade"):
+        return allreduce_q(x, comm=comm)
+
+def disjoint(x, comm):
+    with guard("off"):
+        pass
+    return allreduce_q(x, comm=comm)
+"""
+    assert lint(src, "SPMD204") == []
+
+
+# --------------------------------------------------------------------- #
 # SPMD301/302: Pallas tiling and grids                                   #
 # --------------------------------------------------------------------- #
 def test_spmd301_triggers_on_off_tile_blocks():
@@ -570,8 +632,8 @@ def test_baseline_fingerprint_is_line_insensitive():
 # --------------------------------------------------------------------- #
 def test_every_rule_is_registered():
     assert [r.id for r in all_rules()] == [
-        "SPMD101", "SPMD102", "SPMD201", "SPMD202", "SPMD203", "SPMD301",
-        "SPMD302", "SPMD401",
+        "SPMD101", "SPMD102", "SPMD201", "SPMD202", "SPMD203", "SPMD204",
+        "SPMD301", "SPMD302", "SPMD401",
     ]
 
 
