@@ -854,7 +854,7 @@ def compressed_allreduce_rates(X):
     import jax.numpy as jnp
     from jax.sharding import NamedSharding, PartitionSpec
 
-    from heat_tpu.comm.compressed import BLOCK, ring_allreduce_q
+    from heat_tpu.comm.compressed import ring_allreduce_q
     from heat_tpu.core._jax_compat import shard_map
 
     comm = X.comm
@@ -909,22 +909,22 @@ def compressed_allreduce_rates(X):
     q_gbs, q_spread = rate(make_loop("int8_block"), 20, 220)
     exact_gbs, exact_spread = rate(make_loop(None), 40, 440)
 
-    # bytes-moved model (the acceptance claim: int8_block <= ~0.3x exact):
-    # each device sends 2(p-1) chunks per rep; a chunk is ceil(m/p) f32
-    # padded to the 128 block grid — exact ships 4 B/elem, int8_block
-    # ships 1 int8/elem + one f32 scale per 128 block = 132/512 = 0.258x
-    chunk = -(-m // max(p, 1))
-    chunk_p = -(-chunk // BLOCK) * BLOCK
-    hops = 2 * (p - 1)
-    exact_wire = hops * chunk_p * 4
-    q_wire = hops * (chunk_p + (chunk_p // BLOCK) * 4)
+    # bytes-moved model (the acceptance claim: int8_block <= ~0.3x exact)
+    # from the ONE shared source — heat_tpu.comm.compressed.wire_model(),
+    # the same arithmetic behind the telemetry layer's live
+    # comm.wire_ratio gauge and the test suite's exact-byte assertions,
+    # so the reported 0.258x can never drift between the three
+    from heat_tpu.comm.compressed import wire_model as _wm
+
+    q_model = _wm(m, p, "int8_block", op="allreduce")
+    bf16_model = _wm(m, p, "bf16", op="allreduce")
     wire_model = {
         "payload_elems_per_device": m,
-        "ring_hops_per_device": hops,
-        "exact_wire_bytes_per_rep": exact_wire,
-        "int8_block_wire_bytes_per_rep": q_wire,
-        "bytes_ratio_int8_vs_f32": round(q_wire / exact_wire, 4) if hops else None,
-        "bytes_ratio_bf16_vs_f32": 0.5,
+        "ring_hops_per_device": q_model["ring_hops_per_device"],
+        "exact_wire_bytes_per_rep": q_model["exact_wire_bytes"],
+        "int8_block_wire_bytes_per_rep": q_model["wire_bytes"],
+        "bytes_ratio_int8_vs_f32": q_model["bytes_ratio"],
+        "bytes_ratio_bf16_vs_f32": bf16_model["bytes_ratio"],
     }
     return (q_gbs, q_spread), (exact_gbs, exact_spread), wire_model
 
@@ -1044,7 +1044,23 @@ def fused_pipeline_ms(X):
     # ~100 ms tunnel round-trip for both
     fused_rate, fused_spread = _slope_rate(chained(fused), *_win(40, 400, 5))
     eager_rate, eager_spread = _slope_rate(chained(pipeline), *_win(40, 400, 5))
-    return (1e3 / fused_rate, fused_spread), (1e3 / eager_rate, eager_spread)
+
+    # per-call dispatch counts from the telemetry dispatch window (caches
+    # warm after the regions above, so these are pure replay counts):
+    # fused == 1 is the PR-3 identity, the eager twin shows what it buys
+    from heat_tpu.core._tracing import counting_dispatches
+
+    dispatches = {}
+    for label, step in (("fused", fused), ("eager", pipeline)):
+        with counting_dispatches() as d:
+            y = step(small, b)
+            np.asarray(y.larray[0, 0])
+        dispatches[label] = d.count
+    return (
+        (1e3 / fused_rate, fused_spread),
+        (1e3 / eager_rate, eager_spread),
+        dispatches,
+    )
 
 
 def qr_svd_ms():
@@ -1219,6 +1235,7 @@ def main():
     (
         (fused_ms, fused_ms_spread),
         (eager_pipe_ms, eager_pipe_spread),
+        pipe_dispatches,
     ) = fused_pipeline_ms(X)
     lasso_sweeps, lasso_spread = lasso_rate(data, X)
     golden.measure("qr")
@@ -1262,6 +1279,11 @@ def main():
                 # pipeline through the eager per-op path (~6 dispatches)
                 "fused_pipeline_ms": round(fused_ms, 3),
                 "eager_pipeline_ms": round(eager_pipe_ms, 3),
+                # per-call device dispatches, read from the telemetry
+                # dispatch window (counting_dispatches): fused == 1 by
+                # construction, eager shows the per-op launches it folds
+                "fused_pipeline_dispatches_per_call": pipe_dispatches["fused"],
+                "eager_pipeline_dispatches_per_call": pipe_dispatches["eager"],
                 "lasso_sweeps_per_sec": round(lasso_sweeps, 2),
                 "qr_svd_tall_skinny_ms": round(qr_ms, 2),
                 # sequence-parallel flagship: fused flash-attention

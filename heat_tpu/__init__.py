@@ -57,6 +57,7 @@ from . import naive_bayes
 from . import regression
 from . import resilience
 from . import spatial
+from . import telemetry
 from . import utils
 from . import datasets
 

@@ -847,6 +847,58 @@ def check_guard_disabled_collectives(ctx: FileContext) -> Iterable[Finding]:
 
 
 # --------------------------------------------------------------------- #
+# SPMD205: host timing inside traced functions                           #
+# --------------------------------------------------------------------- #
+#: host clocks whose reading inside a traced body is a trace-time
+#: constant — including the `_ns` variants SPMD201 does not list
+_TIMING_CALLS = {
+    "time.time", "time.time_ns",
+    "time.perf_counter", "time.perf_counter_ns",
+    "time.monotonic", "time.monotonic_ns",
+    "time.process_time", "time.process_time_ns",
+}
+#: dotted-suffix forms of the telemetry span entry point (`from heat_tpu
+#: import telemetry` and the internal `from ..telemetry import _core`)
+_SPAN_SUFFIXES = ("telemetry.span", "telemetry._core.span")
+
+
+@rule("SPMD205", "host-side timing inside traced functions measures trace time, not run time")
+def check_trace_timing(ctx: FileContext) -> Iterable[Finding]:
+    """A traced body runs ONCE, at trace time, with abstract tracers: a
+    ``time.*`` read or a ``telemetry.span`` opened inside it brackets the
+    *tracing* of the program — microseconds of Python — not the compiled
+    execution it stands for, and the measured value is frozen into the
+    cache.  Deliberately overlaps SPMD201 on the wall-clock reads (either
+    finding alone should stop the commit) and extends the set with the
+    ``_ns``/``process_time`` variants and the telemetry span API, whose
+    timing intent makes the trace/run confusion easy to miss."""
+    for node in ast.walk(ctx.tree):
+        if not (isinstance(node, ast.Call) and ctx.in_traced_context(node)):
+            continue
+        dotted = ctx.resolve(node.func)
+        if dotted is None:
+            continue
+        if dotted in _TIMING_CALLS:
+            yield ctx.finding(
+                "SPMD205", node,
+                f"host clock {dotted!r} read inside a traced function",
+                hint="the read happens once at trace time and its value is "
+                "baked into the compiled program; time the jitted call at "
+                "its HOST call site (after block_until_ready), or use "
+                "jax.profiler device traces",
+            )
+        elif any(dotted == s or dotted.endswith("." + s) for s in _SPAN_SUFFIXES):
+            yield ctx.finding(
+                "SPMD205", node,
+                "telemetry.span opened inside a traced function",
+                hint="the span brackets TRACING (one-time Python), not the "
+                "compiled execution; move the span to the host call site "
+                "around the jitted/fused call, as the op engine already "
+                "does for its own sites",
+            )
+
+
+# --------------------------------------------------------------------- #
 # SPMD301/302: Pallas tiling and grids                                   #
 # --------------------------------------------------------------------- #
 @rule("SPMD301", "Pallas BlockSpec tiles must respect the hardware tile grid")

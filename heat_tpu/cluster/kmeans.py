@@ -22,6 +22,7 @@ import jax.numpy as jnp
 from ..core.dndarray import DNDarray
 from ..core.sanitation import sanitize_in
 from ..spatial import distance
+from ..telemetry import _core as _tel
 from ._kcluster import _KCluster, _quadratic_cdist
 
 __all__ = ["KMeans"]
@@ -186,6 +187,14 @@ class KMeans(_KCluster):
             else:
                 carry = KMeans._fit_segment(arr, tol, jnp.int32(stop), carry)
             it = int(carry[0])
+            if use_q and _tel.enabled and it > it0:
+                from ..comm import compressed as _cq
+
+                # the quantized centroid-partial combine runs INSIDE the
+                # compiled segment (one ring of k*f f32 per Lloyd step) —
+                # invisible to the host-level accounting in allreduce_q,
+                # so the fit driver credits the ledger per iteration here
+                _cq._account_wire("allreduce", mode, k * f, comm.size, reps=it - it0)
             if it >= self.max_iter or it < stop:
                 # out of iterations, or converged before the boundary
                 break

@@ -74,6 +74,7 @@ from jax.sharding import PartitionSpec
 from ..core._compile import jitted, register_key_context
 from ..core._jax_compat import shape_dtype_struct, shard_map
 from ..core.communication import sanitize_comm
+from ..telemetry import _core as _tel
 
 __all__ = [
     "BLOCK",
@@ -90,6 +91,7 @@ __all__ = [
     "ring_allreduce_q_ef",
     "set_collective_precision",
     "set_collective_threshold",
+    "wire_model",
 ]
 
 #: Quantization block length: one f32 scale per this many payload values.
@@ -558,7 +560,12 @@ def allreduce_q(
     # program's own health output covers the call
     eager = not isinstance(array, jax.core.Tracer)
     payload = faults.comm_input("allreduce_q", array) if eager and faults.any_active() else array
-    out = fn(payload, error) if has_err else fn(payload)
+    if _tel.enabled and eager:
+        _account_wire("allreduce", wire, int(np.prod(shape[1:])) if len(shape) > 1 else 1, p)
+        with _tel.span("commq:allreduce", mode=wire or "f32", mesh=p):
+            out = fn(payload, error) if has_err else fn(payload)
+    else:
+        out = fn(payload, error) if has_err else fn(payload)
     if eager and faults.any_active():
         if has_err:
             out = (faults.comm_output("allreduce_q", out[0]), out[1])
@@ -589,6 +596,56 @@ def _payload_nbytes(array, stacked: bool) -> int:
     if stacked and shape:
         nbytes //= max(int(shape[0]), 1)
     return nbytes
+
+
+def wire_model(n_elems: int, size: int, mode: Optional[str], *,
+               block: int = BLOCK, op: str = "allreduce") -> dict:
+    """Bytes-moved model for one ring collective, per device.
+
+    The single source of the 0.258x claim: exact f32 ships 4 B/element,
+    ``int8_block`` ships 1 B/element plus one f32 scale per ``block``
+    elements (132/512 per 128-block), ``bf16`` 2 B/element.  ``op="
+    allreduce"`` models the reduce-scatter + all-gather ring (each device
+    sends ``2*(size-1)`` chunks of ``ceil(n/size)`` elements padded to
+    the block grid); ``op="allgather"`` the one-way ring (``size-1`` hops
+    of the ``n_elems``-element local shard).  Shared by bench.py's
+    ``allreduce_q_wire_model`` headline and the telemetry layer's live
+    exact-vs-wire byte accounting, so the reported ratio and the tested
+    exact-byte math can never drift apart."""
+    p = max(int(size), 1)
+    if op == "allreduce":
+        chunk = -(-int(n_elems) // p)
+        hops = 2 * (p - 1)
+    elif op == "allgather":
+        chunk = int(n_elems)
+        hops = p - 1
+    else:
+        raise ValueError(f"unknown ring op {op!r}")
+    chunk_p = -(-chunk // int(block)) * int(block)
+    exact = hops * chunk_p * 4
+    if mode == "int8_block":
+        wire = hops * (chunk_p + (chunk_p // int(block)) * 4)
+    elif mode == "bf16":
+        wire = hops * chunk_p * 2
+    else:  # exact transmission (policy answered None / "f32")
+        wire = exact
+    return {
+        "ring_hops_per_device": hops,
+        "chunk_elems_padded": chunk_p,
+        "exact_wire_bytes": exact,
+        "wire_bytes": wire,
+        "bytes_ratio": round(wire / exact, 4) if exact else None,
+    }
+
+
+def _account_wire(op: str, mode: Optional[str], n_elems: int, size: int,
+                  reps: int = 1) -> None:
+    """Credit ``reps`` ring invocations to the telemetry byte ledger
+    (no-op unless telemetry is enabled; callers pre-check the flag)."""
+    wm = wire_model(n_elems, size, mode, op=op)
+    _tel.account_bytes(
+        op, mode or "f32", wm["exact_wire_bytes"] * reps, wm["wire_bytes"] * reps
+    )
 
 
 def allgather_q(
@@ -647,7 +704,12 @@ def allgather_q(
     faults, guards = _resilience()
     eager = not isinstance(array, jax.core.Tracer)  # see allreduce_q
     payload = faults.comm_input("allgather_q", array) if eager and faults.any_active() else array
-    out = fn(payload)
+    if _tel.enabled and eager:
+        _account_wire("allgather", mode, int(np.prod(shape)) // p, p)
+        with _tel.span("commq:allgather", mode=mode, mesh=p):
+            out = fn(payload)
+    else:
+        out = fn(payload)
     if eager and faults.any_active():
         out = faults.comm_output("allgather_q", out)
     if eager and guards.active() and not guards.is_healthy(out):

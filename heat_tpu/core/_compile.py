@@ -25,6 +25,7 @@ import numpy as np
 
 import jax
 
+from ..telemetry import _core as _tel
 from ._tracing import record_dispatch
 
 __all__ = [
@@ -110,17 +111,64 @@ def jitted(key: Tuple, make_fn: Callable[[], Callable]) -> Callable:
         key = key + context_token()
     fn = _CACHE.get(key)
     if fn is None:
+        if _tel.enabled:
+            _tel.inc("compile.cache.misses")
         jfn = jax.jit(make_fn())
+        site = key[0] if key and isinstance(key[0], str) else getattr(
+            jfn, "__name__", "op"
+        )
+        staged = [False]  # first-call stage timing done (telemetry only)
 
         def fn(*args, _jfn=jfn, **kwargs):
-            if _trace_state_clean():
+            clean = _trace_state_clean()
+            if clean:
                 record_dispatch()
+            if _tel.enabled and clean:
+                if not staged[0]:
+                    staged[0] = True
+                    out = _timed_first_call(site, _jfn, args, kwargs)
+                    if out is not _AOT_UNAVAILABLE:
+                        return out
+                with _tel.span(f"jitted:{site}"):
+                    return _jfn(*args, **kwargs)
             return _jfn(*args, **kwargs)
 
         fn.lower = jfn.lower  # HLO inspection passthrough (tests)
         fn.jitted = jfn
         _CACHE[key] = fn
+        if _tel.enabled:
+            _tel.gauge("compile.cache.size", len(_CACHE))
+    elif _tel.enabled:
+        _tel.inc("compile.cache.hits")
     return fn
+
+
+_AOT_UNAVAILABLE = object()
+
+
+def _timed_first_call(site: str, jfn, args, kwargs):
+    """Telemetry-enabled first invocation of a freshly built ``jitted``
+    entry: stage the call through the AOT API so the compile-miss event
+    records trace+lower time and XLA compile time separately, then run
+    the compiled executable (one dispatch, already counted by the
+    caller).  Falls back to the plain call — returning the
+    ``_AOT_UNAVAILABLE`` sentinel — when the AOT path does not apply
+    (kwargs, older jax)."""
+    if kwargs:
+        return _AOT_UNAVAILABLE
+    t0 = _tel.clock()
+    try:
+        lowered = jfn.lower(*args)
+        t1 = _tel.clock()
+        compiled = lowered.compile()
+        t2 = _tel.clock()
+    except Exception:
+        return _AOT_UNAVAILABLE
+    _tel.record_event(
+        "compile", site=site, trace_lower_s=t1 - t0, compile_s=t2 - t1
+    )
+    with _tel.span(f"jitted:{site}", phase="first_run"):
+        return compiled(*args)
 
 
 def clear_cache() -> None:

@@ -19,14 +19,22 @@ layer is not reliable from Python (the C++ pjit fast path bypasses any
 Python wrapper after the first call), so the counter is incremented by the
 two places heat_tpu itself launches programs: the ``jitted()`` executable
 wrapper and the ``device_put``-based reshard in the communication layer.
+The counter's storage moved into :mod:`heat_tpu.telemetry` (one registry
+for all runtime accounting, lock-guarded so threaded serving does not
+lose increments); the functions here are the stable shim over it, and
+:func:`counting_dispatches` is the leak-free way for tests to scope a
+reading.
 
 Kept free of jax imports so every core module can import it without
-ordering constraints.
+ordering constraints (:mod:`heat_tpu.telemetry._core` holds the same
+property).
 """
 
 from __future__ import annotations
 
 import contextlib
+
+from ..telemetry import _core as _telemetry
 
 __all__ = [
     "FuseTraceError",
@@ -36,6 +44,7 @@ __all__ = [
     "record_dispatch",
     "dispatch_count",
     "reset_dispatch_count",
+    "counting_dispatches",
 ]
 
 
@@ -90,27 +99,32 @@ def require_concrete(what: str) -> None:
 
 
 # ---------------------------------------------------------------------- #
-# dispatch counting                                                       #
+# dispatch counting (shim over the telemetry registry)                    #
 # ---------------------------------------------------------------------- #
-_dispatches = 0
-
-
 def record_dispatch() -> None:
     """Count one device program launch.
 
     No-ops inside trace mode: a call that happens while tracing is being
-    inlined into the enclosing program, not dispatched.
+    inlined into the enclosing program, not dispatched.  The increment
+    itself lives in :mod:`heat_tpu.telemetry` — thread-safe, and visible
+    as the ``dispatches`` counter when telemetry is enabled.
     """
-    global _dispatches
     if _trace_depth == 0:
-        _dispatches += 1
+        _telemetry.record_dispatch()
 
 
 def dispatch_count() -> int:
     """Device program launches recorded since the last reset."""
-    return _dispatches
+    return _telemetry.dispatch_count()
 
 
 def reset_dispatch_count() -> None:
-    global _dispatches
-    _dispatches = 0
+    _telemetry.reset_dispatch_count()
+
+
+def counting_dispatches():
+    """Scoped dispatch counting: ``with counting_dispatches() as d: ...``
+    then read ``d.count`` — a baseline diff over the process counter, so
+    tests never have to reset (and therefore never leak) global state.
+    See :func:`heat_tpu.telemetry.counting_dispatches`."""
+    return _telemetry.counting_dispatches()

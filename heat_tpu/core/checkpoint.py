@@ -36,6 +36,7 @@ from typing import Any, Dict, Tuple
 
 import numpy as np
 
+from ..telemetry import _core as _tel
 from . import io as _io
 from . import types
 from .base import BaseEstimator
@@ -222,6 +223,18 @@ def save_estimator(est: BaseEstimator, path: str) -> None:
         "format_version": _FORMAT_VERSION,
         "root": _manifest(est, "", ctx),
     }
+    if _tel.enabled:
+        _tel.inc("checkpoint.saves")
+        with _tel.span("ckpt:save_estimator", cls=type(est).__name__, path=path):
+            _io._save_hdf5_many(
+                path,
+                sorted(ctx.datasets.items()),
+                attrs={_MANIFEST_ATTR: json.dumps(manifest)},
+            )
+        _tel.record_event(
+            "checkpoint", site=type(est).__name__, op="save", path=path
+        )
+        return
     _io._save_hdf5_many(
         path,
         sorted(ctx.datasets.items()),
@@ -330,4 +343,12 @@ def load_estimator(path: str) -> BaseEstimator:
             f"{path}: unsupported checkpoint format_version {version!r} "
             f"(this build reads versions {list(_READABLE_VERSIONS)})"
         )
+    if _tel.enabled:
+        _tel.inc("checkpoint.loads")
+        with _tel.span("ckpt:load_estimator", path=path):
+            est = _instantiate(manifest["root"], path, {})
+        _tel.record_event(
+            "checkpoint", site=type(est).__name__, op="load", path=path
+        )
+        return est
     return _instantiate(manifest["root"], path, {})

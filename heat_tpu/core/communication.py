@@ -56,6 +56,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
+from ..telemetry import _core as _tel
 from ._compile import jitted
 from ._jax_compat import distributed_is_initialized, shard_map
 from ._tracing import in_trace, record_dispatch
@@ -408,6 +409,12 @@ class XlaCommunication(Communication):
                 src = self._split_axis_of(array)
                 if src is not None and int(array.shape[src]) % self.size == 0:
                     return _cq.allgather_q(array, axis=src, comm=self, precision=mode)
+            if _tel.enabled and not isinstance(array, jax.core.Tracer):
+                _cq._account_wire(
+                    "allgather", None, int(np.prod(array.shape)) // self.size, self.size
+                )
+                with _tel.span("comm:allgather", mesh=self.size):
+                    return _reshard(array, self.sharding(array.ndim, None))
         return _reshard(array, self.sharding(array.ndim, None))
 
     def alltoall(self, array: jax.Array, send_axis: int, recv_axis: int) -> jax.Array:
@@ -525,7 +532,15 @@ class XlaCommunication(Communication):
 
             return _f
 
-        return jitted(("comm.allreduce", self, op), make)(array)
+        fn = jitted(("comm.allreduce", self, op), make)
+        if _tel.enabled:
+            from ..comm.compressed import _account_wire
+
+            elems = int(np.prod(array.shape[1:])) if array.ndim > 1 else 1
+            _account_wire("allreduce", None, elems, n)
+            with _tel.span("comm:allreduce", op=op, mesh=n):
+                return fn(array)
+        return fn(array)
 
     def ring_permute(self, array: jax.Array, shift: int = 1) -> jax.Array:
         """Rotate shards around the mesh ring: the reference's paired
@@ -770,6 +785,10 @@ def _reshard(array, sh: NamedSharding):
     ):
         return _constrained_copy(array, sh)
     record_dispatch()
+    if _tel.enabled:
+        _tel.inc("comm.reshards")
+        with _tel.span("comm:reshard"):
+            return jax.device_put(array, sh)
     return jax.device_put(array, sh)
 
 

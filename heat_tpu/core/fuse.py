@@ -57,6 +57,7 @@ import numpy as np
 
 import jax
 
+from ..telemetry import _core as _tel
 from . import _compile
 from ._compile import cache_stable
 from ._tracing import (
@@ -214,11 +215,25 @@ class _FusedFunction:
             except TypeError:  # unhashable static leaf slipped through
                 key = None
         if program is None:
+            if _tel.enabled:
+                _tel.inc("fuse.cache.misses")
             program = _build(self._fn, slots, treedef, self._donate)
             if key is not None:
                 _FUSE_CACHE[key] = program
+                if _tel.enabled:
+                    _tel.gauge("fuse.cache.size", len(_FUSE_CACHE))
+        elif _tel.enabled:
+            _tel.inc("fuse.cache.hits")
 
-        raws = program.jfn(tuple(operands))
+        if _tel.enabled:
+            # jax.jit is lazy: a program whose out_treedef is still unset
+            # runs its DNDarray trace + XLA compile inside this first
+            # call, so that is the "build" span; later calls replay
+            site = "fuse:build" if program.out_treedef is None else "fuse:replay"
+            with _tel.span(site, name=getattr(self._fn, "__name__", "<pipeline>")):
+                raws = program.jfn(tuple(operands))
+        else:
+            raws = program.jfn(tuple(operands))
         record_dispatch()
 
         flag = None

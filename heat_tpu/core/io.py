@@ -25,6 +25,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from ..telemetry import _core as _tel
 from . import devices as _devices
 from . import factories, types
 from .communication import comm_for_device, sanitize_comm
@@ -536,6 +537,14 @@ def save_csv(
 
 def load(path: str, *args, **kwargs) -> DNDarray:
     """Extension-dispatched load (reference io.py:622-664)."""
+    if _tel.enabled:
+        _tel.inc("io.loads")
+        with _tel.span("io:load", path=str(path)):
+            return _load_impl(path, *args, **kwargs)
+    return _load_impl(path, *args, **kwargs)
+
+
+def _load_impl(path: str, *args, **kwargs) -> DNDarray:
     if not isinstance(path, str):
         raise TypeError(f"Expected path to be str, but was {type(path)}")
     ext = os.path.splitext(path)[-1].strip().lower()
@@ -556,6 +565,14 @@ def save(data: DNDarray, path: str, *args, **kwargs) -> None:
     """Extension-dispatched save (reference io.py:886-923).  Estimators
     dispatch to :func:`heat_tpu.save_estimator` (extension): one call
     saves data or a fitted model alike."""
+    if _tel.enabled:
+        _tel.inc("io.saves")
+        with _tel.span("io:save", path=str(path)):
+            return _save_impl(data, path, *args, **kwargs)
+    return _save_impl(data, path, *args, **kwargs)
+
+
+def _save_impl(data: DNDarray, path: str, *args, **kwargs) -> None:
     from .base import BaseEstimator
 
     if isinstance(data, BaseEstimator):

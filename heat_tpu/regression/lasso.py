@@ -18,6 +18,7 @@ from ..core import factories, types
 from ..core.base import BaseEstimator, RegressionMixin
 from ..core.dndarray import DNDarray
 from ..core.sanitation import sanitize_in
+from ..telemetry import _core as _tel
 
 __all__ = ["Lasso"]
 
@@ -278,6 +279,14 @@ class Lasso(RegressionMixin, BaseEstimator):
                         comm=comm, mode=mode,
                     )
                     it = int(carry[0])
+                    if _tel.enabled and it > it0:
+                        # the quantized gradient combine runs INSIDE the
+                        # compiled segment (one ring of m f32 per ISTA
+                        # step), so the fit driver credits the wire-byte
+                        # ledger per iteration here
+                        _cq._account_wire(
+                            "allreduce", mode, m, comm.size, reps=it - it0
+                        )
                     if it >= self.max_iter or it < stop:
                         break
                     ckpt.tick(

@@ -12,9 +12,10 @@ collectives it describes.
 from __future__ import annotations
 
 import itertools
-import time
 from dataclasses import dataclass, field
 from typing import List, Tuple
+
+from ..telemetry import _core as _telemetry
 
 __all__ = ["Incident", "record", "incident_log", "clear_incident_log"]
 
@@ -42,7 +43,10 @@ class Incident:
     policy: str
     action: str
     detail: str = ""
-    #: wall-clock seconds (host time); informational only — never part of
+    #: host-time seconds from the telemetry clock
+    #: (:func:`heat_tpu.telemetry.clock` — monotonic, injectable, and a
+    #: plain sequence number in deterministic mode, so chaos-lane runs
+    #: are clock-independent); informational only — never part of
     #: equality-sensitive test assertions
     timestamp: float = field(default=0.0, compare=False)
 
@@ -54,7 +58,12 @@ class Incident:
 
 
 def record(kind: str, site: str, policy: str, action: str, detail: str = "") -> Incident:
-    """Append one incident to the process-wide log and return it."""
+    """Append one incident to the process-wide log and return it.
+
+    With telemetry enabled the incident is also published on the event
+    stream (type ``"incident"``) and counted under
+    ``resilience.incidents`` / ``resilience.incidents.<action>`` — the
+    resilience log doubles as a telemetry event source."""
     inc = Incident(
         seq=next(_SEQ),
         kind=kind,
@@ -62,9 +71,21 @@ def record(kind: str, site: str, policy: str, action: str, detail: str = "") -> 
         policy=policy,
         action=action,
         detail=detail,
-        timestamp=time.time(),
+        timestamp=_telemetry.clock(),
     )
     _LOG.append(inc)
+    if _telemetry.enabled:
+        _telemetry.inc("resilience.incidents")
+        _telemetry.inc(f"resilience.incidents.{action}")
+        _telemetry.record_event(
+            "incident",
+            site=site,
+            kind=kind,
+            policy=policy,
+            action=action,
+            detail=detail,
+            seq=inc.seq,
+        )
     return inc
 
 

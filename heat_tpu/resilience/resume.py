@@ -27,6 +27,7 @@ import numpy as np
 
 from ..core import factories
 from ..core import io as _io
+from ..telemetry import _core as _tel
 from . import faults
 
 __all__ = ["LoopCheckpointer", "load_loop_state", "save_loop_state"]
@@ -57,6 +58,14 @@ def save_loop_state(path: str, state: Dict[str, Any], meta: Optional[Dict[str, A
         "meta": dict(meta or {}),
         "entries": entries,
     }
+    if _tel.enabled:
+        _tel.inc("checkpoint.saves")
+        with _tel.span("ckpt:save", path=str(path)):
+            _io._save_hdf5_many(
+                path, datasets, attrs={_MANIFEST_ATTR: json.dumps(manifest)}
+            )
+        _tel.record_event("checkpoint", site="loop", op="save", path=str(path))
+        return
     _io._save_hdf5_many(
         path, datasets, attrs={_MANIFEST_ATTR: json.dumps(manifest)}
     )
@@ -100,6 +109,9 @@ def load_loop_state(path: str) -> Tuple[Dict[str, np.ndarray], Dict[str, Any]]:
             if entry.get("scalar"):
                 arr = arr.reshape(())
             state[name] = arr
+    if _tel.enabled:
+        _tel.inc("checkpoint.loads")
+        _tel.record_event("checkpoint", site="loop", op="load", path=str(path))
     return state, manifest.get("meta", {})
 
 
@@ -151,6 +163,12 @@ class LoopCheckpointer:
         if not self.path:
             raise ValueError("resume=True requires checkpoint_path")
         state, meta = load_loop_state(self.path)
+        if _tel.enabled:
+            _tel.inc("checkpoint.resumes")
+            _tel.record_event(
+                "checkpoint", site=self.algo, op="resume",
+                path=str(self.path), it=int(meta.get("it", -1)),
+            )
         if meta.get("algo") != self.algo:
             raise ValueError(
                 f"{self.path}: snapshot was written by {meta.get('algo')!r}, "

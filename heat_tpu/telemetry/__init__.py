@@ -1,0 +1,87 @@
+"""``heat_tpu.telemetry`` — unified runtime observability.
+
+One registry for everything the runtime can tell you about itself:
+
+- **spans** — ``telemetry.span("site")`` (context manager + decorator),
+  emitted automatically by the hot paths: ``jitted()`` replay vs
+  first-compile (miss events split trace+lower vs compile time),
+  ``ht.fuse`` program build/replay, communication-layer reshards and
+  collectives, checkpoint saves, estimator ``fit``/``predict``;
+- **counters & gauges** — device dispatches, compile-cache hits /
+  misses / size, collective invocations with exact-vs-wire byte
+  accounting per precision mode (the compression ratio is the live
+  gauge ``comm.wire_ratio.<mode>``), guard incidents, checkpoint
+  save/load/resume events;
+- **exporters** — ``snapshot()`` (in-memory dict), a JSONL sink
+  (``set_jsonl(path)``), and Chrome/Perfetto trace-event JSON
+  (``start_trace(path)`` / ``stop_trace()``, optionally interleaved
+  with ``jax.profiler`` device capture).
+
+Disabled (the default) it costs one predicate per instrumented site and
+contributes nothing to compile-cache keys; ``enable(deterministic=True)``
+swaps timestamps for a monotone sequence so tests can assert on event
+streams bitwise.  ``HEAT_TELEMETRY=1`` enables collection from the
+environment.  See docs/design.md ("Observability") and the tutorial
+walkthrough for a worked example.
+"""
+
+from ._core import (
+    account_bytes,
+    clock,
+    counting_dispatches,
+    disable,
+    dispatch_count,
+    enable,
+    events,
+    gauge,
+    inc,
+    is_deterministic,
+    is_enabled,
+    jsonl_path,
+    record_dispatch,
+    record_event,
+    reset,
+    reset_dispatch_count,
+    set_clock,
+    set_jsonl,
+    snapshot,
+    span,
+)
+from .export import start_trace, stop_trace, trace_active
+
+__all__ = [
+    "enable",
+    "disable",
+    "is_enabled",
+    "is_deterministic",
+    "enabled",
+    "clock",
+    "set_clock",
+    "span",
+    "inc",
+    "gauge",
+    "record_event",
+    "account_bytes",
+    "events",
+    "snapshot",
+    "reset",
+    "set_jsonl",
+    "jsonl_path",
+    "record_dispatch",
+    "dispatch_count",
+    "reset_dispatch_count",
+    "counting_dispatches",
+    "start_trace",
+    "stop_trace",
+    "trace_active",
+]
+
+
+def __getattr__(name):
+    # `telemetry.enabled` must track the live flag; a from-import at
+    # package init would freeze the boolean at its import-time value
+    if name == "enabled":
+        from . import _core
+
+        return _core.enabled
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -1,0 +1,496 @@
+"""Process-wide telemetry runtime: spans, counters, gauges, events.
+
+This module is the single registry behind ``heat_tpu.telemetry`` — every
+instrumented hot path (the ``jitted()`` replay wrapper, ``ht.fuse`` build
+and replay, the communication layer's reshards and collectives, the
+compressed rings' wire-byte accounting, guard incidents, checkpoint
+save/load/resume) reports here, and every exporter (``snapshot()``, the
+JSONL sink, the Perfetto trace writer in :mod:`heat_tpu.telemetry.export`)
+reads from here.
+
+Overhead contract
+-----------------
+Telemetry is off by default and *disabled mode costs one predicate per
+site*: instrumented library code guards every report with
+``if _core.enabled:`` — a module-attribute load and a branch, no object
+allocation, no lock, no clock read.  Enabling flips one module-level
+flag; nothing is registered with the compile-cache key context, so
+toggling telemetry can never change what a cached program means or force
+a retrace (asserted by tests/test_telemetry.py).
+
+The one always-on piece of state is the *dispatch counter*: it predates
+telemetry (tier-1 dispatch-count gates consume it through the
+:mod:`heat_tpu.core._tracing` shim) and keeps counting with telemetry
+disabled.  It is guarded by the registry lock, so threaded serving does
+not lose increments.
+
+Determinism
+-----------
+``enable(deterministic=True)`` replaces the wall clock with a monotone
+integer sequence: every ``clock()`` read returns the next integer, so
+span timestamps and durations become pure functions of the event order
+and two identical runs (after ``reset()``) produce bitwise-identical
+event streams.  ``set_clock()`` injects an arbitrary clock — the
+resilience incident log stamps its records through :func:`clock`, so
+chaos-lane runs can pin time entirely.
+
+Kept free of jax imports (like :mod:`heat_tpu.core._tracing`) so every
+core module can import it without ordering constraints.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import functools
+import json
+import os
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+__all__ = [
+    "enabled",
+    "enable",
+    "disable",
+    "is_enabled",
+    "is_deterministic",
+    "clock",
+    "set_clock",
+    "span",
+    "inc",
+    "gauge",
+    "record_event",
+    "account_bytes",
+    "events",
+    "snapshot",
+    "reset",
+    "set_jsonl",
+    "jsonl_path",
+    "record_dispatch",
+    "dispatch_count",
+    "reset_dispatch_count",
+    "counting_dispatches",
+]
+
+#: THE module-level flag.  Instrumented hot paths read this attribute
+#: directly (``if _core.enabled:``); everything else in this module is
+#: behind that predicate.
+enabled: bool = False
+
+_lock = threading.RLock()
+_deterministic = False
+_det_seq = 0
+_wall: Callable[[], float] = time.monotonic  # injectable via set_clock()
+
+_counters: Dict[str, int] = {}
+_gauges: Dict[str, float] = {}
+#: per-site span aggregates: site -> [count, total_seconds]
+_spans: Dict[str, List[float]] = {}
+#: the bounded event list (newest last); spans append one event at exit
+_events: List[dict] = []
+_MAX_EVENTS = 1 << 16
+
+#: optional JSONL sink: every event is also appended to this file
+_jsonl = None  # type: Optional[Any]
+_jsonl_path: Optional[str] = None
+
+#: Perfetto trace-event buffer; managed by telemetry.export.  Lives here
+#: so span/event emission never has to import the exporter.
+_trace_buf: Optional[List[dict]] = None
+
+#: thread ids -> small stable indices (first-seen order), so exported
+#: ``tid`` values are deterministic in single-threaded runs
+_tids: Dict[int, int] = {}
+
+
+# --------------------------------------------------------------------- #
+# clock                                                                 #
+# --------------------------------------------------------------------- #
+def clock() -> float:
+    """The telemetry timestamp source (seconds, monotonic).
+
+    In deterministic mode every read returns the next integer of a
+    monotone sequence instead of a wall-clock value; :func:`reset`
+    rewinds the sequence, making event streams bitwise replayable.
+    The resilience incident log (:mod:`heat_tpu.resilience.incidents`)
+    stamps its records through this function, so a test can pin incident
+    timestamps with :func:`set_clock` or deterministic mode.
+    """
+    global _det_seq
+    if _deterministic:
+        with _lock:
+            t = float(_det_seq)
+            _det_seq += 1
+        return t
+    return _wall()
+
+
+def set_clock(fn: Optional[Callable[[], float]]) -> None:
+    """Inject a replacement wall clock (``None`` restores
+    ``time.monotonic``).  Ignored while deterministic mode is active."""
+    global _wall
+    _wall = time.monotonic if fn is None else fn
+
+
+# --------------------------------------------------------------------- #
+# enable / disable                                                      #
+# --------------------------------------------------------------------- #
+def enable(deterministic: bool = False) -> None:
+    """Turn telemetry collection on.
+
+    ``deterministic=True`` switches :func:`clock` to the monotone
+    integer sequence (see the module docstring)."""
+    global enabled, _deterministic, _det_seq
+    with _lock:
+        _deterministic = bool(deterministic)
+        if _deterministic:
+            _det_seq = 0
+        enabled = True
+
+
+def disable() -> None:
+    """Turn telemetry collection off (recorded data stays until
+    :func:`reset`; :func:`snapshot` answers ``{}`` while disabled)."""
+    global enabled, _deterministic
+    with _lock:
+        enabled = False
+        _deterministic = False
+
+
+def is_enabled() -> bool:
+    return enabled
+
+
+def is_deterministic() -> bool:
+    return _deterministic
+
+
+def reset() -> None:
+    """Drop all recorded counters, gauges, span aggregates, and events,
+    and rewind the deterministic sequence.  The dispatch counter is NOT
+    touched — it predates telemetry and tests scope it with
+    :func:`counting_dispatches` instead."""
+    global _det_seq
+    with _lock:
+        _counters.clear()
+        _gauges.clear()
+        _spans.clear()
+        _events.clear()
+        _tids.clear()
+        if _trace_buf is not None:
+            _trace_buf.clear()
+        _det_seq = 0
+
+
+# --------------------------------------------------------------------- #
+# emission                                                              #
+# --------------------------------------------------------------------- #
+def _tid() -> int:
+    ident = threading.get_ident()
+    t = _tids.get(ident)
+    if t is None:
+        t = len(_tids) + 1
+        _tids[ident] = t
+    return t
+
+
+def _emit(ev: dict) -> None:
+    """Append one event under the lock: bounded in-memory list, JSONL
+    sink, and the Perfetto buffer when a trace is being collected."""
+    with _lock:
+        if len(_events) < _MAX_EVENTS:
+            _events.append(ev)
+        else:
+            _counters["telemetry.dropped_events"] = (
+                _counters.get("telemetry.dropped_events", 0) + 1
+            )
+        if _jsonl is not None:
+            _jsonl.write(json.dumps(ev, sort_keys=True, default=str) + "\n")
+        if _trace_buf is not None:
+            _trace_buf.append(_trace_event(ev))
+
+
+def _trace_event(ev: dict) -> dict:
+    """Map one telemetry event onto the Chrome/Perfetto trace_event
+    schema (https://docs.google.com/document/d/1CvAClvFfyA5R-PhYUmn5OOQtYMH4h6I0nSsKchNAySU):
+    spans become complete ("X") slices, everything else an instant."""
+    ts = int(ev.get("ts", 0.0) * 1e6)
+    args = {
+        k: v for k, v in ev.items() if k not in ("type", "site", "ts", "dur")
+    }
+    out = {
+        "name": ev.get("site", ev.get("type", "event")),
+        "cat": ev.get("type", "event"),
+        "ts": ts,
+        "tid": ev.get("tid", 0),
+    }
+    if ev.get("type") == "span":
+        out["ph"] = "X"
+        out["dur"] = int(ev.get("dur", 0.0) * 1e6)
+    else:
+        out["ph"] = "i"
+        out["s"] = "t"
+    if args:
+        out["args"] = args
+    return out
+
+
+def record_event(etype: str, site: str = "", **fields) -> None:
+    """Record one instant event (guard incidents, checkpoint saves,
+    compile-cache misses …) of type ``etype``.  No-op while disabled."""
+    if not enabled:
+        return
+    ev = {"type": etype, "site": site, "ts": clock(), "tid": _tid()}
+    ev.update(fields)
+    _emit(ev)
+
+
+def inc(name: str, n: int = 1) -> None:
+    """Add ``n`` to a named counter.  No-op while disabled."""
+    if not enabled:
+        return
+    with _lock:
+        _counters[name] = _counters.get(name, 0) + n
+
+
+def gauge(name: str, value: float) -> None:
+    """Set a named gauge to ``value``.  No-op while disabled.
+
+    While a Perfetto trace is being collected the update also lands on
+    the timeline as a counter ("C") event, so live gauges — e.g. the
+    exact-vs-wire compression ratio — render as a graph over time."""
+    if not enabled:
+        return
+    with _lock:
+        _gauges[name] = value
+        if _trace_buf is not None:
+            _trace_buf.append(
+                {
+                    "name": name,
+                    "ph": "C",
+                    "ts": int(clock() * 1e6),
+                    "tid": 0,
+                    "args": {"value": value},
+                }
+            )
+
+
+def account_bytes(op: str, mode: str, exact_bytes: int, wire_bytes: int) -> None:
+    """Credit one collective's traffic to the exact-vs-wire ledger.
+
+    ``exact_bytes`` is what the payload would cost on the wire as exact
+    f32 (the common denominator the bench suite already reports in);
+    ``wire_bytes`` what the resolved precision mode actually ships.  The
+    per-mode compression ratio is maintained as a live gauge
+    ``comm.wire_ratio.<mode>`` — for ``int8_block`` ring traffic it sits
+    at ``(BLOCK + 4) / (4 * BLOCK)`` = 0.258x (see heat_tpu.comm).
+    No-op while disabled."""
+    if not enabled:
+        return
+    with _lock:
+        _counters[f"comm.collectives.{op}"] = (
+            _counters.get(f"comm.collectives.{op}", 0) + 1
+        )
+        for name, val in (
+            (f"comm.exact_bytes.{mode}", exact_bytes),
+            (f"comm.wire_bytes.{mode}", wire_bytes),
+            ("comm.exact_bytes", exact_bytes),
+            ("comm.wire_bytes", wire_bytes),
+        ):
+            _counters[name] = _counters.get(name, 0) + int(val)
+        exact = _counters[f"comm.exact_bytes.{mode}"]
+        if exact:
+            _gauges[f"comm.wire_ratio.{mode}"] = (
+                _counters[f"comm.wire_bytes.{mode}"] / exact
+            )
+        total_exact = _counters["comm.exact_bytes"]
+        if total_exact:
+            _gauges["comm.wire_ratio"] = _counters["comm.wire_bytes"] / total_exact
+
+
+# --------------------------------------------------------------------- #
+# spans                                                                 #
+# --------------------------------------------------------------------- #
+class _Span:
+    """One ``telemetry.span("site")`` — context manager and decorator.
+
+    Enter/exit are each a single predicate when telemetry is disabled.
+    On exit the span lands twice: in the per-site aggregate (count +
+    total seconds, what ``snapshot()`` reports) and as one event on the
+    stream (what the JSONL sink and the Perfetto exporter consume).
+    Exceptions propagate; the span still records, tagged with the
+    exception type."""
+
+    __slots__ = ("site", "fields", "_t0")
+
+    def __init__(self, site: str, fields: Optional[dict] = None):
+        self.site = site
+        self.fields = fields or None
+        self._t0 = None
+
+    def __enter__(self):
+        if enabled:
+            self._t0 = clock()
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        if self._t0 is None:
+            return False
+        t1 = clock()
+        dur = t1 - self._t0
+        ev = {
+            "type": "span",
+            "site": self.site,
+            "ts": self._t0,
+            "dur": dur,
+            "tid": _tid(),
+        }
+        if self.fields:
+            ev.update(self.fields)
+        if exc_type is not None:
+            ev["error"] = exc_type.__name__
+        with _lock:
+            agg = _spans.get(self.site)
+            if agg is None:
+                _spans[self.site] = [1, dur]
+            else:
+                agg[0] += 1
+                agg[1] += dur
+            _emit(ev)
+        self._t0 = None
+        return False
+
+    def __call__(self, fn):
+        """Decorator form: ``@telemetry.span("site")``.  The wrapper
+        re-checks the flag per call, so decoration at import time with
+        telemetry disabled still records once it is enabled."""
+        site, fields = self.site, self.fields
+
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            if not enabled:
+                return fn(*args, **kwargs)
+            with _Span(site, fields):
+                return fn(*args, **kwargs)
+
+        wrapper.__telemetry_site__ = site
+        return wrapper
+
+
+def span(site: str, **fields) -> _Span:
+    """A host-side timing span — use as a ``with`` block or a decorator.
+
+    NOTE: spans are host-side by construction.  Inside a ``jax.jit`` /
+    ``shard_map`` / ``ht.fuse``-traced function a span measures *trace*
+    time, not run time — spmdlint rule SPMD205 flags that misuse; put
+    spans around the eager call site instead.
+    """
+    return _Span(site, fields or None)
+
+
+# --------------------------------------------------------------------- #
+# reading                                                               #
+# --------------------------------------------------------------------- #
+def events() -> Tuple[dict, ...]:
+    """Snapshot of the recorded event stream (oldest first)."""
+    with _lock:
+        return tuple(_events)
+
+
+def snapshot() -> dict:
+    """The in-memory export: counters, gauges, and per-site span totals.
+
+    Empty dict while telemetry is disabled — the cheap way for callers
+    to branch on "was anything collected"."""
+    if not enabled:
+        return {}
+    with _lock:
+        return {
+            "counters": dict(_counters),
+            "gauges": dict(_gauges),
+            "spans": {
+                site: {"count": int(c), "total_s": t}
+                for site, (c, t) in sorted(_spans.items())
+            },
+            "events": len(_events),
+        }
+
+
+# --------------------------------------------------------------------- #
+# JSONL sink                                                            #
+# --------------------------------------------------------------------- #
+def set_jsonl(path: Optional[str]) -> None:
+    """Stream every subsequent event to ``path`` as one JSON object per
+    line (``None`` closes the sink)."""
+    global _jsonl, _jsonl_path
+    with _lock:
+        if _jsonl is not None:
+            _jsonl.close()
+            _jsonl = None
+            _jsonl_path = None
+        if path is not None:
+            _jsonl = open(path, "a", buffering=1)
+            _jsonl_path = str(path)
+
+
+def jsonl_path() -> Optional[str]:
+    return _jsonl_path
+
+
+# --------------------------------------------------------------------- #
+# dispatch counter (the _tracing shim's backing store)                  #
+# --------------------------------------------------------------------- #
+_dispatches = 0
+
+
+def record_dispatch() -> None:
+    """Count one device program launch.  Always on (tier-1 dispatch-count
+    gates read it through :mod:`heat_tpu.core._tracing` with telemetry
+    disabled); the increment is lock-guarded, so threaded serving does
+    not lose launches.  With telemetry enabled the launch also lands on
+    the ``dispatches`` registry counter."""
+    global _dispatches
+    with _lock:
+        _dispatches += 1
+        if enabled:
+            _counters["dispatches"] = _counters.get("dispatches", 0) + 1
+
+
+def dispatch_count() -> int:
+    """Device program launches recorded since the last reset."""
+    return _dispatches
+
+
+def reset_dispatch_count() -> None:
+    global _dispatches
+    with _lock:
+        _dispatches = 0
+
+
+class _DispatchWindow:
+    """Handle yielded by :func:`counting_dispatches`: ``.count`` is the
+    number of dispatches since the window opened."""
+
+    __slots__ = ("_base",)
+
+    def __init__(self, base: int):
+        self._base = base
+
+    @property
+    def count(self) -> int:
+        return _dispatches - self._base
+
+
+@contextlib.contextmanager
+def counting_dispatches():
+    """Scoped dispatch counting.
+
+    Yields a window whose ``.count`` property reads the launches made
+    since entry — a baseline diff, not a global reset, so concurrent
+    tests (or nested windows) never leak counter state into each other::
+
+        with counting_dispatches() as d:
+            fused_pipeline(x)
+        assert d.count == 1
+    """
+    yield _DispatchWindow(_dispatches)

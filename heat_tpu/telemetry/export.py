@@ -1,0 +1,116 @@
+"""Chrome/Perfetto trace export for the telemetry event stream.
+
+``start_trace(path)`` begins buffering every span, event, and gauge
+update as a Chrome ``trace_event`` record; ``stop_trace()`` writes the
+buffered timeline as trace-event JSON (``{"traceEvents": [...]}``) that
+chrome://tracing and https://ui.perfetto.dev load directly.  Host spans
+carry ``ph="X"`` (complete slices), instant events ``ph="i"``, gauges
+``ph="C"`` (counter tracks) — so one timeline shows the Python
+orchestration layer: compile misses, fuse replays, reshards, ring
+collectives, checkpoint ticks.
+
+Pass ``device_trace_dir=...`` to also run :func:`jax.profiler.trace`
+for the same window: jax writes its own Perfetto file with the XLA
+device timeline under that directory, and loading both into the
+Perfetto UI lines Python orchestration up over device execution.  The
+jax import happens lazily and failures degrade to host-only capture —
+this module stays importable without jax.
+
+``HEAT_TELEMETRY=1`` in the environment enables collection at import
+time; ``HEAT_TELEMETRY_JSONL=<path>`` opens the JSONL sink and
+``HEAT_TELEMETRY_TRACE=<path>`` starts a trace that is flushed at
+process exit — the hooks the CI telemetry lane
+(scripts/run_test_matrix.sh) uses to archive artifacts from an
+otherwise unmodified test run.
+"""
+
+from __future__ import annotations
+
+import atexit
+import json
+import os
+import warnings
+from typing import Optional
+
+from . import _core
+
+__all__ = ["start_trace", "stop_trace", "trace_active"]
+
+_trace_path: Optional[str] = None
+_device_tracing = False
+
+
+def trace_active() -> bool:
+    return _trace_path is not None
+
+
+def start_trace(path: str, device_trace_dir: Optional[str] = None) -> None:
+    """Begin collecting a Chrome/Perfetto trace into ``path``.
+
+    Implicitly enables telemetry (a trace of nothing is useless); the
+    enabled flag stays on after ``stop_trace`` — call
+    :func:`heat_tpu.telemetry.disable` to turn collection back off.
+    """
+    global _trace_path, _device_tracing
+    if _trace_path is not None:
+        raise RuntimeError(f"a trace is already being collected into {_trace_path}")
+    if not _core.enabled:
+        _core.enable()
+    _trace_path = str(path)
+    with _core._lock:
+        _core._trace_buf = []
+    if device_trace_dir is not None:
+        try:
+            import jax
+
+            jax.profiler.start_trace(str(device_trace_dir))
+            _device_tracing = True
+        except Exception as e:  # pragma: no cover - depends on jax build
+            warnings.warn(f"device trace capture unavailable ({e}); host-only trace")
+            _device_tracing = False
+
+
+def stop_trace() -> Optional[str]:
+    """Stop collecting and write the trace-event JSON; returns the path
+    (``None`` when no trace was active)."""
+    global _trace_path, _device_tracing
+    if _device_tracing:
+        _device_tracing = False
+        try:
+            import jax
+
+            jax.profiler.stop_trace()
+        except Exception as e:  # pragma: no cover
+            warnings.warn(f"device trace stop failed ({e})")
+    if _trace_path is None:
+        return None
+    path = _trace_path
+    _trace_path = None
+    with _core._lock:
+        buf, _core._trace_buf = _core._trace_buf, None
+    doc = {
+        "traceEvents": [dict(ev, pid=os.getpid()) for ev in (buf or [])],
+        "displayTimeUnit": "ms",
+    }
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(doc, f)
+    os.replace(tmp, path)  # atomic like every other heat_tpu save
+    return path
+
+
+def _env_autostart() -> None:
+    """The CI-lane hooks (see module docstring)."""
+    if os.environ.get("HEAT_TELEMETRY") == "1":
+        _core.enable()
+    jsonl = os.environ.get("HEAT_TELEMETRY_JSONL")
+    if jsonl:
+        _core.enable()
+        _core.set_jsonl(jsonl)
+    trace = os.environ.get("HEAT_TELEMETRY_TRACE")
+    if trace:
+        start_trace(trace)
+        atexit.register(stop_trace)
+
+
+_env_autostart()

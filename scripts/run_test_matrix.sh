@@ -34,6 +34,23 @@ if ! HEAT_CHAOS_SEED="${HEAT_CHAOS_SEED:-0}" python -m pytest tests/test_resilie
     echo "FAILED chaos lane (reproduce with HEAT_CHAOS_SEED=${HEAT_CHAOS_SEED:-0})"
     fail=1
 fi
+# telemetry lane: a tier-1 smoke slice with collection armed process-wide
+# (HEAT_TELEMETRY=1) — proves the instrumented hot paths stay green with
+# spans/counters live and archives the event stream + Perfetto trace as
+# CI artifacts (docs/design.md §13)
+tel_dir="${HEAT_TELEMETRY_ARTIFACT_DIR:-/tmp/heat-telemetry-artifacts}"
+mkdir -p "$tel_dir"
+echo "=== telemetry lane (HEAT_TELEMETRY=1 smoke; artifacts in $tel_dir) ==="
+if ! HEAT_TELEMETRY=1 \
+     HEAT_TELEMETRY_JSONL="$tel_dir/events.jsonl" \
+     HEAT_TELEMETRY_TRACE="$tel_dir/trace.json" \
+     python -m pytest tests/test_telemetry.py tests/test_fuse.py \
+         tests/test_compressed_collectives.py tests/test_compile_cache.py -q; then
+    echo "FAILED telemetry lane"
+    fail=1
+fi
+echo "--- telemetry artifacts ---"
+ls -l "$tel_dir" 2>/dev/null || true
 for n in "${sizes[@]}"; do
     echo "=== mesh size $n ==="
     if ! HEAT_TEST_DEVICES="$n" python -m pytest tests/ -q -x; then

@@ -472,6 +472,90 @@ def disjoint(x, comm):
 
 
 # --------------------------------------------------------------------- #
+# SPMD205: host timing inside traced functions                           #
+# --------------------------------------------------------------------- #
+def test_spmd205_triggers_on_clock_reads_in_jit():
+    src = """
+import time
+import jax
+
+@jax.jit
+def f(x):
+    t0 = time.perf_counter_ns()
+    y = x * 2
+    t1 = time.process_time()
+    return y, t1 - t0
+"""
+    findings = lint(src, "SPMD205")
+    msgs = " | ".join(f.message for f in findings)
+    assert "time.perf_counter_ns" in msgs and "time.process_time" in msgs
+
+
+def test_spmd205_triggers_on_span_in_shard_map_kernel():
+    src = """
+from jax.experimental.shard_map import shard_map
+from heat_tpu import telemetry
+
+def f(x, mesh, specs):
+    def kernel(s):
+        with telemetry.span("kernel"):
+            return s * 2
+    return shard_map(kernel, mesh=mesh, in_specs=specs, out_specs=specs)(x)
+"""
+    findings = lint(src, "SPMD205")
+    assert findings and "telemetry.span" in findings[0].message
+
+
+def test_spmd205_triggers_inside_jitted_factory():
+    src = """
+import time
+from heat_tpu.core._compile import jitted
+
+def op(x):
+    def make():
+        def fn(a):
+            t = time.monotonic_ns()
+            return a + t
+        return fn
+    return jitted(("op",), make)(x)
+"""
+    findings = lint(src, "SPMD205")
+    assert findings and "time.monotonic_ns" in findings[0].message
+
+
+def test_spmd205_clean_on_host_side_timing():
+    src = """
+import time
+import jax
+from heat_tpu import telemetry
+
+@jax.jit
+def f(x):
+    return x * 2
+
+def timed(x):
+    t0 = time.perf_counter()
+    with telemetry.span("host"):
+        y = f(x)
+    return y, time.perf_counter() - t0
+"""
+    assert lint(src, "SPMD205") == []
+
+
+def test_spmd205_overlaps_spmd201_on_wall_clock():
+    # either rule alone stops the commit; both fire on the shared set
+    src = """
+import time
+import jax
+
+@jax.jit
+def f(x):
+    return x * time.time()
+"""
+    assert rules_of(lint(src)) == ["SPMD201", "SPMD205"]
+
+
+# --------------------------------------------------------------------- #
 # SPMD301/302: Pallas tiling and grids                                   #
 # --------------------------------------------------------------------- #
 def test_spmd301_triggers_on_off_tile_blocks():
@@ -633,7 +717,7 @@ def test_baseline_fingerprint_is_line_insensitive():
 def test_every_rule_is_registered():
     assert [r.id for r in all_rules()] == [
         "SPMD101", "SPMD102", "SPMD201", "SPMD202", "SPMD203", "SPMD204",
-        "SPMD301", "SPMD302", "SPMD401",
+        "SPMD205", "SPMD301", "SPMD302", "SPMD401",
     ]
 
 
