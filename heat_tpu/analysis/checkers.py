@@ -899,6 +899,115 @@ def check_trace_timing(ctx: FileContext) -> Iterable[Finding]:
 
 
 # --------------------------------------------------------------------- #
+# SPMD206: monolithic resplit inside a loop body                         #
+# --------------------------------------------------------------------- #
+#: layout-change entry points whose repeated monolithic execution is the
+#: worst-case pattern: each iteration pays a full GSPMD reshard
+#: (gather+slice envelope) where one hoisted resplit — or the planned
+#: rotation schedule — was expected
+_RESPLIT_CALLS = {"resplit", "resplit_", "alltoall", "commit_split"}
+
+
+def _planned_policy_call(ctx: FileContext, expr: ast.AST, leaf_name: str) -> bool:
+    """True when ``expr`` is a ``redistribution("planned"|"auto")`` /
+    ``set_redistribution("planned"|"auto")`` call (positionally or via
+    ``policy=``) from the comm layer (or a bare name, the fixture/test
+    spelling) — the exemption: under the planner, a loop-body resplit
+    replays one bounded compiled schedule instead of the monolithic
+    worst case."""
+    if not isinstance(expr, ast.Call):
+        return False
+    dotted = ctx.resolve(expr.func) or ""
+    if dotted.rsplit(".", 1)[-1] != leaf_name:
+        return False
+    if not (
+        dotted == leaf_name
+        or "comm" in dotted
+        or "redistribute" in dotted
+        or "heat_tpu" in dotted
+    ):
+        return False
+    policy = expr.args[0] if expr.args else None
+    if policy is None:
+        for kw in expr.keywords:
+            if kw.arg == "policy":
+                policy = kw.value
+    return isinstance(policy, ast.Constant) and policy.value in ("planned", "auto")
+
+
+@rule("SPMD206", "monolithic split→split resplit inside a loop body")
+def check_resplit_in_loop(ctx: FileContext) -> Iterable[Finding]:
+    """A ``resplit``/``alltoall``/``commit_split`` lexically inside a
+    ``for``/``while`` body repeats the framework's single most expensive
+    layout primitive every iteration — under the monolithic policy each
+    pass is a worst-case GSPMD reshard (all-gather + slice envelope,
+    reference ``Alltoallv`` communication.py:764-881).  Almost always
+    the change is loop-invariant and hoists, or belongs under the
+    planned redistribution policy, whose compiled rotation schedule
+    moves ``(p-1)/p²`` of the array per device with bounded peak memory
+    and replays from the program cache.  Exempt when the call sits
+    inside a ``with redistribution("planned"|"auto")`` block or follows
+    a ``set_redistribution("planned"|"auto")`` call in the same scope;
+    traced bodies (jit/shard_map/fuse) are also exempt — there the
+    "call" is a sharding constraint compiled once, not a per-iteration
+    collective."""
+    planned_sets: List[Tuple[ast.AST, int]] = []
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Call) and _planned_policy_call(
+            ctx, node, "set_redistribution"
+        ):
+            encl = ctx.enclosing_functions(node)
+            planned_sets.append((encl[0] if encl else ctx.tree, node.lineno))
+
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        dotted = ctx.resolve(node.func) or ""
+        leaf = dotted.rsplit(".", 1)[-1]
+        if leaf not in _RESPLIT_CALLS:
+            continue
+        # a resplit is a method of a DNDarray/comm object (or the comm
+        # module's function) — a bare local helper named `resplit` is
+        # not the layout primitive
+        if "." not in dotted:
+            continue
+        if ctx.in_traced_context(node):
+            continue
+        in_loop = False
+        exempt = False
+        cur: Optional[ast.AST] = node
+        while cur is not None:
+            cur = ctx.parents.get(cur)
+            if isinstance(cur, (ast.For, ast.AsyncFor, ast.While)):
+                in_loop = True
+            if isinstance(cur, ast.With):
+                for item in cur.items:
+                    if _planned_policy_call(ctx, item.context_expr, "redistribution"):
+                        exempt = True
+                        break
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                break  # loop containment is per-function, not lexical-outward
+        if not in_loop or exempt:
+            continue
+        encl = ctx.enclosing_functions(node)
+        scope = encl[0] if encl else ctx.tree
+        if any(s is scope and ln < node.lineno for s, ln in planned_sets):
+            continue
+        yield ctx.finding(
+            "SPMD206", node,
+            f"monolithic layout change {leaf!r} inside a loop body pays a "
+            "worst-case reshard every iteration",
+            hint="hoist the resplit out of the loop if the layout is "
+            "loop-invariant; otherwise run it under the planned "
+            "redistribution policy (ht.comm.set_redistribution('planned') "
+            "or `with redistribution(\"planned\")`), whose compiled "
+            "schedule is minimal-traffic and memory-bounded — or mark the "
+            "call with `# spmdlint: disable=SPMD206` if the per-iteration "
+            "monolithic reshard is deliberate",
+        )
+
+
+# --------------------------------------------------------------------- #
 # SPMD301/302: Pallas tiling and grids                                   #
 # --------------------------------------------------------------------- #
 @rule("SPMD301", "Pallas BlockSpec tiles must respect the hardware tile grid")

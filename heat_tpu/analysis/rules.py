@@ -11,6 +11,7 @@ SPMD202    no host-sync coercions (float()/.item()/np.asarray) on traced values
 SPMD203    quantized collectives must not carry integer/exact-dtype payloads
 SPMD204    quantized collectives in guard-disabled regions need suppression
 SPMD205    host timing (time.*, telemetry.span) inside traced functions
+SPMD206    monolithic split→split resplit inside a loop body
 SPMD301    Pallas BlockSpec tiles must respect the hardware tile grid
 SPMD302    pallas_call grids must be static (no traced values)
 SPMD401    jitted() cache keys: hashable, identity-stable parts only

@@ -1,4 +1,4 @@
-"""Compressed-collective layer under the comm seam.
+"""Compressed-collective and planned-redistribution layer under the comm seam.
 
 ``ht.comm.set_collective_precision("int8_block")`` flips every eligible
 cross-device combine — the comm layer's ``allreduce``/``allgather``, the
@@ -6,9 +6,26 @@ cross-device combine — the comm layer's ``allreduce``/``allgather``, the
 Lasso / k-means fit loops — onto block-scaled quantized ring collectives
 with no call-site changes.  See :mod:`heat_tpu.comm.compressed` for the
 wire format and the error-feedback machinery.
+
+``ht.comm.set_redistribution("planned")`` routes ``resplit`` /
+``alltoall`` / ``commit_split`` through the redistribution planner
+(:mod:`heat_tpu.comm.redistribute`): every eligible layout change
+compiles to a minimal-traffic, bounded-memory schedule of
+allgather / dynamic-slice / ppermute steps executed as one dispatch
+(arXiv 2112.01075; docs/design.md §14).
 """
 
-from . import compressed
+from . import compressed, redistribute
+from .redistribute import (
+    Plan,
+    get_redistribution,
+    get_redistribution_threshold,
+    monolithic_model,
+    plan,
+    redistribution,
+    set_redistribution,
+    set_redistribution_threshold,
+)
 from .compressed import (
     BLOCK,
     allgather_q,
@@ -28,6 +45,7 @@ from .compressed import (
 
 __all__ = [
     "BLOCK",
+    "Plan",
     "allgather_q",
     "allreduce_q",
     "collective_precision",
@@ -35,11 +53,19 @@ __all__ = [
     "dequantize_blocks",
     "get_collective_precision",
     "get_collective_threshold",
+    "get_redistribution",
+    "get_redistribution_threshold",
+    "monolithic_model",
+    "plan",
     "quantize_blocks",
+    "redistribute",
+    "redistribution",
     "reduce_mode",
     "ring_allgather_q",
     "ring_allreduce_q",
     "ring_allreduce_q_ef",
     "set_collective_precision",
     "set_collective_threshold",
+    "set_redistribution",
+    "set_redistribution_threshold",
 ]

@@ -96,11 +96,17 @@ def cache_stable(fn: Any) -> bool:
     return mod is not None and name is not None and getattr(mod, name, None) is fn
 
 
-def jitted(key: Tuple, make_fn: Callable[[], Callable]) -> Callable:
+def jitted(key: Tuple, make_fn: Callable[[], Callable], jit_kwargs=None) -> Callable:
     """Return a cached ``jax.jit`` of ``make_fn()`` memoized under ``key``.
 
     ``make_fn`` is only invoked on a cache miss; it should return a function
     closing over all static parameters named in ``key``.
+
+    ``jit_kwargs`` (a dict, used only on a miss) passes straight through to
+    :func:`jax.jit` — e.g. ``out_shardings`` where the exact committed spec
+    form matters (the redistribution planner pins its output layout so it
+    compares EQUAL to the monolithic reshard's).  The key must determine the
+    kwargs, exactly as it determines the traced function.
 
     The cached entry is a thin wrapper that records one device dispatch per
     eager invocation (see :mod:`heat_tpu.core._tracing`); calls made while a
@@ -113,7 +119,7 @@ def jitted(key: Tuple, make_fn: Callable[[], Callable]) -> Callable:
     if fn is None:
         if _tel.enabled:
             _tel.inc("compile.cache.misses")
-        jfn = jax.jit(make_fn())
+        jfn = jax.jit(make_fn(), **(jit_kwargs or {}))
         site = key[0] if key and isinstance(key[0], str) else getattr(
             jfn, "__name__", "op"
         )

@@ -99,6 +99,40 @@ def _user_stacklevel() -> int:
     return level
 
 
+#: warning sites already fired this process — keyed (warning kind,
+#: (user filename, user lineno)), so a resplit loop warns ONCE per call
+#: site instead of once per iteration.  Tests clear this set directly.
+_WARNED_SITES: set = set()
+
+
+def _user_site() -> Tuple[int, Tuple[str, int]]:
+    """(stacklevel, (filename, lineno)) of the first frame OUTSIDE the
+    heat_tpu package, counted for a ``warnings.warn`` issued one helper
+    below the warning method (see :func:`_warn_once_per_site`)."""
+    level = 2
+    frame = sys._getframe(2)  # 0=this helper, 1=_warn_once_per_site, 2=the method
+    while frame is not None and os.path.abspath(frame.f_code.co_filename).startswith(
+        _PKG_DIR + os.sep
+    ):
+        frame = frame.f_back
+        level += 1
+    if frame is None:
+        return level, ("<unknown>", 0)
+    return level, (frame.f_code.co_filename, frame.f_lineno)
+
+
+def _warn_once_per_site(message: str, kind: str) -> None:
+    """Warn with :func:`_user_stacklevel`-style attribution, deduplicated
+    per user call site: the first hit from a given (file, line) fires,
+    repeats — a resplit inside a loop body — stay silent."""
+    level, site = _user_site()
+    key = (kind, site)
+    if key in _WARNED_SITES:
+        return
+    _WARNED_SITES.add(key)
+    warnings.warn(message, stacklevel=level)
+
+
 def _nbytes_of(array) -> int:
     """Payload bytes from shape/dtype (tracers lack ``.nbytes``)."""
     elems = 1
@@ -404,12 +438,16 @@ class XlaCommunication(Communication):
         if self.size > 1 and getattr(array, "ndim", 0):
             from ..comm import compressed as _cq
 
+            src = self._split_axis_of(array)
             mode = _cq.reduce_mode(array.dtype, _nbytes_of(array))
             if mode is not None:
-                src = self._split_axis_of(array)
                 if src is not None and int(array.shape[src]) % self.size == 0:
                     return _cq.allgather_q(array, axis=src, comm=self, precision=mode)
-            if _tel.enabled and not isinstance(array, jax.core.Tracer):
+            # ledger + span only when traffic actually moves: an already
+            # replicated input (src None — includes every tracer) makes
+            # the reshard a no-op, and crediting (p-1)/p of its bytes
+            # here overcounted every allgather of replicated data
+            if _tel.enabled and src is not None:
                 _cq._account_wire(
                     "allgather", None, int(np.prod(array.shape)) // self.size, self.size
                 )
@@ -446,19 +484,31 @@ class XlaCommunication(Communication):
                 and array.shape[src] % self.size == 0
             )
             if definitive:
-                warnings.warn(
+                # once per user call site: a resplit loop hits this path
+                # every iteration and per-iteration repeats are noise
+                _warn_once_per_site(
                     f"alltoall: input is split at axis {src}, not recv_axis="
                     f"{recv_axis}; the global result is unaffected (layout is "
                     "a performance hint), but the caller's layout bookkeeping "
                     "may be stale",
-                    stacklevel=_user_stacklevel(),
+                    kind="alltoall-stale-recv",
                 )
-        return self.apply_sharding(array, send_axis)
+        return self.resplit(array, send_axis)
 
     def resplit(self, array: jax.Array, split: Optional[int]) -> jax.Array:
         """Generic reshard (the engine under ``DNDarray.resplit_``,
         reference dndarray.py:2801-2921): split→None is an all-gather,
-        None→split a local slice-discard, split→split an all-to-all."""
+        None→split a local slice-discard, split→split an all-to-all.
+
+        Consults the redistribution policy
+        (:func:`heat_tpu.comm.set_redistribution`): eligible eager
+        changes run the planner's compiled schedule
+        (:mod:`heat_tpu.comm.redistribute`) — same values, bounded peak
+        memory, one dispatch; everything else takes the monolithic GSPMD
+        reshard."""
+        out = self._planned_resplit(array, split, allow_pad=False)
+        if out is not None:
+            return out
         return self.apply_sharding(array, split)
 
     def commit_split(self, array: jax.Array, split: Optional[int]) -> jax.Array:
@@ -466,10 +516,65 @@ class XlaCommunication(Communication):
         form: a ragged target axis pads+shards in ONE step (apply_sharding
         on the ragged view would commit it replicated first); divisible or
         replicated targets take the plain reshard.  The single dispatch
-        site shared by in-place and out-of-place resplit."""
+        site shared by in-place and out-of-place resplit.  Routes through
+        the redistribution planner like :meth:`resplit` (the planner's
+        schedules pad ragged target axes themselves, preserving this
+        method's padded at-rest contract)."""
+        out = self._planned_resplit(array, split, allow_pad=True)
+        if out is not None:
+            return out
         if split is not None and array.ndim and array.shape[split] % max(self.size, 1):
             return self.pad_to_shards(array, axis=split)
         return self.apply_sharding(array, split)
+
+    def _planned_resplit(
+        self, array: jax.Array, split: Optional[int], allow_pad: bool
+    ) -> Optional[jax.Array]:
+        """The redistribution-policy seam: the planned result, or None
+        when this change stays on the monolithic path.
+
+        Fallback (monolithic) whenever the planner cannot improve on or
+        exactly reproduce the GSPMD reshard: policy "monolithic";
+        tracers and fuse traces (layout is a constraint there, not a
+        program); single-device or multi-process meshes; host values;
+        inputs committed on a foreign mesh or non-canonically; ragged
+        destinations when the caller's contract forbids padding
+        (``resplit``/``alltoall`` preserve shape; ``commit_split`` pads).
+        Policy "auto" additionally demands a split→split change of at
+        least :func:`heat_tpu.comm.get_redistribution_threshold` bytes —
+        the regime where the rotation schedule's p× wire saving beats
+        the monolithic reshard's single-collective latency.
+        """
+        from ..comm import redistribute as _rd
+
+        policy = _rd.get_redistribution()
+        if policy == "monolithic" or self.size == 1:
+            return None
+        if isinstance(array, jax.core.Tracer) or in_trace():
+            return None
+        if not isinstance(array, jax.Array) or not getattr(array, "ndim", 0):
+            return None
+        if any(int(s) == 0 for s in array.shape) or jax.process_count() > 1:
+            return None
+        ndim = array.ndim
+        dst = None if split is None else int(split) % ndim
+        src = self._split_axis_of(array)
+        if src is not None and (
+            getattr(array.sharding, "mesh", None) != self._mesh
+            or int(array.shape[src]) % self.size
+        ):
+            return None
+        if src == dst:
+            return None  # no-op: apply_sharding's early-outs are cheaper
+        if dst is not None and not allow_pad and int(array.shape[dst]) % self.size:
+            return None
+        if policy == "auto" and (
+            src is None
+            or dst is None
+            or _nbytes_of(array) < _rd.get_redistribution_threshold()
+        ):
+            return None
+        return _rd.redistribute(array, dst, comm=self, src=src)
 
     def allreduce(self, array: jax.Array, op: str = "sum") -> jax.Array:
         """All-reduce a *per-position* quantity (reference ``Allreduce``,

@@ -51,6 +51,37 @@ if ! HEAT_TELEMETRY=1 \
 fi
 echo "--- telemetry artifacts ---"
 ls -l "$tel_dir" 2>/dev/null || true
+# redistribution lane: the full planned-vs-monolithic parity matrix plus
+# a CPU bench smoke asserting the planner's modeled wire bytes never
+# exceed the monolithic envelope and the modeled peak respects the
+# max_live_bytes bound (docs/design.md §14)
+echo "=== redistribution lane (planner parity matrix + cost-model smoke) ==="
+if ! python -m pytest tests/test_redistribute.py -q; then
+    echo "FAILED redistribution parity matrix"
+    fail=1
+fi
+if ! python - <<'PY'
+from heat_tpu.comm import redistribute as rd
+
+for shape, src, dst, p in [
+    ((2048, 512), 0, 1, 8),
+    ((2048, 512), 1, 0, 4),
+    ((4096, 4096), 0, 1, 2),
+    ((64, 32, 16), 0, 2, 8),
+]:
+    mono = rd.monolithic_model(shape, "float32", src, dst, p)
+    bound = mono["peak_live_bytes"]
+    # plan() raises ValueError if the schedule cannot fit the bound
+    pl = rd.plan(shape, "float32", src, dst, p, max_live_bytes=bound)
+    assert pl.wire_bytes <= mono["wire_bytes"], (shape, src, dst, p)
+    assert pl.peak_live_bytes <= bound, (shape, src, dst, p)
+print("redistribution cost-model smoke: planned wire <= monolithic, "
+      "peak <= max_live_bytes for all probes")
+PY
+then
+    echo "FAILED redistribution cost-model smoke"
+    fail=1
+fi
 for n in "${sizes[@]}"; do
     echo "=== mesh size $n ==="
     if ! HEAT_TEST_DEVICES="$n" python -m pytest tests/ -q -x; then

@@ -152,6 +152,9 @@ def _fake_full_result():
         "allreduce_q_gbps": 212.5,
         "allreduce_exact_gb_per_sec": 80.3,
         "allreduce_q_vs_exact": 2.646,
+        "resplit_gbps": 310.4,
+        "resplit_monolithic_gb_per_sec": 96.7,
+        "resplit_vs_monolithic": 3.21,
         "kmedians_iter_per_sec": 1063.5,
         "kmedians_churn_iter_per_sec": 143.21,
         "kmedoids_iter_per_sec": 10466.7,

@@ -27,6 +27,7 @@ import jax.numpy as jnp
 import heat_tpu as ht
 from heat_tpu.comm import compressed as cq
 from heat_tpu.core import _tracing
+from heat_tpu.core import communication as _comm_mod
 from heat_tpu.core.communication import XlaCommunication
 
 RNG = np.random.default_rng(7)
@@ -369,6 +370,7 @@ def test_alltoall_warning_attributed_to_caller():
     comm = _sub_comm(4)
     data = RNG.normal(size=(8, 8)).astype(np.float32)
     x = comm.apply_sharding(jnp.asarray(data), 0)
+    _comm_mod._WARNED_SITES.clear()  # warning dedups per call site
     with warnings.catch_warnings(record=True) as rec:
         warnings.simplefilter("always")
         comm.alltoall(x, send_axis=1, recv_axis=1)

@@ -1,0 +1,402 @@
+"""Planned redistribution: plan algebra and cost model, bitwise parity vs
+the monolithic reshard across the full src×dst matrix, the one-dispatch
+gate, the peak-live-bytes bound, policy/cache behavior, and the
+satellites that ride along (allgather wire-byte accounting, alltoall
+warning dedup).
+
+Parity is the load-bearing contract: for every (mesh, shape, src, dst)
+the planner's schedule must return the SAME global values as the
+monolithic GSPMD reshard, committed under an EQUAL sharding — callers
+use sharding equality for their no-op early-outs, so "close enough"
+layouts are not enough.
+"""
+
+import warnings
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from heat_tpu import telemetry
+from heat_tpu.comm import compressed as cq
+from heat_tpu.comm import redistribute as rd
+from heat_tpu.core import _tracing
+from heat_tpu.core import communication as comm_mod
+from heat_tpu.core.communication import XlaCommunication
+
+RNG = np.random.default_rng(13)
+
+
+def _sub_comm(k):
+    devs = jax.devices()
+    if len(devs) < k:
+        pytest.skip(f"needs {k} devices")
+    return XlaCommunication(devs[:k])
+
+
+def _committed(comm, data, split):
+    """Commit ``data`` at ``split`` via the monolithic path (fixture prep
+    must not depend on the machinery under test)."""
+    with rd.redistribution("monolithic"):
+        return comm.commit_split(jnp.asarray(data), split)
+
+
+def _parity(comm, data, src, dst, method="resplit"):
+    x = _committed(comm, data, src)
+    op = getattr(comm, method)
+    with rd.redistribution("monolithic"):
+        ref = op(x, dst)
+    with rd.redistribution("planned"):
+        got = op(x, dst)
+    assert got.dtype == ref.dtype
+    assert got.shape == ref.shape
+    assert got.sharding == ref.sharding, (
+        f"sharding mismatch {src}->{dst}: {got.sharding} != {ref.sharding}"
+    )
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(ref))
+    return got
+
+
+# --------------------------------------------------------------------- #
+# the matrix: src×dst over 2-D / 3-D, divisible and ragged, mesh 1..8    #
+# --------------------------------------------------------------------- #
+@pytest.mark.parametrize("mesh_size", [1, 2, 4, 8])
+@pytest.mark.parametrize("src", [None, 0, 1])
+@pytest.mark.parametrize("dst", [None, 0, 1])
+def test_resplit_matrix_2d_divisible(mesh_size, src, dst):
+    comm = _sub_comm(mesh_size)
+    data = RNG.normal(size=(8, 16)).astype(np.float32)
+    _parity(comm, data, src, dst)
+
+
+@pytest.mark.parametrize("mesh_size", [2, 4, 8])
+@pytest.mark.parametrize(
+    "src,dst",
+    [(None, 0), (None, 2), (0, 1), (0, 2), (1, 0), (1, 2), (2, 0), (2, None)],
+)
+def test_resplit_matrix_3d_divisible(mesh_size, src, dst):
+    comm = _sub_comm(mesh_size)
+    data = RNG.normal(size=(16, 8, 24)).astype(np.float32)
+    _parity(comm, data, src, dst)
+
+
+@pytest.mark.parametrize("mesh_size", [2, 4, 8])
+@pytest.mark.parametrize("src,dst", [(None, 0), (None, 1), (0, 1), (0, None)])
+def test_resplit_matrix_2d_ragged(mesh_size, src, dst):
+    """Ragged axes: ``resplit`` preserves the true shape, so a ragged
+    destination falls back to the monolithic reshard — parity must hold
+    either way.  Axis 1 (= 10) is ragged for mesh 4 and 8; axis 0 (= 8)
+    stays divisible so the source commits canonically without padding."""
+    comm = _sub_comm(mesh_size)
+    data = RNG.normal(size=(8, 10)).astype(np.float32)
+    x = _committed(comm, data, src)
+    with rd.redistribution("monolithic"):
+        ref = comm.resplit(x, dst)
+    with rd.redistribution("planned"):
+        got = comm.resplit(x, dst)
+    assert got.shape == (8, 10) and got.sharding == ref.sharding
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(ref))
+
+
+@pytest.mark.parametrize("mesh_size", [2, 4, 8])
+@pytest.mark.parametrize("src,dst", [(None, 1), (0, 1), (0, 2), (None, 0)])
+def test_commit_split_matrix_3d_ragged(mesh_size, src, dst):
+    """``commit_split`` pads a ragged destination axis; the planner's
+    schedules pad it themselves and must match the monolithic padded
+    at-rest form bitwise (including the zero padding)."""
+    comm = _sub_comm(mesh_size)
+    data = RNG.normal(size=(8, 9, 5)).astype(np.float32)
+    if src is not None and data.shape[src] % mesh_size:
+        pytest.skip("source axis must be divisible to commit canonically")
+    _parity(comm, data, src, dst, method="commit_split")
+
+
+@pytest.mark.parametrize("dtype", ["float32", "bfloat16", "int32"])
+def test_resplit_parity_across_dtypes(dtype):
+    comm = _sub_comm(4)
+    data = (RNG.normal(size=(8, 16)) * 100).astype(np.float32)
+    if dtype == "int32":
+        data = data.astype(np.int32)
+    x = jnp.asarray(data).astype(dtype)
+    with rd.redistribution("monolithic"):
+        x = comm.commit_split(x, 0)
+        ref = comm.resplit(x, 1)
+    with rd.redistribution("planned"):
+        got = comm.resplit(x, 1)
+    assert got.dtype == ref.dtype and got.sharding == ref.sharding
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(ref))
+
+
+def test_alltoall_routes_through_planner():
+    comm = _sub_comm(4)
+    data = RNG.normal(size=(8, 16)).astype(np.float32)
+    x = _committed(comm, data, 0)
+    with rd.redistribution("monolithic"):
+        ref = comm.alltoall(x, send_axis=1, recv_axis=0)
+    with rd.redistribution("planned"):
+        got = comm.alltoall(x, send_axis=1, recv_axis=0)
+    assert got.sharding == ref.sharding
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(ref))
+
+
+# --------------------------------------------------------------------- #
+# one compiled dispatch per plan                                         #
+# --------------------------------------------------------------------- #
+@pytest.mark.parametrize("src,dst", [(0, 1), (1, 0), (0, None)])
+def test_planned_resplit_is_one_dispatch(src, dst):
+    comm = _sub_comm(4)
+    data = RNG.normal(size=(8, 16)).astype(np.float32)
+    x = _committed(comm, data, src)
+    with rd.redistribution("planned"):
+        jax.block_until_ready(comm.resplit(x, dst))  # warm the program cache
+        with _tracing.counting_dispatches() as d:
+            out = comm.resplit(x, dst)
+            jax.block_until_ready(out)
+    assert d.count == 1, f"planned {src}->{dst} took {d.count} dispatches"
+
+
+# --------------------------------------------------------------------- #
+# plan algebra and the cost model                                        #
+# --------------------------------------------------------------------- #
+def test_plan_noop_cases_have_empty_schedules():
+    for src, dst, p in [(0, 0, 4), (None, None, 4), (1, 1, 8), (0, 1, 1)]:
+        p_obj = rd.plan((8, 16), "float32", src, dst, p)
+        assert p_obj.steps == () and p_obj.wire_bytes == 0
+
+
+def test_plan_none_to_split_is_wire_free():
+    p_obj = rd.plan((8, 16), "float32", None, 0, 4)
+    assert p_obj.wire_bytes == 0 and p_obj.exact_wire_bytes == 0
+    assert any(s[0] == "slice" for s in p_obj.steps)
+
+
+def test_plan_split_to_split_beats_monolithic_envelope():
+    shape, p = (1024, 1024), 4
+    p_obj = rd.plan(shape, "float32", 0, 1, p)
+    mono = rd.monolithic_model(shape, "float32", 0, 1, p)
+    total = 1024 * 1024 * 4
+    # rotation: p-1 hops of one (total/p²)-sized piece per device
+    assert p_obj.wire_model()["rotate_hops_per_device"] == p - 1
+    assert p_obj.exact_wire_bytes == (p - 1) * total // (p * p)
+    assert p_obj.wire_bytes <= mono["wire_bytes"]
+    assert p_obj.peak_live_bytes <= mono["peak_live_bytes"]
+    assert 0 < p_obj.wire_model()["bytes_ratio"] <= 1.0
+
+
+def test_plan_split_to_none_matches_allgather_wire():
+    shape, p = (64, 32), 8
+    p_obj = rd.plan(shape, "float32", 0, None, p)
+    total = 64 * 32 * 4
+    assert p_obj.exact_wire_bytes == (p - 1) * (total // p)
+
+
+def test_plan_rejects_ragged_source():
+    with pytest.raises(ValueError, match="ragged source"):
+        rd.plan((9, 16), "float32", 0, 1, 4)
+
+
+def test_plan_explain_renders_schedule():
+    text = rd.plan((8, 16), "float32", 0, 1, 4).explain()
+    assert "rotate" in text and "split 0 -> 1" in text
+
+
+def test_plan_cache_hits_and_policy_keying():
+    rd.clear_plan_cache()
+    rd.plan((8, 16), "float32", 0, 1, 4)
+    n = rd.plan_cache_size()
+    rd.plan((8, 16), "float32", 0, 1, 4)
+    assert rd.plan_cache_size() == n  # identical request: cache hit
+    rd.plan((8, 16), "float32", 1, 0, 4)
+    assert rd.plan_cache_size() == n + 1
+
+
+# --------------------------------------------------------------------- #
+# the peak-live-bytes bound                                              #
+# --------------------------------------------------------------------- #
+def test_max_live_bytes_too_small_raises():
+    with pytest.raises(ValueError, match="live"):
+        rd.plan((1024, 1024), "float32", 0, 1, 4, max_live_bytes=100)
+
+
+def test_max_live_bytes_generous_is_respected_end_to_end():
+    comm = _sub_comm(4)
+    data = RNG.normal(size=(64, 64)).astype(np.float32)
+    x = _committed(comm, data, 0)
+    p_obj = rd.plan((64, 64), "float32", 0, 1, 4, max_live_bytes=1 << 20)
+    assert p_obj.peak_live_bytes <= 1 << 20
+    out = rd.execute(x, p_obj, comm)
+    with rd.redistribution("monolithic"):
+        ref = comm.resplit(x, 1)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+
+
+def test_split_to_split_peak_is_two_slabs_plus_piece():
+    shape, p = (256, 256), 4
+    p_obj = rd.plan(shape, "float32", 0, 1, p)
+    total = 256 * 256 * 4
+    slab, piece = total // p, total // (p * p)
+    assert p_obj.peak_live_bytes == 2 * slab + piece
+
+
+# --------------------------------------------------------------------- #
+# the policy knob                                                        #
+# --------------------------------------------------------------------- #
+def test_policy_validation_and_roundtrip():
+    prior = rd.get_redistribution()
+    with pytest.raises(ValueError):
+        rd.set_redistribution("bogus")
+    assert rd.get_redistribution() == prior
+    with rd.redistribution("planned"):
+        assert rd.get_redistribution() == "planned"
+    assert rd.get_redistribution() == prior
+
+
+def test_auto_policy_thresholds_split_to_split():
+    """Under "auto" only eager split→split changes of at least the
+    threshold ride the planner; small arrays keep the monolithic path."""
+    comm = _sub_comm(4)
+    small = _committed(comm, RNG.normal(size=(8, 16)).astype(np.float32), 0)
+    big = _committed(comm, RNG.normal(size=(256, 256)).astype(np.float32), 0)
+    telemetry.enable()
+    telemetry.reset()
+    try:
+        with rd.redistribution("auto"):
+            jax.block_until_ready(comm.resplit(small, 1))
+            counters = telemetry.snapshot()["counters"]
+            assert counters.get("comm.resplit.planned", 0) == 0
+            jax.block_until_ready(comm.resplit(big, 1))
+            counters = telemetry.snapshot()["counters"]
+            assert counters.get("comm.resplit.planned", 0) == 1
+    finally:
+        telemetry.reset()
+        telemetry.disable()
+
+
+def test_planned_resplit_accounts_wire_bytes_and_span():
+    comm = _sub_comm(4)
+    x = _committed(comm, RNG.normal(size=(64, 64)).astype(np.float32), 0)
+    p_obj = rd.plan((64, 64), "float32", 0, 1, 4)
+    telemetry.enable()
+    telemetry.reset()
+    try:
+        with rd.redistribution("planned"):
+            jax.block_until_ready(comm.resplit(x, 1))
+        snap = telemetry.snapshot()
+        assert snap["counters"]["comm.wire_bytes"] == p_obj.wire_bytes
+        assert snap["counters"]["comm.exact_bytes"] == p_obj.exact_wire_bytes
+        assert snap["counters"]["comm.collectives.resplit"] == 1
+        assert "comm:resplit" in snap["spans"]
+    finally:
+        telemetry.reset()
+        telemetry.disable()
+
+
+# --------------------------------------------------------------------- #
+# compressed steps ride the collective-precision policy                  #
+# --------------------------------------------------------------------- #
+def test_compressed_resplit_error_bound():
+    """Each rotated piece is quantized once (one encode/decode per hop,
+    no accumulation), so the element-wise error of an int8_block planned
+    resplit is bounded by one quantization step: absmax/254."""
+    comm = _sub_comm(4)
+    data = RNG.normal(size=(256, 256)).astype(np.float32)
+    x = _committed(comm, data, 0)
+    prior = cq.get_collective_threshold()
+    cq.set_collective_threshold(0)
+    try:
+        with rd.redistribution("planned"), cq.collective_precision("int8_block"):
+            p_obj = rd.plan((256, 256), "float32", 0, 1, 4)
+            assert p_obj.mode == "int8_block"
+            assert p_obj.wire_bytes < p_obj.exact_wire_bytes
+            got = comm.resplit(x, 1)
+    finally:
+        cq.set_collective_threshold(prior)
+    assert got.dtype == x.dtype
+    bound = float(np.max(np.abs(data))) / 254.0 + 1e-6
+    err = float(np.max(np.abs(np.asarray(got, dtype=np.float64) - data)))
+    assert err <= bound, f"err {err} > bound {bound}"
+
+
+def test_exact_mode_plans_are_bitwise_by_construction():
+    p_obj = rd.plan((8, 16), "float32", 0, 1, 4)
+    assert p_obj.mode is None  # default f32 policy: exact wire, bitwise parity
+    assert p_obj.wire_bytes == p_obj.exact_wire_bytes
+
+
+# --------------------------------------------------------------------- #
+# satellite: allgather wire-byte accounting (no-op must not be credited) #
+# --------------------------------------------------------------------- #
+def test_allgather_of_replicated_input_accounts_nothing():
+    comm = _sub_comm(4)
+    x = _committed(comm, RNG.normal(size=(8, 16)).astype(np.float32), None)
+    telemetry.enable()
+    telemetry.reset()
+    try:
+        jax.block_until_ready(comm.allgather(x))
+        snap = telemetry.snapshot()
+        assert snap["counters"].get("comm.collectives.allgather", 0) == 0
+        assert snap["counters"].get("comm.wire_bytes", 0) == 0
+        assert "comm:allgather" not in snap["spans"]
+    finally:
+        telemetry.reset()
+        telemetry.disable()
+
+
+def test_allgather_of_split_input_accounts_traffic():
+    comm = _sub_comm(4)
+    x = _committed(comm, RNG.normal(size=(8, 16)).astype(np.float32), 0)
+    telemetry.enable()
+    telemetry.reset()
+    try:
+        jax.block_until_ready(comm.allgather(x))
+        snap = telemetry.snapshot()
+        assert snap["counters"]["comm.collectives.allgather"] == 1
+        assert snap["counters"]["comm.wire_bytes"] > 0
+        assert "comm:allgather" in snap["spans"]
+    finally:
+        telemetry.reset()
+        telemetry.disable()
+
+
+# --------------------------------------------------------------------- #
+# satellite: alltoall stale-layout warning fires once per call site      #
+# --------------------------------------------------------------------- #
+def _stale_alltoall(comm, x):
+    return comm.alltoall(x, send_axis=1, recv_axis=1)
+
+
+def test_alltoall_stale_warning_dedups_per_site():
+    comm = _sub_comm(2)
+    x = _committed(comm, RNG.normal(size=(4, 6)).astype(np.float32), 0)
+    comm_mod._WARNED_SITES.clear()
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        for _ in range(5):  # one site, five calls: exactly one warning
+            _stale_alltoall(comm, x)
+    stale = [m for m in w if "layout bookkeeping" in str(m.message)]
+    assert len(stale) == 1
+    assert stale[0].filename == __file__  # attributed to the caller, not comm
+
+
+def test_alltoall_stale_warning_fires_again_at_a_new_site():
+    comm = _sub_comm(2)
+    x = _committed(comm, RNG.normal(size=(4, 6)).astype(np.float32), 0)
+    comm_mod._WARNED_SITES.clear()
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        _stale_alltoall(comm, x)          # site A
+        comm.alltoall(x, send_axis=1, recv_axis=1)  # site B: distinct line
+    stale = [m for m in w if "layout bookkeeping" in str(m.message)]
+    assert len(stale) == 2
+
+
+def test_alltoall_consistent_layout_never_warns():
+    comm = _sub_comm(2)
+    x = _committed(comm, RNG.normal(size=(4, 6)).astype(np.float32), 0)
+    comm_mod._WARNED_SITES.clear()
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        comm.alltoall(x, send_axis=1, recv_axis=0)  # recv matches the layout
+    assert [m for m in w if "layout bookkeeping" in str(m.message)] == []

@@ -556,6 +556,105 @@ def f(x):
 
 
 # --------------------------------------------------------------------- #
+# SPMD206: monolithic resplit inside a loop body                         #
+# --------------------------------------------------------------------- #
+def test_spmd206_triggers_on_resplit_in_for_loop():
+    src = """
+def pipeline(x, comm):
+    for _ in range(8):
+        x = comm.resplit(x, 1)
+    return x
+"""
+    findings = lint(src, "SPMD206")
+    assert findings and "resplit" in findings[0].message
+    assert "planned" in findings[0].hint
+
+
+def test_spmd206_triggers_on_alltoall_in_while_loop():
+    src = """
+def pump(arr, comm):
+    while arr.converged() is False:
+        arr = comm.alltoall(arr, send_axis=1, recv_axis=0)
+    return arr
+"""
+    findings = lint(src, "SPMD206")
+    assert findings and "alltoall" in findings[0].message
+
+
+def test_spmd206_triggers_on_dndarray_method_resplit():
+    src = """
+def epoch(batches):
+    for b in batches:
+        b.resplit_(0)
+        yield b
+"""
+    assert lint(src, "SPMD206")
+
+
+def test_spmd206_clean_under_planned_policy():
+    src = """
+from heat_tpu.comm import redistribution, set_redistribution
+
+def with_block(x, comm):
+    with redistribution("planned"):
+        for _ in range(8):
+            x = comm.resplit(x, 1)
+    return x
+
+def with_setter(x, comm):
+    set_redistribution("auto")
+    for _ in range(8):
+        x = comm.alltoall(x, send_axis=1, recv_axis=0)
+    return x
+"""
+    assert lint(src, "SPMD206") == []
+
+
+def test_spmd206_clean_outside_loops_and_in_traced_bodies():
+    src = """
+import jax
+
+def once(x, comm):
+    return comm.resplit(x, 1)
+
+def loop_then_resplit(xs, comm):
+    for x in xs:
+        pass
+    return comm.commit_split(xs[0], 0)
+
+@jax.jit
+def traced(x, comm):
+    for _ in range(4):
+        x = comm.resplit(x, 1)
+    return x
+"""
+    assert lint(src, "SPMD206") == []
+
+
+def test_spmd206_monolithic_policy_does_not_exempt():
+    src = """
+from heat_tpu.comm import redistribution
+
+def shuffle(x, comm):
+    with redistribution("monolithic"):
+        for _ in range(8):
+            x = comm.resplit(x, 1)
+    return x
+"""
+    assert lint(src, "SPMD206")
+
+
+def test_spmd206_suppression_comment_silences():
+    src = """
+def shuffle(x, comm):
+    for _ in range(8):
+        x = comm.resplit(x, 1)  # spmdlint: disable=SPMD206
+    return x
+"""
+    assert lint(src, "SPMD206") == []
+
+
+# --------------------------------------------------------------------- #
 # SPMD301/302: Pallas tiling and grids                                   #
 # --------------------------------------------------------------------- #
 def test_spmd301_triggers_on_off_tile_blocks():
@@ -717,7 +816,7 @@ def test_baseline_fingerprint_is_line_insensitive():
 def test_every_rule_is_registered():
     assert [r.id for r in all_rules()] == [
         "SPMD101", "SPMD102", "SPMD201", "SPMD202", "SPMD203", "SPMD204",
-        "SPMD205", "SPMD301", "SPMD302", "SPMD401",
+        "SPMD205", "SPMD206", "SPMD301", "SPMD302", "SPMD401",
     ]
 
 
