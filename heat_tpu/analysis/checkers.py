@@ -1008,6 +1008,110 @@ def check_resplit_in_loop(ctx: FileContext) -> Iterable[Finding]:
 
 
 # --------------------------------------------------------------------- #
+# SPMD207: silent broad except around dispatch/collective/io sites       #
+# --------------------------------------------------------------------- #
+#: exception leaves that catch "anything that can go wrong at a guarded
+#: site" — the fault classes the resilience layer exists to make visible
+_BROAD_EXC = {"Exception", "BaseException", "OSError", "IOError",
+              "EnvironmentError"}
+
+#: call leaves whose failures must never vanish: file opens/loads/saves,
+#: checkpoint and loop-snapshot manifests, layout changes, collectives
+_GUARDED_SITE_CALLS = {
+    "open", "File", "Dataset",
+    "load", "save", "load_hdf5", "save_hdf5", "load_netcdf", "save_netcdf",
+    "load_csv", "save_csv", "load_loop_state", "save_loop_state",
+    "load_estimator", "save_estimator",
+    "resplit", "resplit_", "commit_split", "apply_sharding", "redistribute",
+    "alltoall", "allreduce", "allgather", "all_gather", "ppermute", "psum",
+}
+
+
+def _broad_handler_names(ctx: FileContext, handler: ast.ExceptHandler) -> List[str]:
+    """The broad exception leaves a handler catches (empty = narrow)."""
+    t = handler.type
+    if t is None:
+        return ["(bare except)"]
+    elts = t.elts if isinstance(t, ast.Tuple) else [t]
+    out = []
+    for e in elts:
+        dotted = ctx.resolve(e) or ""
+        leaf = dotted.rsplit(".", 1)[-1]
+        if leaf in _BROAD_EXC:
+            out.append(leaf)
+    return out
+
+
+def _handler_is_silent(ctx: FileContext, handler: ast.ExceptHandler) -> bool:
+    """True when nothing in the handler body makes the fault visible: no
+    re-raise, no reference to the caught exception (the deferred-error
+    barrier pattern binds it — ``err = e``), no incident record, no
+    warning/log call."""
+    caught = handler.name
+    for stmt in handler.body:
+        for sub in ast.walk(stmt):
+            if isinstance(sub, ast.Raise):
+                return False
+            if caught and isinstance(sub, ast.Name) and sub.id == caught:
+                return False
+            if isinstance(sub, ast.Call):
+                dotted = ctx.resolve(sub.func) or ""
+                leaf = dotted.rsplit(".", 1)[-1]
+                if leaf == "record" or "incident" in dotted:
+                    return False
+                if leaf in ("warn", "warning", "error", "exception", "critical"):
+                    return False
+    return True
+
+
+@rule("SPMD207", "silent broad except around dispatch/collective/io sites")
+def check_silent_broad_except(ctx: FileContext) -> Iterable[Finding]:
+    """A ``try`` whose body touches a dispatch, collective, or io site
+    (file opens/loads/saves, checkpoint manifests, resplits, ring
+    collectives) with an ``except Exception``/``except OSError`` handler
+    that neither re-raises, nor references the caught exception (the
+    deferred-error barrier pattern — ``err = e`` past a collective
+    fence), nor records an incident, makes the fault *invisible*: the
+    fit continues on garbage, the chaos lane can't see the injection,
+    and the retry/elastic machinery never engages.  Transient faults
+    belong on the retry engine (``resilience.retry``); real failures
+    belong in the incident log (``resilience.incidents.record``) or
+    propagated."""
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Try):
+            continue
+        guarded_leaf = None
+        for stmt in node.body:
+            for sub in ast.walk(stmt):
+                if not isinstance(sub, ast.Call):
+                    continue
+                dotted = ctx.resolve(sub.func) or ""
+                leaf = dotted.rsplit(".", 1)[-1]
+                if leaf in _GUARDED_SITE_CALLS:
+                    guarded_leaf = leaf
+                    break
+            if guarded_leaf:
+                break
+        if guarded_leaf is None:
+            continue
+        for handler in node.handlers:
+            broad = _broad_handler_names(ctx, handler)
+            if not broad or not _handler_is_silent(ctx, handler):
+                continue
+            yield ctx.finding(
+                "SPMD207", handler,
+                f"broad `except {broad[0]}` swallows failures of guarded "
+                f"site {guarded_leaf!r} without re-raise or incident "
+                "record — the fault becomes invisible",
+                hint="re-raise after cleanup, bind and defer the exception "
+                "past the barrier (err = e), route transients through "
+                "resilience.retry, or record it with "
+                "resilience.incidents.record(...); mark the handler with "
+                "`# spmdlint: disable=SPMD207` if the swallow is deliberate",
+            )
+
+
+# --------------------------------------------------------------------- #
 # SPMD301/302: Pallas tiling and grids                                   #
 # --------------------------------------------------------------------- #
 @rule("SPMD301", "Pallas BlockSpec tiles must respect the hardware tile grid")

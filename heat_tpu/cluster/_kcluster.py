@@ -124,13 +124,14 @@ class _KCluster(ClusteringMixin, BaseEstimator):
         self._inertia = None
         self._n_iter = None
 
-    def _checkpointer(self, algo: str, meta: dict):
+    def _checkpointer(self, algo: str, meta: dict, comm=None, splits=None):
         """The loop-snapshot driver for resumable fits (KMeans; the other
         k-clusterers accept the parameters but run unsegmented)."""
         from ..resilience.resume import LoopCheckpointer
 
         return LoopCheckpointer(
-            self.checkpoint_path, self.checkpoint_every, algo, meta
+            self.checkpoint_path, self.checkpoint_every, algo, meta,
+            comm=comm, splits=splits,
         )
 
     def _checkpoint_attrs(self):
@@ -170,8 +171,12 @@ class _KCluster(ClusteringMixin, BaseEstimator):
             self._cluster_centers = self.init.resplit(None)
             return
         if self.init == "random":
-            # uniform sampling of k distinct rows (reference :82-117)
-            idx = random.randperm(x.shape[0])[: self.n_clusters]
+            # uniform sampling of k distinct rows (reference :82-117);
+            # draws land on x's communicator so sub-mesh fits (elastic
+            # recovery on a shrunk device set) don't mix device sets
+            idx = random.randperm(
+                x.shape[0], device=x.device, comm=x.comm
+            )[: self.n_clusters]
             centers = x.larray[idx.larray]
             self._cluster_centers = DNDarray(
                 x.comm.apply_sharding(centers, None),
@@ -192,8 +197,10 @@ class _KCluster(ClusteringMixin, BaseEstimator):
             arr = x.larray.astype(jnp.float32)
             n = arr.shape[0]
 
-            first = random.randint(0, n, (1,)).larray[0]
-            us = random.rand(self.n_clusters).larray.astype(jnp.float32)
+            first = random.randint(0, n, (1,), device=x.device, comm=x.comm).larray[0]
+            us = random.rand(
+                self.n_clusters, device=x.device, comm=x.comm
+            ).larray.astype(jnp.float32)
             rep_sh = x.comm.sharding(1, None) if x.comm.size > 1 else None
             carr = _kmeanspp(arr, first, us, rep_sh=rep_sh).astype(x.dtype.jax_type())
             self._cluster_centers = DNDarray(

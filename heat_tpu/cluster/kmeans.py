@@ -124,7 +124,7 @@ class KMeans(_KCluster):
         inertia = jnp.sum((arr - centers[labels]) ** 2)
         return labels, inertia
 
-    def fit(self, x: DNDarray, resume: bool = False) -> "KMeans":
+    def fit(self, x: DNDarray, resume=False) -> "KMeans":
         """Lloyd iterations until centroid shift ≤ tol (reference
         kmeans.py:87-120), as a single on-device loop.
 
@@ -132,7 +132,10 @@ class KMeans(_KCluster):
         of the same compiled program, snapshotting the carry between
         segments; ``resume=True`` restarts from the snapshot (skipping
         center initialization) and finishes bitwise-identical to an
-        uninterrupted fit.
+        uninterrupted fit.  ``resume="elastic"`` additionally accepts a
+        snapshot taken at a different mesh size, migrating the stacked
+        error-feedback residual to the current mesh (device loss: shrink
+        the mesh, rebuild the inputs, resume).
         """
         sanitize_in(x)
         if x.ndim != 2:
@@ -152,16 +155,22 @@ class KMeans(_KCluster):
             mode = _cq.reduce_mode(jnp.float32, k * f * 4)
         use_q = mode is not None
 
+        from ..resilience import elastic as _elastic
+
         meta = {
             "n": n, "f": f, "k": k, "tol": float(self.tol),
             "max_iter": int(self.max_iter),
         }
+        splits = {"it": None, "centers": None, "shift": None}
         if use_q:
-            meta.update(mesh=comm.size, mode=mode)
-        ckpt = self._checkpointer("kmeans-q" if use_q else "kmeans", meta)
+            meta.update(mode=mode)
+            splits["error"] = "mesh"
+        ckpt = self._checkpointer(
+            "kmeans-q" if use_q else "kmeans", meta, comm=comm, splits=splits
+        )
 
         if resume:
-            state, _ = ckpt.load()
+            state, _ = ckpt.load(elastic=resume == "elastic")
             carry = (
                 jnp.int32(state["it"]),
                 jnp.asarray(state["centers"], jnp.float32),
@@ -180,12 +189,15 @@ class KMeans(_KCluster):
         while True:
             it0 = int(carry[0])
             stop = ckpt.stop(it0, self.max_iter)
-            if use_q:
-                carry = _kmeans_segment_q(
-                    arr, tol, jnp.int32(stop), carry, comm=comm, mode=mode
-                )
-            else:
-                carry = KMeans._fit_segment(arr, tol, jnp.int32(stop), carry)
+            with _elastic.dispatch_guard(
+                "kmeans.seg_q" if use_q else "kmeans.seg", comm
+            ):
+                if use_q:
+                    carry = _kmeans_segment_q(
+                        arr, tol, jnp.int32(stop), carry, comm=comm, mode=mode
+                    )
+                else:
+                    carry = KMeans._fit_segment(arr, tol, jnp.int32(stop), carry)
             it = int(carry[0])
             if use_q and _tel.enabled and it > it0:
                 from ..comm import compressed as _cq

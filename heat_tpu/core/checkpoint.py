@@ -323,9 +323,14 @@ def load_estimator(path: str) -> BaseEstimator:
         raise RuntimeError("h5py is required for estimator checkpointing")
     import h5py
 
-    _io._faults().io_open(path)
+    def _open():
+        _io._faults().io_open(path)
+        return h5py.File(path, "r")
+
     try:
-        f = h5py.File(path, "r")
+        # transient EIO at the open heals under the bounded, seeded retry
+        # policy; only an exhausted policy surfaces as the ValueError below
+        f = _io._retry_open(_open, "checkpoint.load_estimator")
     except OSError as e:
         raise ValueError(
             f"{path} is not a readable estimator checkpoint (missing, "

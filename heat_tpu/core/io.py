@@ -90,6 +90,17 @@ def _faults():
     return faults
 
 
+def _retry_open(fn, site: str):
+    """Run a file-open probe under the bounded, seeded io retry policy:
+    a transient ``OSError`` (flaky NFS, a file mid-failover, an injected
+    ``io_error`` fault) heals on retry with every attempt incident-logged
+    and counted; only an exhausted policy propagates.  Lazy import for
+    the same one-way-dependency reason as :func:`_faults`."""
+    from ..resilience import retry as _r
+
+    return _r.call(fn, policy=_r.IO_POLICY, site=site)
+
+
 # --------------------------------------------------------------------- #
 # atomic writes                                                          #
 # --------------------------------------------------------------------- #
@@ -160,10 +171,12 @@ def load_hdf5(
         raise TypeError(f"dataset must be str, not {type(dataset)}")
     dtype = types.canonical_heat_type(dtype)
 
-    _faults().io_open(path)
-    with h5py.File(path, "r") as handle:
-        data = handle[dataset]
-        gshape = tuple(data.shape)
+    def _probe():
+        _faults().io_open(path)
+        with h5py.File(path, "r") as handle:
+            return tuple(handle[dataset].shape)
+
+    gshape = _retry_open(_probe, "io.load_hdf5")
 
     np_dtype = np.dtype(dtype._np_type)
 
@@ -379,22 +392,27 @@ def load_netcdf(
     dtype = types.canonical_heat_type(dtype)
     np_dtype = np.dtype(dtype._np_type)
 
-    _faults().io_open(path)
     if nc is not None:
-        with nc.Dataset(path, "r") as handle:
-            gshape = tuple(handle.variables[variable].shape)
+        def _probe():
+            _faults().io_open(path)
+            with nc.Dataset(path, "r") as handle:
+                return tuple(handle.variables[variable].shape)
 
         def read_slices(index):
             with nc.Dataset(path, "r") as f:
                 return np.asarray(f.variables[variable][index], dtype=np_dtype)
 
     else:
-        with _scipy_nc(path, "r", mmap=False) as handle:
-            gshape = tuple(handle.variables[variable].shape)
+        def _probe():
+            _faults().io_open(path)
+            with _scipy_nc(path, "r", mmap=False) as handle:
+                return tuple(handle.variables[variable].shape)
 
         def read_slices(index):
             with _scipy_nc(path, "r", mmap=False) as f:
                 return np.array(f.variables[variable][index], dtype=np_dtype)
+
+    gshape = _retry_open(_probe, "io.load_netcdf")
 
     return _sharded_from_reader(gshape, dtype, split, device, comm, read_slices)
 

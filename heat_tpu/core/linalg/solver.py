@@ -141,7 +141,7 @@ def lanczos(
     T_out: Optional[DNDarray] = None,
     checkpoint_every: int = 0,
     checkpoint_path: Optional[str] = None,
-    resume: bool = False,
+    resume=False,
 ) -> Tuple[DNDarray, DNDarray]:
     """Lanczos tridiagonalization with full re-orthogonalization
     (reference solver.py:74-184).  Returns (V, T) with ``T = V.T A V``
@@ -158,6 +158,9 @@ def lanczos(
     breakdown-restart matrix, so restart draws replay too) to
     ``checkpoint_path`` between segments; ``resume=True`` restarts from
     the snapshot and finishes bitwise-identical to an uninterrupted run.
+    ``resume="elastic"`` additionally accepts a snapshot taken at a
+    different mesh size (the Lanczos carry is replicated, so migration
+    is a pass-through).
     """
     sanitize_in(A)
     if A.ndim != 2 or A.shape[0] != A.shape[1]:
@@ -169,13 +172,17 @@ def lanczos(
     arr = A.larray.astype(jnp.float32 if types.heat_type_is_exact(A.dtype) else A.larray.dtype)
 
     from .. import random
+    from ...resilience import elastic as _elastic
     from ...resilience.resume import LoopCheckpointer
 
     ckpt = LoopCheckpointer(
-        checkpoint_path, checkpoint_every, "lanczos", {"n": int(n), "m": int(m)}
+        checkpoint_path, checkpoint_every, "lanczos",
+        {"n": int(n), "m": int(m)}, comm=A.comm,
+        splits={"i": None, "V": None, "T": None, "w": None,
+                "v_prev": None, "R": None},
     )
     if resume:
-        state, _ = ckpt.load()
+        state, _ = ckpt.load(elastic=resume == "elastic")
         R = jnp.asarray(state["R"], jnp.float32)
         carry = (
             jnp.asarray(state["V"], arr.dtype),
@@ -186,7 +193,11 @@ def lanczos(
         it = int(state["i"])
     else:
         if v0 is None:
-            v = random.rand(n, dtype=types.float32, device=A.device).larray
+            # draws land on A's communicator so sub-mesh fits (elastic
+            # recovery on a shrunk device set) don't mix device sets
+            v = random.rand(
+                n, dtype=types.float32, device=A.device, comm=A.comm
+            ).larray
             v = v / jnp.linalg.norm(v)
         else:
             sanitize_in(v0)
@@ -194,7 +205,9 @@ def lanczos(
         v = v.astype(arr.dtype)
         # breakdown-restart candidates, one per iteration (drawn per fit,
         # used on device only when the matching step actually breaks down)
-        R = random.rand(n, m, dtype=types.float32, device=A.device).larray
+        R = random.rand(
+            n, m, dtype=types.float32, device=A.device, comm=A.comm
+        ).larray
 
         V = jnp.zeros((n, m), dtype=arr.dtype).at[:, 0].set(v)
         w0 = arr @ v
@@ -205,7 +218,8 @@ def lanczos(
 
     while it < m:
         stop = ckpt.stop(it, m)
-        carry = _lanczos_segment(arr, R, jnp.int32(it), jnp.int32(stop), carry)
+        with _elastic.dispatch_guard("lanczos.seg", A.comm):
+            carry = _lanczos_segment(arr, R, jnp.int32(it), jnp.int32(stop), carry)
         it = stop
         if it >= m:
             break

@@ -104,15 +104,17 @@ class Lasso(RegressionMixin, BaseEstimator):
         diff = gt.larray.reshape(-1) - yest.larray.reshape(-1)
         return float(jnp.sqrt(jnp.mean(diff * diff)))
 
-    def _checkpointer(self, algo: str, meta: dict):
+    def _checkpointer(self, algo: str, meta: dict, comm=None, splits=None):
         """The segmentation driver for this fit configuration."""
         from ..resilience.resume import LoopCheckpointer
 
         return LoopCheckpointer(
-            self.checkpoint_path, self.checkpoint_every, algo, meta
+            self.checkpoint_path, self.checkpoint_every, algo, meta,
+            comm=comm, splits=splits,
         )
 
-    def fit(self, x: DNDarray, y: DNDarray, resume: bool = False) -> "Lasso":
+    def fit(self, x: DNDarray, y: DNDarray,
+            resume: Union[bool, str] = False) -> "Lasso":
         """Cyclic coordinate descent (reference lasso.py:104-156).
 
         The per-coordinate update loop is expressed as ``lax.fori_loop``
@@ -124,6 +126,10 @@ class Lasso(RegressionMixin, BaseEstimator):
         segments of the same compiled program, snapshotting the carry
         between segments; ``resume=True`` restarts from the snapshot and
         finishes bitwise-identical to an uninterrupted fit.
+        ``resume="elastic"`` additionally accepts a snapshot taken at a
+        *different* mesh size — the sharded carry entries migrate to the
+        current mesh through the planned-redistribution pipeline (device
+        loss: shrink the mesh, rebuild the inputs, resume).
         """
         sanitize_in(x)
         sanitize_in(y)
@@ -141,17 +147,19 @@ class Lasso(RegressionMixin, BaseEstimator):
         if self.solver == "gd":
             theta, n_iter = self._fit_gd(x, arr, yv, resume)
         else:
-            theta, n_iter = self._fit_cd(arr, yv, resume)
+            theta, n_iter = self._fit_cd(arr, yv, resume, comm=x.comm)
         self.n_iter = int(n_iter)
         self.__theta = factories.array(
             np.asarray(theta).reshape(-1, 1), dtype=types.float32, device=x.device, comm=x.comm
         )
         return self
 
-    def _fit_cd(self, arr, yv, resume: bool):
+    def _fit_cd(self, arr, yv, resume, comm=None):
         """Segment-driven coordinate descent: the plain fit is one
         segment with ``stop = max_iter``, a checkpointed fit re-enters
         the same compiled program every ``checkpoint_every`` sweeps."""
+        from ..resilience import elastic as _elastic
+
         m = int(arr.shape[1])
         ckpt = self._checkpointer(
             "lasso-cd",
@@ -159,9 +167,11 @@ class Lasso(RegressionMixin, BaseEstimator):
                 "n": int(arr.shape[0]), "m": m, "lam": float(self.__lam),
                 "tol": float(self.tol), "max_iter": int(self.max_iter),
             },
+            comm=comm,
+            splits={"it": None, "theta": None, "delta": None},
         )
         if resume:
-            state, _ = ckpt.load()
+            state, _ = ckpt.load(elastic=resume == "elastic")
             carry = (
                 jnp.int32(state["it"]),
                 jnp.asarray(state["theta"], jnp.float32),
@@ -173,7 +183,8 @@ class Lasso(RegressionMixin, BaseEstimator):
         while True:
             it0 = int(carry[0])
             stop = ckpt.stop(it0, self.max_iter)
-            carry = Lasso._fit_segment(arr, yv, lam, tol, jnp.int32(stop), carry)
+            with _elastic.dispatch_guard("lasso.cd", comm):
+                carry = Lasso._fit_segment(arr, yv, lam, tol, jnp.int32(stop), carry)
             it = int(carry[0])
             if it >= self.max_iter or it < stop:
                 # out of iterations, or converged before the boundary
@@ -229,7 +240,7 @@ class Lasso(RegressionMixin, BaseEstimator):
 
         return lax.while_loop(cond, body_sweep, carry)
 
-    def _fit_gd(self, x: DNDarray, arr, yv, resume: bool = False):
+    def _fit_gd(self, x: DNDarray, arr, yv, resume=False):
         """Proximal-gradient (ISTA) fit: θ ← prox_{sλ}(θ − s∇f(θ)) with
         step ``s = 1/L`` from power iteration.  When the
         collective-precision policy compresses and the rows split
@@ -239,6 +250,8 @@ class Lasso(RegressionMixin, BaseEstimator):
         compiled program.  Both forms run segment-by-segment under
         ``checkpoint_every`` (the quantized form snapshots the EF
         residual as part of the carry)."""
+        from ..resilience import elastic as _elastic
+
         n, m = int(arr.shape[0]), int(arr.shape[1])
         step = jnp.float32(1.0) / Lasso._lipschitz(arr)
         lam = jnp.float32(self.__lam)
@@ -248,16 +261,19 @@ class Lasso(RegressionMixin, BaseEstimator):
             "n": n, "m": m, "lam": float(self.__lam), "tol": float(self.tol),
             "max_iter": int(self.max_iter),
         }
+        elastic = resume == "elastic"
         if x.split == 0 and comm.size > 1 and n % comm.size == 0:
             from ..comm import compressed as _cq
 
             mode = _cq.reduce_mode(jnp.float32, m * 4)
             if mode is not None:
                 ckpt = self._checkpointer(
-                    "lasso-gd-q", {**meta, "mesh": comm.size, "mode": mode}
+                    "lasso-gd-q", {**meta, "mode": mode}, comm=comm,
+                    splits={"it": None, "theta": None, "delta": None,
+                            "error": "mesh"},
                 )
                 if resume:
-                    state, _ = ckpt.load()
+                    state, _ = ckpt.load(elastic=elastic)
                     carry = (
                         jnp.int32(state["it"]),
                         jnp.asarray(state["theta"], jnp.float32),
@@ -274,10 +290,11 @@ class Lasso(RegressionMixin, BaseEstimator):
                 while True:
                     it0 = int(carry[0])
                     stop = ckpt.stop(it0, self.max_iter)
-                    carry = _gd_segment_q(
-                        arr, yv, lam, tol, jnp.int32(stop), step, carry,
-                        comm=comm, mode=mode,
-                    )
+                    with _elastic.dispatch_guard("lasso.gd_q", comm):
+                        carry = _gd_segment_q(
+                            arr, yv, lam, tol, jnp.int32(stop), step, carry,
+                            comm=comm, mode=mode,
+                        )
                     it = int(carry[0])
                     if _tel.enabled and it > it0:
                         # the quantized gradient combine runs INSIDE the
@@ -295,9 +312,12 @@ class Lasso(RegressionMixin, BaseEstimator):
                          "error": carry[3]},
                     )
                 return carry[1], carry[0]
-        ckpt = self._checkpointer("lasso-gd", meta)
+        ckpt = self._checkpointer(
+            "lasso-gd", meta, comm=comm,
+            splits={"it": None, "theta": None, "delta": None},
+        )
         if resume:
-            state, _ = ckpt.load()
+            state, _ = ckpt.load(elastic=elastic)
             carry = (
                 jnp.int32(state["it"]),
                 jnp.asarray(state["theta"], jnp.float32),
@@ -308,7 +328,8 @@ class Lasso(RegressionMixin, BaseEstimator):
         while True:
             it0 = int(carry[0])
             stop = ckpt.stop(it0, self.max_iter)
-            carry = Lasso._gd_segment(arr, yv, lam, tol, jnp.int32(stop), step, carry)
+            with _elastic.dispatch_guard("lasso.gd", comm):
+                carry = Lasso._gd_segment(arr, yv, lam, tol, jnp.int32(stop), step, carry)
             it = int(carry[0])
             if it >= self.max_iter or it < stop:
                 break

@@ -27,13 +27,26 @@ host-visible boundaries, so compiled-program caches stay clean):
     residual) snapshots atomically through the parallel-IO layer, with
     bitwise-identical resume.
 
+:mod:`~heat_tpu.resilience.retry`
+    ``retry(policy)`` — seeded, jittered exponential backoff with
+    deadlines and bounded attempts, adopted by the HDF5/NetCDF opens and
+    the checkpoint/manifest loads; every attempt lands in the incident
+    log and on the telemetry counters.
+
+:mod:`~heat_tpu.resilience.elastic`
+    ``resume="elastic"`` / ``elastic.recover(...)`` — survive device
+    loss by shrinking the mesh: the deadline watchdog classifies
+    over-budget dispatches as suspected-lost ranks, and recovery
+    migrates the snapshot carry (error-feedback residuals re-chunked,
+    then placed by planned redistribution) onto the surviving devices.
+
 See docs/design.md (resilience section) for the fault model and the
 resume determinism contract.
 """
 
 from __future__ import annotations
 
-from .faults import Preempted, inject
+from .faults import DeviceLossError, Preempted, inject
 from .guards import (
     GuardWarning,
     NumericalHealthError,
@@ -42,16 +55,30 @@ from .guards import (
     set_guard_policy,
 )
 from .incidents import Incident, clear_incident_log, incident_log
-from .resume import LoopCheckpointer, load_loop_state, save_loop_state
-from . import faults, guards, incidents, resume
+from .resume import (
+    LoopCheckpointer,
+    MeshMismatchError,
+    load_loop_state,
+    save_loop_state,
+)
+from .retry import RetryPolicy
+from .elastic import DeadlineWatchdog, recover, set_watchdog
+# NOTE: bound last on purpose — `retry` must stay the submodule at the
+# package level (the engine function is retry.retry / retry.call)
+from . import elastic, faults, guards, incidents, resume, retry
 
 __all__ = [
+    "DeadlineWatchdog",
+    "DeviceLossError",
     "GuardWarning",
     "Incident",
     "LoopCheckpointer",
+    "MeshMismatchError",
     "NumericalHealthError",
     "Preempted",
+    "RetryPolicy",
     "clear_incident_log",
+    "elastic",
     "faults",
     "get_guard_policy",
     "guard",
@@ -60,7 +87,10 @@ __all__ = [
     "incidents",
     "inject",
     "load_loop_state",
+    "recover",
     "resume",
+    "retry",
     "save_loop_state",
     "set_guard_policy",
+    "set_watchdog",
 ]

@@ -1,0 +1,320 @@
+"""Elastic recovery: shrink the mesh, migrate the carry, resume the fit.
+
+PR 5 made the segmented fit loops preemption-safe (snapshot the carry at
+every segment boundary; resume is bitwise-equal to never having been
+interrupted) and PR 7 made resharding a compiled, minimal-traffic
+program.  This module is where they meet: when a device drops out of the
+mesh — an injected ``device_loss``, or a dispatch the deadline watchdog
+classifies as a suspected-lost rank — the latest snapshot is still
+durable, and :func:`recover` re-enters the fit on the surviving devices:
+
+1. the snapshot's replicated carry entries (iterate, residual, counters)
+   are mesh-independent and load unchanged;
+2. the mesh-stacked entries — the ``(p, payload)`` error-feedback
+   residual ring of the quantized paths — are re-chunked onto the new
+   mesh by :func:`migrate_stacked` (old rank ``r``'s untransmitted
+   residual is *summed* into new rank ``r * new_p // old_p``, so total
+   deferred mass is conserved) and placed through the planned
+   redistribution pipeline (:mod:`heat_tpu.comm.redistribute`) — one
+   compiled pad+slice dispatch, visible on the ``comm.resplit.planned``
+   counter;
+3. the fit re-enters its one compiled segment program at the recorded
+   iteration via ``resume="elastic"``.
+
+Determinism contract (PR 5's, transposed): a fit killed by
+``device_loss`` at mesh ``P`` and recovered at mesh ``Q`` finishes
+bitwise-identical to an uninterrupted mesh-``Q`` fit resumed from the
+same snapshot — both consume the same migrated carry through the same
+compiled programs.  (Migrated residuals re-quantize against the new
+block grid at the next ring step, so the int8_block trajectory at mesh
+``Q`` differs from the never-interrupted mesh-``P`` one only within the
+documented quantization bound.)
+
+The :class:`DeadlineWatchdog` closes the detection loop: per-site
+dispatch budgets are fed from telemetry span aggregates (mean duration ×
+``factor``), and a dispatch blowing its budget — including simulated
+``slow_rank`` latency from :mod:`heat_tpu.resilience.faults` — records a
+``suspected-lost`` incident and raises the same typed
+:class:`~heat_tpu.resilience.faults.DeviceLossError` the injection seam
+does, so callers have exactly one failure mode to catch.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from ..telemetry import _core as _tel
+from . import faults, incidents
+from . import resume as _resume
+from . import retry as _retry
+from .faults import DeviceLossError
+
+__all__ = [
+    "DeadlineWatchdog",
+    "dispatch_guard",
+    "get_watchdog",
+    "migrate_stacked",
+    "migrate_state",
+    "recover",
+    "set_watchdog",
+]
+
+
+# --------------------------------------------------------------------- #
+# carry migration                                                        #
+# --------------------------------------------------------------------- #
+def migrate_stacked(arr: np.ndarray, new_p: int) -> np.ndarray:
+    """Re-chunk a mesh-stacked ``(old_p, *payload)`` carry entry onto a
+    ``new_p``-rank mesh: old rank ``r``'s row is **summed** into new row
+    ``r * new_p // old_p``.
+
+    Summing (not slicing) is what keeps the error-feedback ring honest:
+    each row is a rank's *untransmitted* quantization residual, and the
+    merge hands the surviving rank the total deferred mass of the ranks
+    it absorbs — 8→4 folds pairs, 8→7 folds ``[2, 1, 1, 1, 1, 1, 1]``.
+    The merged rows re-quantize against the new block grid at the next
+    ring step.
+    """
+    arr = np.asarray(arr)
+    if arr.ndim == 0:
+        raise ValueError("stacked carry entries must have a leading mesh axis")
+    old_p = int(arr.shape[0])
+    new_p = int(new_p)
+    if new_p < 1:
+        raise ValueError(f"new mesh size must be >= 1, got {new_p}")
+    if new_p == old_p:
+        return arr
+    out = np.zeros((new_p,) + arr.shape[1:], dtype=arr.dtype)
+    for r in range(old_p):
+        out[r * new_p // old_p] += arr[r]
+    return out
+
+
+def migrate_state(
+    state: Dict[str, Any],
+    meta: Dict[str, Any],
+    new_mesh: int,
+    comm=None,
+) -> Dict[str, Any]:
+    """Migrate a loaded snapshot's carry to a ``new_mesh``-rank mesh.
+
+    ``meta["splits"]`` (written by :class:`~heat_tpu.resilience.resume.
+    LoopCheckpointer`) names each entry's partitioning; entries marked
+    ``"mesh"`` are re-chunked by :func:`migrate_stacked`, everything else
+    (replicated) passes through untouched.  When ``comm`` spans more than
+    one device, migrated entries are placed through the planned
+    redistribution pipeline — one compiled dispatch, counted on
+    ``comm.resplit.planned`` — so recovery resharding is the same
+    bounded-memory collective schedule PR 7 compiles for every other
+    resplit.
+    """
+    new_mesh = int(new_mesh)
+    splits = meta.get("splits") or {}
+    old_mesh = int(meta.get("mesh", new_mesh))
+    out = dict(state)
+    for name, spec in splits.items():
+        if spec != "mesh" or name not in out:
+            continue
+        arr = np.asarray(out[name])
+        if arr.ndim == 0 or int(arr.shape[0]) != old_mesh:
+            continue  # not actually stacked per-rank; leave it alone
+        migrated = migrate_stacked(arr, new_mesh)
+        if comm is not None and getattr(comm, "size", 1) > 1:
+            import jax.numpy as jnp
+
+            from ..comm import redistribution
+
+            with redistribution("planned"):
+                migrated = comm.resplit(
+                    jnp.asarray(np.ascontiguousarray(migrated)), 0
+                )
+        out[name] = migrated
+        incidents.record(
+            kind="mesh-shrink",
+            site=f"elastic.{name}",
+            policy=f"migrate_stacked({old_mesh}->{new_mesh})",
+            action="migrated",
+            detail=f"carry entry {name!r}: {old_mesh} rows folded into "
+            f"{new_mesh} (deferred residual mass conserved)",
+        )
+        if _tel.enabled:
+            _tel.inc("resilience.elastic.migrated")
+    return out
+
+
+# --------------------------------------------------------------------- #
+# deadline watchdog                                                      #
+# --------------------------------------------------------------------- #
+class DeadlineWatchdog:
+    """Classifies a dispatch exceeding its per-site budget as a
+    suspected-lost rank.
+
+    The budget for a site is ``factor ×`` the mean observed duration,
+    preferring the process-wide telemetry span aggregates
+    (``telemetry.snapshot()["spans"]``) and falling back to the
+    watchdog's own observations; no budget exists until ``min_samples``
+    observations have accumulated (a cold site can't be judged).  The
+    budget is computed *before* the new observation is folded in, so one
+    pathological dispatch cannot raise its own bar.  Time comes from the
+    telemetry clock — deterministic under
+    ``telemetry.enable(deterministic=True)``, injectable via
+    ``telemetry.set_clock`` — and simulated ``slow_rank`` latency from
+    the fault seams is added on top, which is how the chaos tests drive
+    classification without real stalls.
+    """
+
+    def __init__(self, factor: float = 3.0, min_samples: int = 3,
+                 min_budget: float = 0.0):
+        if factor <= 1.0:
+            raise ValueError(f"factor must be > 1, got {factor}")
+        self.factor = float(factor)
+        self.min_samples = int(min_samples)
+        self.min_budget = float(min_budget)
+        #: site -> [count, total_seconds] (fallback when telemetry is off)
+        self._local: Dict[str, list] = {}
+
+    def observations(self, site: str):
+        """``(count, total_seconds)`` for a site: telemetry span
+        aggregates when available, else this watchdog's own."""
+        spans = getattr(_tel, "_spans", None) or {}
+        agg = spans.get(site)
+        if agg and agg[0] > 0:
+            return int(agg[0]), float(agg[1])
+        local = self._local.get(site)
+        if local and local[0] > 0:
+            return int(local[0]), float(local[1])
+        return 0, 0.0
+
+    def budget(self, site: str) -> Optional[float]:
+        """The deadline (seconds) for one dispatch at ``site``, or
+        ``None`` while fewer than ``min_samples`` observations exist."""
+        count, total = self.observations(site)
+        if count < self.min_samples:
+            return None
+        return max(self.factor * (total / count), self.min_budget)
+
+    def _observe(self, site: str, elapsed: float) -> None:
+        agg = self._local.setdefault(site, [0, 0.0])
+        agg[0] += 1
+        agg[1] += float(elapsed)
+
+    @contextlib.contextmanager
+    def watch(self, site: str, comm=None):
+        """Time the block; on budget overrun, record a ``suspected-lost``
+        incident and raise :class:`DeviceLossError` naming the suspect
+        rank (the armed ``slow_rank``'s rank when one fired, else the
+        mesh's last rank)."""
+        budget = self.budget(site)  # pre-observation: see class docstring
+        t0 = _tel.clock()
+        yield
+        elapsed = float(_tel.clock() - t0)
+        extra, suspect = faults.extra_latency(site)
+        elapsed += extra
+        self._observe(site, elapsed)
+        if budget is None or elapsed <= budget:
+            return
+        size = int(getattr(comm, "size", 1) or 1)
+        lost = suspect if suspect is not None else size - 1
+        if _tel.enabled:
+            _tel.inc("resilience.watchdog.suspected")
+        incidents.record(
+            kind="deadline",
+            site=site,
+            policy=f"watchdog(factor={self.factor}, "
+            f"min_samples={self.min_samples})",
+            action="suspected-lost",
+            detail=f"dispatch took {elapsed:.4f}s against a {budget:.4f}s "
+            f"budget; suspecting rank {lost} of {size}",
+        )
+        raise DeviceLossError(
+            f"dispatch at {site} exceeded its deadline ({elapsed:.4f}s > "
+            f"{budget:.4f}s budget): suspecting lost rank {lost}; shrink "
+            f'the mesh and resume with resume="elastic"',
+            lost_rank=lost,
+            mesh_size=size,
+            site=site,
+        )
+
+
+#: the process-wide watchdog the fit drivers consult (None = disarmed)
+_WATCHDOG: Optional[DeadlineWatchdog] = None
+
+
+def set_watchdog(watchdog: Optional[DeadlineWatchdog]):
+    """Arm (or, with ``None``, disarm) the process-wide deadline
+    watchdog consulted by :func:`dispatch_guard`."""
+    global _WATCHDOG
+    _WATCHDOG = watchdog
+    return watchdog
+
+
+def get_watchdog() -> Optional[DeadlineWatchdog]:
+    return _WATCHDOG
+
+
+@contextlib.contextmanager
+def dispatch_guard(site: str, comm=None):
+    """The seam the fit drivers wrap around their segment dispatches.
+    A no-op (beyond one attribute read) while no watchdog is armed and
+    no fault plans are active, so the hot path stays hot."""
+    wd = _WATCHDOG
+    if wd is None:
+        if faults.any_active():
+            # still advance the slow_rank schedule so fault plans see a
+            # deterministic opportunity sequence with or without a watchdog
+            faults.extra_latency(site)
+        yield
+        return
+    with wd.watch(site, comm=comm):
+        yield
+
+
+# --------------------------------------------------------------------- #
+# recovery driver                                                        #
+# --------------------------------------------------------------------- #
+def recover(fit, snapshot: str, *data, comm=None,
+            policy: Optional[_retry.RetryPolicy] = None):
+    """Kill→shrink→recover in one call.
+
+    ``fit`` is an estimator exposing ``.fit(*data, resume=...)`` (Lasso,
+    KMeans) or a bare callable (``lambda: lanczos(..., resume="elastic")``);
+    ``snapshot`` is the loop-snapshot path the dead fit was ticking;
+    ``data`` are the input arrays **already built on the surviving
+    mesh**.  The snapshot probe runs under the bounded, seeded retry
+    policy — recovery is exactly when storage is most likely to still be
+    failing over — and the whole cycle lands in the incident log.
+    """
+    probe = _retry.retry(policy or _retry.IO_POLICY, site="elastic.recover")
+    state, meta = None, None
+    for attempt in probe:
+        with attempt:
+            state, meta = _resume.load_loop_state(snapshot)
+    old_mesh = meta.get("mesh")
+    new_mesh = int(getattr(comm, "size", 0) or 0) or None
+    if hasattr(fit, "checkpoint_path") and fit.checkpoint_path != snapshot:
+        fit.checkpoint_path = snapshot
+    incidents.record(
+        kind="device-loss",
+        site="elastic.recover",
+        policy="elastic",
+        action="recovering",
+        detail=f"resuming {meta.get('algo')!r} from it={meta.get('it')} "
+        f"on mesh {old_mesh}->{new_mesh if new_mesh else '?'}",
+    )
+    if _tel.enabled:
+        _tel.inc("resilience.elastic.recoveries")
+    if hasattr(fit, "fit"):
+        out = fit.fit(*data, resume="elastic")
+    else:
+        out = fit(*data, resume="elastic") if data else fit()
+    incidents.record(
+        kind="device-loss",
+        site="elastic.recover",
+        policy="elastic",
+        action="recovered",
+        detail=f"{meta.get('algo')!r} finished on the shrunk mesh",
+    )
+    return out

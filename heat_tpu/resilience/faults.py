@@ -31,6 +31,20 @@ Kinds
     Raises :class:`Preempted` at a preemption point: the checkpoint tick
     between training-loop segments (``site="iteration"``) or between two
     slab writes inside a save (``site="save-slab"``).
+``"device_loss"``
+    Raises :class:`DeviceLossError` at a device-loss point (the same
+    checkpoint tick, after the snapshot is durable): rank ``rank``
+    (default: the last rank of the current mesh) "drops out", and the
+    error carries the surviving-mesh description.  Catch it, shrink the
+    mesh, then ``fit(..., resume="elastic")`` — the ICE-preempted-host
+    lifecycle of a multi-host TPU slice.
+``"slow_rank"``
+    Arms a simulated straggler: :func:`extra_latency` reports ``delay``
+    extra seconds for rank ``rank`` at matching sites.  Consumed by the
+    deadline watchdog (:mod:`heat_tpu.resilience.elastic`), which
+    classifies a dispatch blowing its per-site budget as a suspected
+    lost rank.  No real sleeping happens — the delay is part of the
+    deterministic schedule, not wall time.
 
 All injection happens at host-visible boundaries (eager ops on the
 arrays entering/leaving a compiled collective), so armed plans never leak
@@ -49,9 +63,17 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-__all__ = ["Preempted", "inject", "any_active", "clear"]
+__all__ = ["DeviceLossError", "Preempted", "inject", "any_active", "clear"]
 
-_KINDS = ("nonfinite", "saturate", "bitflip", "io_error", "preempt")
+_KINDS = (
+    "nonfinite",
+    "saturate",
+    "bitflip",
+    "io_error",
+    "preempt",
+    "device_loss",
+    "slow_rank",
+)
 
 #: trigger sites, by kind, that consume one schedule decision per call
 _COMM_INPUT_KINDS = ("nonfinite", "saturate")
@@ -64,6 +86,30 @@ class Preempted(RuntimeError):
     writes).  Catch it, then call ``fit(..., resume=True)`` / re-run the
     save — exactly the SIGTERM-then-reschedule lifecycle of a preemptible
     TPU VM."""
+
+
+class DeviceLossError(RuntimeError):
+    """A rank dropped out of the mesh (injected ``device_loss``, or a
+    dispatch the deadline watchdog classified as a suspected-lost rank).
+
+    Carries the failure topology so callers can shrink and recover:
+    ``lost_rank`` (the dead rank), ``survivors`` (the surviving rank
+    tuple), ``mesh_size`` (the old device count).  The fit's latest
+    snapshot is durable (the loss point sits *after* the checkpoint
+    tick), so the recovery story is: build a comm over the surviving
+    devices, then ``fit(..., resume="elastic")`` — or call
+    :func:`heat_tpu.resilience.elastic.recover` directly.
+    """
+
+    def __init__(self, message: str, *, lost_rank: int, mesh_size: int,
+                 site: str = ""):
+        super().__init__(message)
+        self.lost_rank = int(lost_rank)
+        self.mesh_size = int(mesh_size)
+        self.survivors = tuple(
+            r for r in range(self.mesh_size) if r != self.lost_rank
+        )
+        self.site = site
 
 
 class _Plan:
@@ -79,6 +125,8 @@ class _Plan:
         factor: float,
         max_faults: Optional[int],
         site: Optional[str],
+        rank: Optional[int] = None,
+        delay: float = 0.0,
     ):
         if kind not in _KINDS:
             raise ValueError(f"unknown fault kind {kind!r}: expected one of {_KINDS}")
@@ -96,6 +144,8 @@ class _Plan:
         self.factor = float(factor)
         self.max_faults = max_faults
         self.site = site
+        self.rank = None if rank is None else int(rank)
+        self.delay = float(delay)
         self.rng = np.random.default_rng(self.seed)
         self.calls = 0  # trigger opportunities seen
         self.fired = 0  # faults actually injected
@@ -141,6 +191,8 @@ def inject(
     factor: float = 1e36,
     max_faults: Optional[int] = None,
     site: Optional[str] = None,
+    rank: Optional[int] = None,
+    delay: float = 0.0,
 ):
     """Arm one deterministic fault plan for the duration of the block.
 
@@ -149,10 +201,15 @@ def inject(
     probability ``rate`` from the plan's seeded stream.  ``max_faults``
     caps total injections (a *transient* fault: fail N times, then heal —
     the shape retry logic must survive).  ``site`` restricts a
-    ``"preempt"`` plan to one preemption point (``"iteration"`` or
-    ``"save-slab"``).  Plans nest; each keeps its own counters.
+    ``"preempt"``/``"device_loss"``/``"slow_rank"`` plan to one trigger
+    site (e.g. ``"iteration"``).  ``rank`` picks the lost/straggling rank
+    for ``"device_loss"``/``"slow_rank"`` (default: the mesh's last
+    rank); ``delay`` is the simulated extra latency, in seconds, a
+    ``"slow_rank"`` plan reports.  Plans nest; each keeps its own
+    counters.
     """
-    plan = _Plan(kind, seed, rate, nth, value, factor, max_faults, site)
+    plan = _Plan(kind, seed, rate, nth, value, factor, max_faults, site,
+                 rank=rank, delay=delay)
     _PLANS.append(plan)
     try:
         yield plan
@@ -218,3 +275,36 @@ def preempt_point(site: str) -> None:
                 f"injected preemption at {site} (seed={plan.seed}, "
                 f"opportunity #{plan.calls})"
             )
+
+
+def device_point(site: str, mesh: Optional[int] = None) -> None:
+    """Device-loss seam, placed *after* the durable checkpoint tick so
+    the snapshot survives the loss (the preempt seam's contract, kept).
+    ``mesh`` is the current device count; the plan's ``rank`` defaults to
+    the last rank of that mesh."""
+    for plan in list(_PLANS):
+        if plan.kind == "device_loss" and plan.should_fire(site):
+            size = int(mesh) if mesh is not None else 1
+            lost = plan.rank if plan.rank is not None else size - 1
+            raise DeviceLossError(
+                f"injected device loss at {site}: rank {lost} of mesh "
+                f"size {size} dropped (seed={plan.seed}, opportunity "
+                f"#{plan.calls}); latest snapshot is durable — shrink the "
+                f'mesh and resume with resume="elastic"',
+                lost_rank=lost,
+                mesh_size=size,
+                site=site,
+            )
+
+
+def extra_latency(site: str):
+    """Straggler seam: the simulated extra seconds an armed ``slow_rank``
+    plan adds at ``site``, plus the suspect rank — ``(0.0, None)`` when
+    nothing fires.  Consumed by the deadline watchdog; no wall-clock
+    sleeping happens here."""
+    total, suspect = 0.0, None
+    for plan in list(_PLANS):
+        if plan.kind == "slow_rank" and plan.should_fire(site):
+            total += plan.delay
+            suspect = plan.rank if plan.rank is not None else suspect
+    return total, suspect

@@ -29,11 +29,34 @@ from ..core import factories
 from ..core import io as _io
 from ..telemetry import _core as _tel
 from . import faults
+from . import retry as _retry
 
-__all__ = ["LoopCheckpointer", "load_loop_state", "save_loop_state"]
+__all__ = [
+    "LoopCheckpointer",
+    "MeshMismatchError",
+    "load_loop_state",
+    "save_loop_state",
+]
 
 _MANIFEST_ATTR = "heat_tpu_loop_state"
 _FORMAT_VERSION = 1
+
+
+class MeshMismatchError(ValueError):
+    """A loop snapshot was taken at a different mesh size than the fit
+    trying to consume it.  Carries ``snapshot_mesh`` and ``current_mesh``;
+    the fix is ``fit(..., resume="elastic")``, which migrates the sharded
+    carry entries to the current mesh through the planned-redistribution
+    pipeline instead of rejecting the snapshot."""
+
+    def __init__(self, path: str, snapshot_mesh: int, current_mesh: int):
+        self.snapshot_mesh = int(snapshot_mesh)
+        self.current_mesh = int(current_mesh)
+        super().__init__(
+            f"{path}: snapshot was taken at mesh size {self.snapshot_mesh} "
+            f"but this fit runs at mesh size {self.current_mesh}; pass "
+            f'resume="elastic" to migrate the carry to the current mesh'
+        )
 
 
 def save_loop_state(path: str, state: Dict[str, Any], meta: Optional[Dict[str, Any]] = None) -> None:
@@ -79,9 +102,14 @@ def load_loop_state(path: str) -> Tuple[Dict[str, np.ndarray], Dict[str, Any]]:
         raise RuntimeError("h5py is required for loop snapshots")
     import h5py
 
-    faults.io_open(path)
+    def _open():
+        faults.io_open(path)
+        return h5py.File(path, "r")
+
     try:
-        f = h5py.File(path, "r")
+        # transient EIO at the open heals under the bounded, seeded retry
+        # policy; only an exhausted policy surfaces as the ValueError below
+        f = _retry.call(_open, policy=_retry.IO_POLICY, site="resume.load")
     except OSError as e:
         raise ValueError(
             f"{path} is not a readable loop snapshot (missing, truncated, "
@@ -120,12 +148,20 @@ class LoopCheckpointer:
 
     ``algo`` tags snapshots so a KMeans resume can never consume a Lasso
     file; ``meta`` records the static fit configuration (shapes, solver
-    constants, mesh size) and is validated field-by-field on load — a
-    snapshot from a different problem raises instead of silently
-    continuing a different trajectory.
+    constants) and is validated field-by-field on load — a snapshot from
+    a different problem raises instead of silently continuing a different
+    trajectory.  ``comm`` stamps the device count into the manifest as
+    the reserved ``"mesh"`` key, and ``splits`` records each carry
+    entry's partitioning (``None`` = replicated, ``"mesh"`` = stacked one
+    row per rank) so an elastic resume knows exactly which entries must
+    migrate when the mesh shrinks.  A mesh-size mismatch raises
+    :class:`MeshMismatchError` under ``resume=True`` and triggers carry
+    migration under ``resume="elastic"``.
     """
 
-    def __init__(self, path: Optional[str], every: int, algo: str, meta: Dict[str, Any]):
+    def __init__(self, path: Optional[str], every: int, algo: str,
+                 meta: Dict[str, Any], *, comm=None,
+                 splits: Optional[Dict[str, Any]] = None):
         every = int(every or 0)
         if every < 0:
             raise ValueError(f"checkpoint_every must be >= 0, got {every}")
@@ -135,6 +171,11 @@ class LoopCheckpointer:
         self.every = every
         self.algo = algo
         self.meta = dict(meta)
+        self._comm = comm
+        if comm is not None and "mesh" not in self.meta:
+            self.meta["mesh"] = int(comm.size)
+        if splits is not None:
+            self.meta["splits"] = dict(splits)
 
     @property
     def enabled(self) -> bool:
@@ -157,11 +198,21 @@ class LoopCheckpointer:
             self.path, state, {**self.meta, "algo": self.algo, "it": int(it)}
         )
         faults.preempt_point("iteration")
+        faults.device_point("iteration", mesh=self.meta.get("mesh"))
 
-    def load(self) -> Tuple[Dict[str, np.ndarray], Dict[str, Any]]:
-        """Read and validate this fit's snapshot for ``resume=True``."""
+    def load(self, elastic: bool = False) -> Tuple[Dict[str, np.ndarray], Dict[str, Any]]:
+        """Read and validate this fit's snapshot.
+
+        ``elastic=True`` (``resume="elastic"``) relaxes two checks the
+        strict path enforces: a mesh-size mismatch migrates the sharded
+        carry entries to the current mesh instead of raising, and a
+        snapshot written by the quantized twin of this algorithm
+        (``<algo>-q``) is accepted — a fit that loses enough devices to
+        land on a single-rank mesh legitimately resumes on the exact
+        path, where the quantized carry's extra entries are ignored.
+        """
         if not self.path:
-            raise ValueError("resume=True requires checkpoint_path")
+            raise ValueError("resume requires checkpoint_path")
         state, meta = load_loop_state(self.path)
         if _tel.enabled:
             _tel.inc("checkpoint.resumes")
@@ -169,12 +220,31 @@ class LoopCheckpointer:
                 "checkpoint", site=self.algo, op="resume",
                 path=str(self.path), it=int(meta.get("it", -1)),
             )
-        if meta.get("algo") != self.algo:
+        meta_algo = meta.get("algo")
+        if meta_algo != self.algo and not (
+            elastic and meta_algo == f"{self.algo}-q"
+        ):
             raise ValueError(
-                f"{self.path}: snapshot was written by {meta.get('algo')!r}, "
+                f"{self.path}: snapshot was written by {meta_algo!r}, "
                 f"not {self.algo!r}"
             )
+        snap_mesh = meta.get("mesh")
+        want_mesh = self.meta.get("mesh")
+        if (
+            snap_mesh is not None
+            and want_mesh is not None
+            and int(snap_mesh) != int(want_mesh)
+        ):
+            if not elastic:
+                raise MeshMismatchError(self.path, snap_mesh, want_mesh)
+            from . import elastic as _elastic  # lazy: elastic imports resume
+
+            state = _elastic.migrate_state(
+                state, meta, int(want_mesh), comm=self._comm
+            )
         for key, expect in self.meta.items():
+            if key in ("mesh", "splits"):
+                continue  # handled above / informational
             got = meta.get(key)
             if got != expect:
                 raise ValueError(

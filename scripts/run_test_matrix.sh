@@ -34,6 +34,16 @@ if ! HEAT_CHAOS_SEED="${HEAT_CHAOS_SEED:-0}" python -m pytest tests/test_resilie
     echo "FAILED chaos lane (reproduce with HEAT_CHAOS_SEED=${HEAT_CHAOS_SEED:-0})"
     fail=1
 fi
+# elastic lane: the kill→shrink→recover cycle end-to-end under the same
+# seeded chaos schedule — device loss at mesh {8→4, 4→2, 2→1} (plus the
+# non-divisible 8→7 fallback) across Lasso-gd/Lasso-gd-int8/KMeans/
+# lanczos, with the bitwise-vs-uninterrupted-twin gate, the retry
+# engine's seeded backoff, and the deadline watchdog (docs/design.md §15)
+echo "=== elastic lane (seed=${HEAT_CHAOS_SEED:-0}: device loss, mesh shrink, recovery) ==="
+if ! HEAT_CHAOS_SEED="${HEAT_CHAOS_SEED:-0}" python -m pytest tests/test_elastic.py -q; then
+    echo "FAILED elastic lane (reproduce with HEAT_CHAOS_SEED=${HEAT_CHAOS_SEED:-0})"
+    fail=1
+fi
 # telemetry lane: a tier-1 smoke slice with collection armed process-wide
 # (HEAT_TELEMETRY=1) — proves the instrumented hot paths stay green with
 # spans/counters live and archives the event stream + Perfetto trace as

@@ -284,14 +284,47 @@ def test_interrupted_csv_save_leaves_previous_file(tmp_path):
 
 
 def test_transient_io_error_heals_on_retry(tmp_path):
+    from heat_tpu.resilience import retry as _retry
+
     p = str(tmp_path / "data.h5")
     data = RNG.normal(size=(8, 2)).astype(np.float32)
     ht.save(ht.array(data, split=0), p, "data")
-    with faults.inject("io_error", nth=1, max_faults=1):
-        with pytest.raises(OSError):
-            ht.load_hdf5(p, "data")
-        # the fault was transient: the very next open succeeds
-        np.testing.assert_array_equal(ht.load_hdf5(p, "data").numpy(), data)
+    _retry.set_sleep(lambda s: None)
+    try:
+        # the load's open site retries internally now: one transient EIO
+        # heals without the caller ever seeing it...
+        with faults.inject("io_error", nth=1, max_faults=1):
+            np.testing.assert_array_equal(ht.load_hdf5(p, "data").numpy(), data)
+    finally:
+        _retry.set_sleep(None)
+    # ...but the heal is never invisible: the attempt is in the log
+    attempts = [
+        i for i in ht.resilience.incident_log()
+        if i.site == "io.load_hdf5" and i.action == "retried"
+    ]
+    assert len(attempts) == 1
+    assert "OSError" in attempts[0].kind
+
+
+def test_persistent_io_error_exhausts_retries_and_propagates(tmp_path):
+    from heat_tpu.resilience import retry as _retry
+
+    p = str(tmp_path / "data.h5")
+    data = RNG.normal(size=(8, 2)).astype(np.float32)
+    ht.save(ht.array(data, split=0), p, "data")
+    _retry.set_sleep(lambda s: None)
+    try:
+        # fault fires on every open: the bounded policy (3 attempts)
+        # gives up and the last OSError propagates to the caller
+        with faults.inject("io_error"):
+            with pytest.raises(OSError):
+                ht.load_hdf5(p, "data")
+    finally:
+        _retry.set_sleep(None)
+    log = ht.resilience.incident_log()
+    assert [i.action for i in log if i.site == "io.load_hdf5"] == [
+        "retried", "retried", "gave-up"
+    ]
 
 
 # --------------------------------------------------------------------- #

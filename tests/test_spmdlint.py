@@ -655,6 +655,125 @@ def shuffle(x, comm):
 
 
 # --------------------------------------------------------------------- #
+# SPMD207: silent broad except around dispatch/collective/io sites       #
+# --------------------------------------------------------------------- #
+def test_spmd207_triggers_on_silent_except_around_collective():
+    src = """
+def shuffle(x, comm):
+    try:
+        x = comm.resplit(x, 1)
+    except Exception:
+        pass
+    return x
+"""
+    findings = lint(src, "SPMD207")
+    assert len(findings) == 1
+    assert "resplit" in findings[0].message and "Exception" in findings[0].message
+    assert "disable=SPMD207" in findings[0].hint
+
+
+def test_spmd207_triggers_on_swallowed_oserror_open():
+    src = """
+def probe(path):
+    try:
+        with open(path) as f:
+            return f.read()
+    except OSError:
+        return None
+"""
+    findings = lint(src, "SPMD207")
+    assert len(findings) == 1 and "'open'" in findings[0].message
+
+
+def test_spmd207_triggers_on_bare_except_and_broad_tuple_member():
+    src = """
+def reduce(comm, arr):
+    try:
+        return comm.allreduce(arr)
+    except:
+        return arr
+
+def load(path):
+    try:
+        return load_hdf5(path, "data")
+    except (ValueError, OSError):
+        return None
+"""
+    findings = lint(src, "SPMD207")
+    assert len(findings) == 2
+    assert "(bare except)" in findings[0].message
+    assert "OSError" in findings[1].message
+
+
+def test_spmd207_clean_on_visible_handlers():
+    src = """
+import logging
+from heat_tpu.resilience import incidents
+
+def reraise(path):
+    try:
+        f = open(path)
+    except OSError:
+        cleanup()
+        raise
+
+def deferred(comm, x):
+    err = None
+    try:
+        x = comm.resplit(x, 1)
+    except Exception as e:
+        err = e
+    return x, err
+
+def recorded(comm, x):
+    try:
+        return comm.allgather(x)
+    except OSError:
+        incidents.record(kind="io", site="gather", policy="manual", action="noted")
+        return x
+
+def logged(path):
+    try:
+        return open(path)
+    except OSError:
+        logging.warning("open failed")
+        return None
+"""
+    assert lint(src, "SPMD207") == []
+
+
+def test_spmd207_clean_on_narrow_or_unguarded_try():
+    src = """
+import os
+
+def narrow(d):
+    try:
+        return d.load("key")
+    except KeyError:
+        return None
+
+def unguarded(path):
+    try:
+        os.remove(path)
+    except OSError:
+        pass
+"""
+    assert lint(src, "SPMD207") == []
+
+
+def test_spmd207_suppression_comment_silences():
+    src = """
+def shuffle(x, comm):
+    try:
+        x = comm.resplit(x, 1)
+    except Exception:  # spmdlint: disable=SPMD207
+        pass
+    return x
+"""
+    assert lint(src, "SPMD207") == []
+
+
+# --------------------------------------------------------------------- #
 # SPMD301/302: Pallas tiling and grids                                   #
 # --------------------------------------------------------------------- #
 def test_spmd301_triggers_on_off_tile_blocks():
@@ -816,7 +935,7 @@ def test_baseline_fingerprint_is_line_insensitive():
 def test_every_rule_is_registered():
     assert [r.id for r in all_rules()] == [
         "SPMD101", "SPMD102", "SPMD201", "SPMD202", "SPMD203", "SPMD204",
-        "SPMD205", "SPMD206", "SPMD301", "SPMD302", "SPMD401",
+        "SPMD205", "SPMD206", "SPMD207", "SPMD301", "SPMD302", "SPMD401",
     ]
 
 
