@@ -1303,3 +1303,32 @@ def check_jit_cache_keys(ctx: FileContext) -> Iterable[Finding]:
             bad = _classify_key_element(ctx, el, fn_scope)
             if bad:
                 yield ctx.finding("SPMD401", anchor, bad[0], hint=bad[1])
+
+
+# --------------------------------------------------------------------- #
+# SPMD001: suppression hygiene                                          #
+# --------------------------------------------------------------------- #
+@rule("SPMD001", "inline suppression of a reason-required rule must carry a reason")
+def check_suppression_reasons(ctx: FileContext) -> Iterable[Finding]:
+    """A ``# spmdlint: disable=...`` comment that silences a rule in
+    :data:`~heat_tpu.analysis.rules.REASON_REQUIRED` (SPMD204, SPMD207 —
+    the checks whose whole purpose is making a risky pattern deliberate)
+    must justify itself with a ``-- reason`` tail::
+
+        # spmdlint: disable=SPMD204 -- bench harness, guards off by design
+
+    A bare suppression (or an empty reason after ``--``) of those rules is
+    itself a finding, so silencing the check leaves an audit trail either
+    way."""
+    from .rules import REASON_REQUIRED
+
+    for lineno, ids, reason in ctx.suppressions():
+        gated = sorted(set(ids) & REASON_REQUIRED)
+        if gated and not reason:
+            anchor = ast.Pass(lineno=lineno, col_offset=0)
+            yield ctx.finding(
+                "SPMD001", anchor,
+                f"suppression of {', '.join(gated)} has no reason",
+                hint="append '-- <why this is safe here>' to the "
+                "spmdlint: disable comment",
+            )

@@ -27,15 +27,28 @@ fingerprint.
 from __future__ import annotations
 
 import ast
+import io
 import os
 import re
+import tokenize
 from typing import Dict, Iterable, List, Optional, Sequence, Tuple, Union
 
 from .rules import RULES, Finding, all_rules
 
-__all__ = ["FileContext", "analyze_file", "analyze_paths", "iter_py_files"]
+__all__ = [
+    "FileContext", "analyze_contexts", "analyze_file", "analyze_paths",
+    "iter_py_files", "norm_relpath", "repo_root_for",
+]
 
-_SUPPRESS_RE = re.compile(r"#\s*spmdlint:\s*disable=([A-Za-z0-9_,\s]+)")
+#: ``# spmdlint: disable=SPMD101,SPMD202`` with an optional human reason
+#: after a ``--`` separator (``disable=SPMD204 -- guards off by design``).
+#: Group 1 = the comma-separated rule ids, group 2 = the reason (None when
+#: absent, "" when the separator is present but empty).
+_SUPPRESS_RE = re.compile(
+    r"#\s*spmdlint:\s*disable="
+    r"([A-Za-z0-9_]+(?:\s*,\s*[A-Za-z0-9_]+)*)"
+    r"(?:\s*--\s*(.*?))?\s*$"
+)
 _SKIP_FILE_RE = re.compile(r"#\s*spmdlint:\s*skip-file")
 
 #: jax entry points whose function argument (by position) gets traced
@@ -61,6 +74,36 @@ FuncNode = Union[ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda]
 _FUNC_TYPES = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
 
 
+def repo_root_for(path: str) -> Optional[str]:
+    """Nearest enclosing repo root of ``path``: the first ancestor holding
+    a ``.git`` directory, ``pyproject.toml``, or committed spmdlint
+    baseline.  None when ``path`` is outside any recognizable repo."""
+    d = os.path.dirname(os.path.abspath(path))
+    while True:
+        if (
+            os.path.isdir(os.path.join(d, ".git"))
+            or os.path.isfile(os.path.join(d, "pyproject.toml"))
+            or os.path.isfile(os.path.join(d, "spmdlint-baseline.json"))
+        ):
+            return d
+        parent = os.path.dirname(d)
+        if parent == d:
+            return None
+        d = parent
+
+
+def norm_relpath(path: str, root: Optional[str] = None) -> str:
+    """Canonical finding path: relative to ``root`` (or the file's repo
+    root), always ``/``-separated.  ``spmdlint.py heat_tpu``,
+    ``./heat_tpu``, and the absolute spelling — from any working
+    directory — all map a file to the SAME relpath, so baseline
+    fingerprints are path-spelling- and cwd-insensitive."""
+    ap = os.path.abspath(path)
+    anchor = root or repo_root_for(ap)
+    rel = os.path.relpath(ap, anchor) if anchor else os.path.relpath(ap)
+    return rel.replace(os.sep, "/")
+
+
 def _module_name_for(path: str) -> str:
     """Dotted module name from the file's package position on disk (walk
     up while ``__init__.py`` exists).  Fixture files outside any package
@@ -78,14 +121,24 @@ def _module_name_for(path: str) -> str:
 class FileContext:
     def __init__(self, path: str, source: Optional[str] = None, relpath: Optional[str] = None):
         self.path = path
-        self.relpath = relpath or os.path.relpath(path)
+        self.relpath = (relpath or norm_relpath(path)).replace(os.sep, "/")
         if source is None:
             with open(path, "r", encoding="utf-8") as f:
                 source = f.read()
         self.source = source
         self.lines = source.splitlines()
         self.tree = ast.parse(source, filename=path)
-        self.module = _module_name_for(path) if os.path.exists(path) else "<fixture>"
+        if os.path.exists(path):
+            self.module = _module_name_for(path)
+        else:
+            # fixture context (source supplied): derive the module from
+            # the declared relpath so synthetic multi-file programs still
+            # resolve cross-module imports
+            name = self.relpath[:-3] if self.relpath.endswith(".py") else self.relpath
+            name = name.replace("/", ".")
+            if name.endswith(".__init__"):
+                name = name[: -len(".__init__")]
+            self.module = name or "<fixture>"
 
         self.parents: Dict[ast.AST, ast.AST] = {}
         for parent in ast.walk(self.tree):
@@ -93,6 +146,7 @@ class FileContext:
                 self.parents[child] = parent
 
         self.aliases = self._collect_aliases()
+        self.module_names = self._collect_module_names()
         self._scope_assigns: Dict[ast.AST, Dict[str, Tuple]] = {}
         self.traced_fns = self._collect_traced()
         self.skip_file = any(
@@ -103,9 +157,23 @@ class FileContext:
     # imports / name resolution                                           #
     # ------------------------------------------------------------------ #
     def _collect_aliases(self) -> Dict[str, str]:
-        """local name -> dotted origin (``pl`` -> ``jax.experimental.pallas``)."""
+        """local name -> dotted origin (``pl`` -> ``jax.experimental.pallas``).
+
+        ``from x import *`` contributes no aliases directly (the imported
+        names are unknowable per-file) but IS recorded in
+        :attr:`star_imports` so :meth:`resolve` can fall back to the star
+        module for otherwise-unknown names, and the splitflow Program can
+        resolve them exactly against the exporting file.  Imports inside
+        ``if TYPE_CHECKING:`` blocks are collected like any other — they
+        bind the names rules match on, even though they never execute."""
         out: Dict[str, str] = {}
+        self.star_imports: List[str] = []
         pkg_parts = self.module.split(".")
+        if self.relpath.rsplit("/", 1)[-1] == "__init__.py":
+            # a package __init__'s module IS the package: `from . import x`
+            # (level 1) must resolve to the package itself, so give the
+            # path a synthetic leaf for the level arithmetic to strip
+            pkg_parts = pkg_parts + ["__init__"]
         for node in ast.walk(self.tree):
             if isinstance(node, ast.Import):
                 for a in node.names:
@@ -120,20 +188,51 @@ class FileContext:
                     mod = ".".join(base + ([mod] if mod else []))
                 for a in node.names:
                     if a.name == "*":
+                        if mod:
+                            self.star_imports.append(mod)
                         continue
                     out[a.asname or a.name] = f"{mod}.{a.name}" if mod else a.name
         return out
 
+    def _collect_module_names(self) -> set:
+        """Names bound at module scope by defs/classes/assignments (NOT
+        imports) — the names a star-import fallback must never shadow."""
+        names: set = set()
+        for st in self.tree.body:
+            if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+                names.add(st.name)
+            elif isinstance(st, ast.Assign):
+                for tgt in st.targets:
+                    for n in ast.walk(tgt):
+                        if isinstance(n, ast.Name):
+                            names.add(n.id)
+            elif isinstance(st, ast.AnnAssign) and isinstance(st.target, ast.Name):
+                names.add(st.target.id)
+        return names
+
     def resolve(self, node: ast.AST) -> Optional[str]:
         """Dotted name of a Name/Attribute chain with import aliases
-        substituted; None for anything else."""
+        substituted; None for anything else.
+
+        A name with no alias and no module-scope binding in a file with
+        exactly ONE ``from x import *`` resolves through that star module
+        (the only place it can have come from); with several star imports
+        the origin is ambiguous and the bare name is kept."""
         parts: List[str] = []
         while isinstance(node, ast.Attribute):
             parts.append(node.attr)
             node = node.value
         if not isinstance(node, ast.Name):
             return None
-        root = self.aliases.get(node.id, node.id)
+        root = self.aliases.get(node.id)
+        if root is None:
+            if (
+                len(self.star_imports) == 1
+                and node.id not in self.module_names
+            ):
+                root = f"{self.star_imports[0]}.{node.id}"
+            else:
+                root = node.id
         parts.append(root)
         return ".".join(reversed(parts))
 
@@ -332,6 +431,33 @@ class FileContext:
                     return True
         return False
 
+    def suppressions(self) -> List[Tuple[int, List[str], Optional[str]]]:
+        """Every inline suppression comment in the file as
+        ``(lineno, rule_ids, reason)`` — ``reason`` is None when no ``--``
+        separator is present and the (stripped) free text after it
+        otherwise.  SPMD001 audits this list for reason-required rules.
+
+        Unlike the fast line-scan in :meth:`_suppressed`, this walks real
+        COMMENT tokens, so pragma look-alikes inside string literals
+        (lint-test fixtures quoting suppressions) are not reported."""
+        out: List[Tuple[int, List[str], Optional[str]]] = []
+        try:
+            tokens = tokenize.generate_tokens(io.StringIO(self.source).readline)
+            comments = [
+                (tok.start[0], tok.string)
+                for tok in tokens
+                if tok.type == tokenize.COMMENT
+            ]
+        except (tokenize.TokenError, IndentationError, SyntaxError):
+            comments = list(enumerate(self.lines, 1))
+        for i, text in comments:
+            m = _SUPPRESS_RE.search(text)
+            if m:
+                ids = [s.strip() for s in m.group(1).split(",")]
+                reason = m.group(2)
+                out.append((i, ids, reason.strip() if reason is not None else None))
+        return out
+
     def finding(
         self, rule_id: str, node: ast.AST, message: str, hint: str = ""
     ) -> Optional[Finding]:
@@ -363,6 +489,60 @@ def iter_py_files(paths: Sequence[str]) -> Iterable[str]:
             yield p
 
 
+def _register_all_rules() -> None:
+    # imports for the side effect of registering every rule: the per-file
+    # checkers and the program-scope splitflow rules (SPMD501-504)
+    from . import checkers  # noqa: F401
+    from .splitflow import checkers as _sf_checkers  # noqa: F401
+
+
+def _wanted(r, dynamic: bool, rules: Optional[Sequence[str]]) -> bool:
+    if rules is not None and r.id not in rules:
+        return False
+    return dynamic or not r.dynamic
+
+
+def analyze_contexts(
+    contexts: Sequence[FileContext],
+    dynamic: bool = True,
+    rules: Optional[Sequence[str]] = None,
+    cache=None,
+) -> List[Finding]:
+    """Run every registered rule over pre-built contexts: file-scope
+    rules per context, then the program-scope (splitflow) rules once over
+    the whole set.  ``cache`` is an optional
+    :class:`heat_tpu.analysis.cache.FindingsCache`; per-file results hit
+    it, program-scope results are interprocedural and always recompute."""
+    _register_all_rules()
+    findings: List[Finding] = []
+    live = [ctx for ctx in contexts if not ctx.skip_file]
+    file_rules = [r for r in all_rules() if r.scope == "file"]
+    for ctx in live:
+        cached = cache.get(ctx, dynamic, rules) if cache is not None else None
+        if cached is not None:
+            findings.extend(cached)
+            continue
+        per_file: List[Finding] = []
+        for r in file_rules:
+            if _wanted(r, dynamic, rules):
+                per_file.extend(f for f in r.check(ctx) if f is not None)
+        if cache is not None:
+            cache.put(ctx, dynamic, rules, per_file)
+        findings.extend(per_file)
+    program_rules = [
+        r for r in all_rules()
+        if r.scope == "program" and _wanted(r, dynamic, rules)
+    ]
+    if program_rules and live:
+        from .splitflow import build_program
+
+        program = build_program(live)
+        for r in program_rules:
+            findings.extend(f for f in r.check(program) if f is not None)
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    return findings
+
+
 def analyze_file(
     path: str,
     source: Optional[str] = None,
@@ -370,30 +550,22 @@ def analyze_file(
     relpath: Optional[str] = None,
     rules: Optional[Sequence[str]] = None,
 ) -> List[Finding]:
-    # import for the side effect of registering every rule
-    from . import checkers  # noqa: F401
-
     ctx = FileContext(path, source=source, relpath=relpath)
-    if ctx.skip_file:
-        return []
-    findings: List[Finding] = []
-    for r in all_rules():
-        if rules is not None and r.id not in rules:
-            continue
-        if r.dynamic and not dynamic:
-            continue
-        findings.extend(f for f in r.check(ctx) if f is not None)
-    findings.sort(key=lambda f: (f.path, f.line, f.rule))
-    return findings
+    return analyze_contexts([ctx], dynamic=dynamic, rules=rules)
 
 
 def analyze_paths(
-    paths: Sequence[str], dynamic: bool = True, root: Optional[str] = None
+    paths: Sequence[str],
+    dynamic: bool = True,
+    root: Optional[str] = None,
+    cache=None,
+    rules: Optional[Sequence[str]] = None,
 ) -> List[Finding]:
     """Analyze every ``.py`` under ``paths``; ``root`` anchors the
-    relative paths used in findings and baseline fingerprints."""
-    findings: List[Finding] = []
-    for f in iter_py_files(paths):
-        rel = os.path.relpath(f, root) if root else os.path.relpath(f)
-        findings.extend(analyze_file(f, dynamic=dynamic, relpath=rel))
-    return findings
+    relative paths used in findings and baseline fingerprints (defaulting
+    to each file's repo root, so fingerprints do not depend on how the
+    path was spelled or where the linter was launched from)."""
+    contexts = [
+        FileContext(f, relpath=norm_relpath(f, root)) for f in iter_py_files(paths)
+    ]
+    return analyze_contexts(contexts, dynamic=dynamic, cache=cache, rules=rules)

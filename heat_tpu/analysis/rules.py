@@ -4,6 +4,7 @@ A rule is a function ``check(ctx) -> Iterable[Finding]`` registered under a
 stable id.  Ids are grouped by family so suppressions and docs stay legible:
 
 =========  ===============================================================
+SPMD001    inline suppression of a reason-required rule needs a reason
 SPMD101    ppermute permutations must be valid (partial) bijections
 SPMD102    collective axis names must match the enclosing shard_map mesh
 SPMD201    trace purity: no host effects inside jit/shard_map/pallas fns
@@ -16,7 +17,16 @@ SPMD207    silent broad except around dispatch/collective/io sites
 SPMD301    Pallas BlockSpec tiles must respect the hardware tile grid
 SPMD302    pallas_call grids must be static (no traced values)
 SPMD401    jitted() cache keys: hashable, identity-stable parts only
+SPMD501    implicit resplit: binary operand splits disagree (hidden wire)
+SPMD502    redundant resplit chain: intermediate layout is never used
+SPMD503    split axis statically out of range (guaranteed runtime error)
+SPMD504    layout collective on a value inferred replicated (no-op)
 =========  ===============================================================
+
+SPMD501–504 are **program-scope** rules (``Rule.scope == "program"``):
+they run once over the whole analyzed tree on the splitflow
+interprocedural sharding-dataflow engine
+(:mod:`heat_tpu.analysis.splitflow`) instead of per file.
 
 The catalog with fix guidance lives in docs/lint.md; each checker's
 docstring is the source of truth for its exact conditions.
@@ -27,7 +37,15 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 from typing import Callable, Dict, Iterable, List
 
-__all__ = ["Finding", "Rule", "RULES", "rule", "all_rules"]
+__all__ = [
+    "Finding", "REASON_REQUIRED", "Rule", "RULES", "rule", "all_rules",
+]
+
+#: rule ids whose inline suppression must carry a ``-- reason`` tail
+#: (``# spmdlint: disable=SPMD204 -- bench harness, guards off by design``):
+#: both silence checks that exist to make a risky pattern *deliberate*, so
+#: a bare suppression defeats the purpose.  Enforced by SPMD001.
+REASON_REQUIRED = frozenset({"SPMD204", "SPMD207"})
 
 
 @dataclass(frozen=True)
@@ -47,6 +65,21 @@ class Finding:
     def fingerprint(self) -> str:
         return f"{self.rule}::{self.path}::{self.context}"
 
+    def to_dict(self) -> dict:
+        return {
+            "rule": self.rule, "path": self.path, "line": self.line,
+            "message": self.message, "hint": self.hint,
+            "context": self.context, "fingerprint": self.fingerprint(),
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "Finding":
+        return cls(
+            rule=d["rule"], path=d["path"], line=d["line"],
+            message=d["message"], hint=d.get("hint", ""),
+            context=d.get("context", ""),
+        )
+
     def render(self) -> str:
         out = f"{self.path}:{self.line}: {self.rule} {self.message}"
         if self.hint:
@@ -58,20 +91,24 @@ class Finding:
 class Rule:
     id: str
     title: str
-    check: Callable  # (FileContext) -> Iterable[Finding]
+    check: Callable  # (FileContext) -> Iterable[Finding]  [file scope]
     #: rules that execute snippets of the analyzed source (perm builders)
     #: are skipped under --no-dynamic
     dynamic: bool = False
+    #: "file" rules get one FileContext per call; "program" rules run ONCE
+    #: per analysis over the splitflow Program (every FileContext plus the
+    #: interprocedural sharding-dataflow results)
+    scope: str = "file"
 
 
 RULES: Dict[str, Rule] = {}
 
 
-def rule(rule_id: str, title: str, dynamic: bool = False):
+def rule(rule_id: str, title: str, dynamic: bool = False, scope: str = "file"):
     """Register a checker under ``rule_id``."""
 
     def deco(fn):
-        RULES[rule_id] = Rule(rule_id, title, fn, dynamic=dynamic)
+        RULES[rule_id] = Rule(rule_id, title, fn, dynamic=dynamic, scope=scope)
         return fn
 
     return deco

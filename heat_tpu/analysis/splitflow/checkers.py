@@ -1,0 +1,130 @@
+"""Program-scope rules over the splitflow dataflow results.
+
+Each checker receives the whole :class:`~heat_tpu.analysis.splitflow.engine.Program`
+and translates the engine's :class:`CommEvent` stream into findings.
+All four fire only on *known* layout facts — a ⊤ anywhere in the derived
+state produces no event, so these rules cannot guess.
+
+SPMD501 implicit resplit
+    ``__binary_op`` silently reshards its right operand when both
+    operands are split along different axes.  The program still computes
+    the right answer — it just moves a whole operand over the wire on
+    every evaluation, invisibly.  Resplit one input once, up front.
+
+SPMD502 redundant resplit chain
+    ``x.resplit(1).resplit(0)`` (directly nested, or through a
+    single-use temporary) materializes an intermediate layout nothing
+    reads.  Each hop is a full collective; go to the final split in one.
+
+SPMD503 split axis out of range
+    A literal split/resplit axis outside ``[-ndim, ndim)`` for a value of
+    statically-known rank is a guaranteed ``ValueError`` from
+    ``sanitize_axis`` at runtime.  A lint finding beats a crash at step
+    40k of a training run.
+
+SPMD504 layout collective on a replicated/identical layout
+    ``resplit`` to the split the value already has (including
+    ``resplit(None)`` of a value inferred replicated) is a no-op
+    layout-wise, but still walks the full plan/dispatch path every call.
+    Delete it, or gate it on ``x.split != target``.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, List
+
+from ..rules import Finding, rule
+from .engine import Program, _fmt_split
+
+__all__ = [
+    "check_implicit_resplit", "check_resplit_chain",
+    "check_split_out_of_range", "check_noop_collective",
+]
+
+
+def _findings_for(program: Program, op: str, build) -> List[Finding]:
+    out: List[Finding] = []
+    seen: set = set()
+    for ev in program.events:
+        if ev.fact.op != op:
+            continue
+        message, hint = build(ev)
+        f = ev.ctx.finding(_RULE_FOR[op], ev.node, message, hint)
+        if f is None:
+            continue
+        fp = f.fingerprint()
+        if fp in seen:
+            continue
+        seen.add(fp)
+        out.append(f)
+    return out
+
+
+_RULE_FOR = {
+    "implicit_resplit": "SPMD501",
+    "resplit_chain": "SPMD502",
+    "split_oob": "SPMD503",
+    "noop_collective": "SPMD504",
+}
+
+
+@rule("SPMD501", "implicit resplit: operand splits disagree", scope="program")
+def check_implicit_resplit(program: Program) -> Iterable[Finding]:
+    def build(ev):
+        f = ev.fact
+        where = f" of shape {f.shape}" if f.shape is not None else ""
+        return (
+            f"operands are split along axes {_fmt_split(f.src)} and "
+            f"{_fmt_split(f.dst)}; the right operand{where} is implicitly "
+            f"resharded to split={_fmt_split(f.dst)} on every evaluation",
+            "resplit one operand explicitly (once, outside any loop) so "
+            "the wire cost is visible and paid a single time",
+        )
+
+    return _findings_for(program, "implicit_resplit", build)
+
+
+@rule("SPMD502", "redundant resplit chain", scope="program")
+def check_resplit_chain(program: Program) -> Iterable[Finding]:
+    def build(ev):
+        return (
+            "resplit of a value that is itself a fresh resplit result; "
+            "the intermediate layout is never used",
+            "collapse the chain into a single resplit to the final axis — "
+            "each hop is a full redistribution collective",
+        )
+
+    return _findings_for(program, "resplit_chain", build)
+
+
+@rule("SPMD503", "split axis statically out of range", scope="program")
+def check_split_out_of_range(program: Program) -> Iterable[Finding]:
+    def build(ev):
+        f = ev.fact
+        ndim = len(f.shape) if f.shape is not None else "?"
+        return (
+            f"split axis {_fmt_split(f.dst)} is out of range for the "
+            f"{ndim}-d value (shape {f.shape}); sanitize_axis raises "
+            f"ValueError at runtime",
+            f"use an axis in [-{ndim}, {ndim}) or fix the shape",
+        )
+
+    return _findings_for(program, "split_oob", build)
+
+
+@rule("SPMD504", "layout collective on an already-matching layout",
+      scope="program")
+def check_noop_collective(program: Program) -> Iterable[Finding]:
+    def build(ev):
+        f = ev.fact
+        what = ("resplit(None) of a value inferred replicated"
+                if f.dst is None else
+                f"resplit to split={_fmt_split(f.dst)}, the split the value "
+                f"already has")
+        return (
+            f"{what}; the collective is a layout no-op",
+            "drop the call, or guard it with `if x.split != target:` when "
+            "the input layout varies",
+        )
+
+    return _findings_for(program, "noop_collective", build)

@@ -16,6 +16,7 @@ import numpy as np
 import jax.numpy as jnp
 
 from ..core import factories, random, types
+from ..core._split_semantics import split_semantics as _split_semantics
 from ..core.base import BaseEstimator, ClusteringMixin
 from ..core.dndarray import DNDarray
 from ..core.fuse import fuse
@@ -229,6 +230,7 @@ class _KCluster(ClusteringMixin, BaseEstimator):
             )
         return _fused_assign(x, self._cluster_centers, self._metric)
 
+    @_split_semantics("entry_fit")
     def fit(self, x: DNDarray):
         raise NotImplementedError()
 
@@ -253,6 +255,7 @@ class _KCluster(ClusteringMixin, BaseEstimator):
             lab, tuple(lab.shape), types.int64, labels_split, x.device, x.comm, True
         )
 
+    @_split_semantics("entry_split0")
     def predict(self, x: DNDarray) -> DNDarray:
         """Nearest learned centroid for each sample
         (reference _kcluster.py:233-249)."""

@@ -1,0 +1,267 @@
+"""Pure wire/memory cost arithmetic shared by runtime and static analysis.
+
+This module is the ONE place the byte models live:
+
+- :func:`ring_wire_model` — bytes per device for one ring collective
+  (the public :func:`heat_tpu.comm.compressed.wire_model` delegates here),
+- :func:`plan_cost` — the planned-redistribution schedule and its
+  wire/peak model (:func:`heat_tpu.comm.redistribute.plan` delegates its
+  arithmetic here),
+- :func:`monolithic_cost` — the one-shot GSPMD reshard envelope
+  (:func:`heat_tpu.comm.redistribute.monolithic_model` delegates here),
+- :func:`resolve_mode` — the collective-precision policy arithmetic
+  (which payloads compress, given an explicit policy + threshold).
+
+It deliberately imports NOTHING from jax or the rest of the package
+(stdlib only), so the static analyzer in
+:mod:`heat_tpu.analysis.splitflow` can load it by file path — via
+``importlib.util.spec_from_file_location`` — and compute the exact bytes
+the telemetry ledger will be credited with at runtime, without ever
+importing jax.  Because the runtime paths *delegate* to these functions
+rather than duplicating them, the statically reported numbers and the
+runtime-accounted numbers cannot drift apart; the oracle lane in
+``tests/test_splitflow_oracle.py`` asserts the equality end-to-end.
+
+All byte figures are PER DEVICE, matching the telemetry ledger's
+convention (docs/design.md §14).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional, Tuple
+
+__all__ = [
+    "BLOCK",
+    "encoded_bytes",
+    "itemsize",
+    "monolithic_cost",
+    "plan_cost",
+    "resolve_mode",
+    "ring_wire_model",
+]
+
+#: Quantization block length: one f32 scale per this many payload values.
+#: 128 is the TPU lane width, so every block is one register row and the
+#: scale overhead is 4/128 bytes/value (wire ratio ~0.258x of exact f32).
+BLOCK = 128
+
+#: dtype-name → bytes per element, for the dtypes the package produces.
+#: A plain table (not ``np.dtype``) keeps this module stdlib-only.
+_ITEMSIZES = {
+    "bool": 1, "int8": 1, "uint8": 1,
+    "float16": 2, "bfloat16": 2, "int16": 2, "uint16": 2,
+    "float32": 4, "int32": 4, "uint32": 4,
+    "float64": 8, "int64": 8, "uint64": 8,
+    "complex64": 8, "complex128": 16,
+}
+
+#: dtype names the collective-precision policy may compress; everything
+#: else always rides the wire exact (spmdlint SPMD203's runtime twin).
+_COMPRESSIBLE = ("float32", "bfloat16")
+
+
+def itemsize(dtype_name: str) -> int:
+    """Bytes per element of a canonical dtype name (e.g. ``"float32"``)."""
+    try:
+        return _ITEMSIZES[str(dtype_name)]
+    except KeyError:
+        raise ValueError(f"unknown dtype name {dtype_name!r}") from None
+
+
+def resolve_mode(
+    dtype_name: str,
+    payload_nbytes: int,
+    precision: str = "f32",
+    threshold: int = 1 << 16,
+) -> Optional[str]:
+    """Wire mode a payload rides under the given precision policy.
+
+    Returns ``"bf16"`` / ``"int8_block"``, or ``None`` for exact
+    transmission — the same decision table as
+    :func:`heat_tpu.comm.compressed.reduce_mode` with the process-global
+    policy passed in explicitly (that function delegates here after its
+    own contract checks).
+    """
+    if precision == "f32" or precision is None:
+        return None
+    if str(dtype_name) not in _COMPRESSIBLE:
+        return None
+    if precision == "auto":
+        return "int8_block" if int(payload_nbytes) >= int(threshold) else None
+    return precision
+
+
+def encoded_bytes(n_elems: int, mode: Optional[str], item: int) -> int:
+    """Bytes one payload of ``n_elems`` occupies on the wire under
+    ``mode`` (block-padded; one f32 scale per :data:`BLOCK` for int8)."""
+    if mode is None:
+        return int(n_elems) * int(item)
+    padded = max(BLOCK, -(-int(n_elems) // BLOCK) * BLOCK)
+    if mode == "int8_block":
+        return padded + (padded // BLOCK) * 4
+    return padded * 2  # bf16
+
+
+def ring_wire_model(n_elems: int, size: int, mode: Optional[str], *,
+                    block: int = BLOCK, op: str = "allreduce") -> dict:
+    """Bytes-moved model for one ring collective, per device.
+
+    The single source of the 0.258x claim: exact f32 ships 4 B/element,
+    ``int8_block`` 1 B/element plus one f32 scale per ``block`` elements
+    (132/512 per 128-block), ``bf16`` 2 B/element.  ``op="allreduce"``
+    models the reduce-scatter + all-gather ring (each device sends
+    ``2*(size-1)`` chunks of ``ceil(n/size)`` elements padded to the
+    block grid); ``op="allgather"`` the one-way ring (``size-1`` hops of
+    the ``n_elems``-element local shard).
+    """
+    p = max(int(size), 1)
+    if op == "allreduce":
+        chunk = -(-int(n_elems) // p)
+        hops = 2 * (p - 1)
+    elif op == "allgather":
+        chunk = int(n_elems)
+        hops = p - 1
+    else:
+        raise ValueError(f"unknown ring op {op!r}")
+    chunk_p = -(-chunk // int(block)) * int(block)
+    exact = hops * chunk_p * 4
+    if mode == "int8_block":
+        wire = hops * (chunk_p + (chunk_p // int(block)) * 4)
+    elif mode == "bf16":
+        wire = hops * chunk_p * 2
+    else:  # exact transmission (policy answered None / "f32")
+        wire = exact
+    return {
+        "ring_hops_per_device": hops,
+        "chunk_elems_padded": chunk_p,
+        "exact_wire_bytes": exact,
+        "wire_bytes": wire,
+        "bytes_ratio": round(wire / exact, 4) if exact else None,
+    }
+
+
+def _nelems(shape: Tuple[int, ...]) -> int:
+    n = 1
+    for s in shape:
+        n *= int(s)
+    return n
+
+
+def monolithic_cost(shape: Tuple[int, ...], item: int,
+                    src: Optional[int], dst: Optional[int], size: int) -> dict:
+    """Per-device cost envelope of the one-shot GSPMD reshard.
+
+    split→None is an all-gather (``(p-1)/p`` of the array per device; the
+    full array live).  None→split is a local slice (zero wire).
+    split→split is modeled as the reference ``Alltoallv``'s envelope —
+    the general GSPMD lowering gathers then slices, so the wire bytes are
+    the all-gather's and the peak briefly holds the full array plus the
+    input shard.
+    """
+    p = max(int(size), 1)
+    total = _nelems(shape) * int(item)
+    if p == 1 or src == dst or (src is None and dst is None):
+        return {"exact_wire_bytes": 0, "wire_bytes": 0, "peak_live_bytes": total}
+    if src is None:  # replicated -> split: local slice
+        return {
+            "exact_wire_bytes": 0,
+            "wire_bytes": 0,
+            "peak_live_bytes": total + total // p,
+        }
+    gather = (p - 1) * (total // p)  # each device receives p-1 foreign shards
+    peak = total + total // p  # full array + own shard live at the boundary
+    return {"exact_wire_bytes": gather, "wire_bytes": gather, "peak_live_bytes": peak}
+
+
+def plan_cost(
+    shape: Tuple[int, ...],
+    dtype_name: str,
+    src: Optional[int],
+    dst: Optional[int],
+    size: int,
+    *,
+    mode_for: Optional[Callable[[int], Optional[str]]] = None,
+) -> dict:
+    """Schedule + cost model of the planned redistribution.
+
+    The arithmetic half of :func:`heat_tpu.comm.redistribute.plan`:
+    returns ``{steps, mode, wire_bytes, exact_wire_bytes,
+    peak_live_bytes}`` for a ``shape`` array committed at split ``src``
+    moving to split ``dst`` over ``size`` devices.  ``mode_for`` maps a
+    wire payload's byte count to its compression mode (defaults to exact
+    transmission); the runtime passes the live collective-precision
+    policy, the static analyzer whatever policy it is asked to model.
+
+    Steps and figures are identical to the runtime planner's — the
+    runtime delegates here, so they cannot diverge.
+    """
+    shape = tuple(int(s) for s in shape)
+    item = itemsize(dtype_name)
+    p = max(int(size), 1)
+    n = _nelems(shape)
+    total = n * item
+    mode_for = mode_for or (lambda nbytes: None)
+
+    if p == 1 or src == dst or not shape or n == 0:
+        at_rest = total if src is None else total // p
+        return {
+            "steps": (), "mode": None, "wire_bytes": 0,
+            "exact_wire_bytes": 0, "peak_live_bytes": at_rest,
+        }
+
+    if dst is not None:
+        w_d = -(-shape[dst] // p)
+        pad_d = p * w_d - shape[dst]
+
+    if src is None:
+        # replicated -> split: pure local slice-discard, zero wire.
+        steps = []
+        if pad_d:
+            steps.append(("pad", dst, shape[dst]))
+        steps.append(("slice", dst))
+        padded_total = (n // shape[dst]) * (p * w_d) * item
+        peak = padded_total + padded_total // p  # full input + own slab
+        return {
+            "steps": tuple(steps), "mode": None, "wire_bytes": 0,
+            "exact_wire_bytes": 0, "peak_live_bytes": peak,
+        }
+
+    if dst is None:
+        # split -> replicated: all-gather fraction.  Each device ships
+        # its shard p-1 times around the ring; mode compresses the
+        # payload.
+        shard_elems = n // p
+        mode = mode_for(shard_elems * item)
+        exact = (p - 1) * shard_elems * item
+        wire = (p - 1) * encoded_bytes(shard_elems, mode, item)
+        peak = total // p + total  # own shard + assembled full array
+        if mode is not None:
+            peak += shard_elems * 4  # f32 staging of the encoded payload
+        return {
+            "steps": (("allgather", src),), "mode": mode, "wire_bytes": wire,
+            "exact_wire_bytes": exact, "peak_live_bytes": peak,
+        }
+
+    # split -> split: p-1 ppermute rotations over 1/p²-sized pieces.
+    # Wire (p-1)/p² of the array per device — p× less than gather+slice —
+    # and peak = input shard + output shard + one piece in flight.
+    w_s = shape[src] // p
+    rest = n // shape[src] // shape[dst]  # elements off the two split axes
+    piece_elems = w_s * w_d * rest
+    mode = mode_for(piece_elems * item)
+    steps = []
+    if pad_d:
+        steps.append(("pad", dst, shape[dst]))
+    steps.append(("view", dst))
+    steps.extend(("rotate", k) for k in range(1, p))
+    steps.append(("assemble", src))
+    exact = (p - 1) * piece_elems * item
+    wire = (p - 1) * encoded_bytes(piece_elems, mode, item)
+    slab = p * piece_elems * item  # == padded input shard == output shard
+    peak = 2 * slab + piece_elems * item
+    if mode is not None:
+        peak += piece_elems * 4  # f32 staging of the encoded piece
+    return {
+        "steps": tuple(steps), "mode": mode, "wire_bytes": wire,
+        "exact_wire_bytes": exact, "peak_live_bytes": peak,
+    }

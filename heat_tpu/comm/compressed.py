@@ -75,6 +75,7 @@ from ..core._compile import jitted, register_key_context
 from ..core._jax_compat import shape_dtype_struct, shard_map
 from ..core.communication import sanitize_comm
 from ..telemetry import _core as _tel
+from . import _costs
 
 __all__ = [
     "BLOCK",
@@ -97,7 +98,9 @@ __all__ = [
 #: Quantization block length: one f32 scale per this many payload values.
 #: 128 is the TPU lane width, so every block is one register row and the
 #: scale overhead is 4/128 bytes/value (wire ratio ~0.258x of exact f32).
-BLOCK = 128
+#: Canonically defined in the shared jax-free cost model (comm/_costs.py)
+#: so the static analyzer and the kernels agree by construction.
+BLOCK = _costs.BLOCK
 
 _MODES = ("f32", "bf16", "int8_block", "auto")
 _PRECISION = "f32"
@@ -203,19 +206,15 @@ def reduce_mode(dtype, payload_nbytes: int, precision: Optional[str] = None):
         raise ValueError(
             f"unknown collective precision {p!r}: expected one of {_MODES}"
         )
-    if p == "f32":
-        return None
-    if not _compressible(dtype):
-        if precision is not None:
-            raise TypeError(
-                f"quantized collective requested on exact dtype "
-                f"{jnp.dtype(dtype).name}: only float32/bfloat16 payloads "
-                "compress (SPMD203)"
-            )
-        return None
-    if p == "auto":
-        return "int8_block" if int(payload_nbytes) >= _AUTO_THRESHOLD else None
-    return p
+    if p != "f32" and not _compressible(dtype) and precision is not None:
+        raise TypeError(
+            f"quantized collective requested on exact dtype "
+            f"{jnp.dtype(dtype).name}: only float32/bfloat16 payloads "
+            "compress (SPMD203)"
+        )
+    return _costs.resolve_mode(
+        jnp.dtype(dtype).name, payload_nbytes, p, _AUTO_THRESHOLD
+    )
 
 
 # --------------------------------------------------------------------- #
@@ -611,31 +610,10 @@ def wire_model(n_elems: int, size: int, mode: Optional[str], *,
     of the ``n_elems``-element local shard).  Shared by bench.py's
     ``allreduce_q_wire_model`` headline and the telemetry layer's live
     exact-vs-wire byte accounting, so the reported ratio and the tested
-    exact-byte math can never drift apart."""
-    p = max(int(size), 1)
-    if op == "allreduce":
-        chunk = -(-int(n_elems) // p)
-        hops = 2 * (p - 1)
-    elif op == "allgather":
-        chunk = int(n_elems)
-        hops = p - 1
-    else:
-        raise ValueError(f"unknown ring op {op!r}")
-    chunk_p = -(-chunk // int(block)) * int(block)
-    exact = hops * chunk_p * 4
-    if mode == "int8_block":
-        wire = hops * (chunk_p + (chunk_p // int(block)) * 4)
-    elif mode == "bf16":
-        wire = hops * chunk_p * 2
-    else:  # exact transmission (policy answered None / "f32")
-        wire = exact
-    return {
-        "ring_hops_per_device": hops,
-        "chunk_elems_padded": chunk_p,
-        "exact_wire_bytes": exact,
-        "wire_bytes": wire,
-        "bytes_ratio": round(wire / exact, 4) if exact else None,
-    }
+    exact-byte math can never drift apart.  The arithmetic itself lives
+    in the shared jax-free model (:mod:`heat_tpu.comm._costs`), which the
+    static analyzer loads by file path."""
+    return _costs.ring_wire_model(n_elems, size, mode, block=block, op=op)
 
 
 def _account_wire(op: str, mode: Optional[str], n_elems: int, size: int,

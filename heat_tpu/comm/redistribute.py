@@ -88,6 +88,7 @@ from jax.sharding import PartitionSpec
 from ..core._compile import context_token, jitted, register_key_context
 from ..core._jax_compat import shard_map
 from ..telemetry import _core as _tel
+from . import _costs
 from . import compressed as _cq
 from .compressed import BLOCK
 
@@ -256,14 +257,10 @@ def _itemsize(dtype) -> int:
 
 def _encoded_bytes(n_elems: int, mode: Optional[str], itemsize: int) -> int:
     """Bytes one payload of ``n_elems`` occupies on the wire under
-    ``mode`` — the same arithmetic as :func:`compressed.wire_model`
-    (block-padded; one f32 scale per BLOCK for int8)."""
-    if mode is None:
-        return n_elems * itemsize
-    padded = max(BLOCK, -(-n_elems // BLOCK) * BLOCK)
-    if mode == "int8_block":
-        return padded + (padded // BLOCK) * 4
-    return padded * 2  # bf16
+    ``mode`` — delegates to the shared jax-free model in
+    :mod:`heat_tpu.comm._costs` (block-padded; one f32 scale per BLOCK
+    for int8), which the static analyzer loads by file path."""
+    return _costs.encoded_bytes(n_elems, mode, itemsize)
 
 
 def monolithic_model(global_shape, dtype, src, dst, size: int) -> dict:
@@ -279,22 +276,8 @@ def monolithic_model(global_shape, dtype, src, dst, size: int) -> dict:
     *envelope* the planner must beat, mirroring the worst-case receive
     buffers of reference communication.py:764-881.)
     """
-    p = max(int(size), 1)
     shape = tuple(int(s) for s in global_shape)
-    n = int(np.prod(shape)) if shape else 1
-    itemsize = _itemsize(dtype)
-    total = n * itemsize
-    if p == 1 or src == dst or (src is None and dst is None):
-        return {"exact_wire_bytes": 0, "wire_bytes": 0, "peak_live_bytes": total}
-    if src is None:  # replicated -> split: local slice
-        return {
-            "exact_wire_bytes": 0,
-            "wire_bytes": 0,
-            "peak_live_bytes": total + total // p,
-        }
-    gather = (p - 1) * (total // p)  # each device receives p-1 foreign shards
-    peak = total + total // p  # full array + own shard live at the boundary
-    return {"exact_wire_bytes": gather, "wire_bytes": gather, "peak_live_bytes": peak}
+    return _costs.monolithic_cost(shape, _itemsize(dtype), src, dst, size)
 
 
 #: plan cache — keyed like the compile cache (request signature + the
@@ -358,76 +341,29 @@ def plan(
 
 
 def _build_plan(shape, dtype, src, dst, p, max_live_bytes) -> Plan:
-    itemsize = _itemsize(dtype)
-    n = int(np.prod(shape)) if shape else 1
-    total = n * itemsize
+    # the arithmetic lives in the shared jax-free model (comm/_costs.py),
+    # which the static analyzer loads by file path — delegation, not
+    # duplication, is what keeps lint's cost report and the runtime
+    # ledger byte-identical
     dt = jnp.dtype(dtype).name
-
-    def _done(steps, wire, exact, peak, mode=None):
-        if max_live_bytes is not None and peak > max_live_bytes:
-            raise ValueError(
-                f"no schedule for {shape} {dt} split {src}->{dst} over {p} "
-                f"devices fits max_live_bytes={max_live_bytes}: the minimal "
-                f"schedule needs {peak} live bytes per device"
-            )
-        return Plan(
-            global_shape=shape, dtype=dt, src=src, dst=dst, size=p,
-            mode=mode, steps=tuple(steps), wire_bytes=int(wire),
-            exact_wire_bytes=int(exact), peak_live_bytes=int(peak),
-            max_live_bytes=max_live_bytes,
+    cost = _costs.plan_cost(
+        shape, dt, src, dst, p,
+        mode_for=lambda nbytes: _cq.reduce_mode(dtype, nbytes),
+    )
+    if max_live_bytes is not None and cost["peak_live_bytes"] > max_live_bytes:
+        raise ValueError(
+            f"no schedule for {shape} {dt} split {src}->{dst} over {p} "
+            f"devices fits max_live_bytes={max_live_bytes}: the minimal "
+            f"schedule needs {cost['peak_live_bytes']} live bytes per device"
         )
-
-    if p == 1 or src == dst or not shape or n == 0:
-        at_rest = total if src is None else total // p
-        return _done((), 0, 0, at_rest)
-
-    # rest = elements per (src-slab × dst-slab) cross-section
-    if dst is not None:
-        w_d = -(-shape[dst] // p)
-        pad_d = p * w_d - shape[dst]
-
-    if src is None:
-        # replicated -> split: pure local slice-discard, zero wire.
-        steps = []
-        if pad_d:
-            steps.append(("pad", dst, shape[dst]))
-        steps.append(("slice", dst))
-        padded_total = (n // shape[dst]) * (p * w_d) * itemsize
-        peak = padded_total + padded_total // p  # full input + own slab
-        return _done(steps, 0, 0, peak)
-
-    if dst is None:
-        # split -> replicated: all-gather fraction.  Each device ships its
-        # shard p-1 times around the ring; mode compresses the payload.
-        shard_elems = n // p
-        mode = _cq.reduce_mode(dtype, shard_elems * itemsize)
-        exact = (p - 1) * shard_elems * itemsize
-        wire = (p - 1) * _encoded_bytes(shard_elems, mode, itemsize)
-        peak = total // p + total  # own shard + assembled full array
-        if mode is not None:
-            peak += shard_elems * 4  # f32 staging of the encoded payload
-        return _done((("allgather", src),), wire, exact, peak, mode)
-
-    # split -> split: p-1 ppermute rotations over 1/p²-sized pieces.
-    # Wire (p-1)/p² of the array per device — p× less than gather+slice —
-    # and peak = input shard + output shard + one piece in flight.
-    w_s = shape[src] // p
-    rest = n // shape[src] // shape[dst]  # elements off the two split axes
-    piece_elems = w_s * w_d * rest
-    mode = _cq.reduce_mode(dtype, piece_elems * itemsize)
-    steps = []
-    if pad_d:
-        steps.append(("pad", dst, shape[dst]))
-    steps.append(("view", dst))
-    steps.extend(("rotate", k) for k in range(1, p))
-    steps.append(("assemble", src))
-    exact = (p - 1) * piece_elems * itemsize
-    wire = (p - 1) * _encoded_bytes(piece_elems, mode, itemsize)
-    slab = p * piece_elems * itemsize  # == padded input shard == output shard
-    peak = 2 * slab + piece_elems * itemsize
-    if mode is not None:
-        peak += piece_elems * 4  # f32 staging of the encoded piece
-    return _done(steps, wire, exact, peak, mode)
+    return Plan(
+        global_shape=tuple(shape), dtype=dt, src=src, dst=dst, size=p,
+        mode=cost["mode"], steps=cost["steps"],
+        wire_bytes=int(cost["wire_bytes"]),
+        exact_wire_bytes=int(cost["exact_wire_bytes"]),
+        peak_live_bytes=int(cost["peak_live_bytes"]),
+        max_live_bytes=max_live_bytes,
+    )
 
 
 # --------------------------------------------------------------------- #

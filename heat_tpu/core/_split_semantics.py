@@ -1,0 +1,164 @@
+"""Split-semantics declarations: the op layer's transfer-function registry.
+
+Every public op declares how it transforms sharding metadata — its
+*transfer function* over split specs — right next to its definition, via
+:func:`declare_split_semantics` tables at the bottom of each op module or
+the :func:`split_semantics` decorator on methods.  The declarations feed
+two consumers:
+
+1. **runtime** — :data:`REGISTRY` is importable and introspectable, and
+   the splitflow oracle suite (tests/test_splitflow_oracle.py) executes
+   each declared op and asserts the declared rule matches the observed
+   ``DNDarray.split`` metadata, so a declaration can never silently
+   drift from the code it sits next to;
+2. **static analysis** — :mod:`heat_tpu.analysis.splitflow` re-reads the
+   SAME declarations from this tree's source (AST-level, jax-free) and
+   uses them as the transfer functions of its abstract interpreter.
+
+This module is deliberately dependency-free (no jax, no numpy): the op
+modules import it at definition time and the analyzer may import it on a
+bare Python install.
+
+Kinds (the transfer-function families; ``params`` refine them):
+
+=================  =====================================================
+``elementwise``    unary map — splits, shape, raggedness preserved
+``binary``         broadcast binary — the ``__binary_op`` anchor rules:
+                   result carries the non-None split (re-anchored from
+                   the right under broadcasting); operands split along
+                   DIFFERENT axes force an implicit resplit of the
+                   second operand onto the first's layout
+``reduction``      axis reduction — reducing across the split axis
+                   yields split=None, otherwise the split index shifts
+                   down past removed axes (``__reduce_op``)
+``cumulative``     split and shape preserved (``__cum_op``)
+``matmul``         ``_result_split_matmul``: split-0 @ anything → row
+                   split, anything @ col-split → col split, contraction
+                   over the split axis → replicated
+``transpose``      split follows its axis through the permutation
+``reshape``        split preserved when the axis index survives, else
+                   re-split at 0 (``manipulations.reshape``)
+``concat``         first non-None operand split, along any axis
+``stack``          split shifts past the new axis
+``expand_dims``    split shifts past the inserted axis
+``squeeze``        split drops with its axis or shifts down
+``flatten``        any split → 0, replicated stays replicated
+``resplit``        explicit layout change to the ``axis`` argument —
+                   the one declared COMM op (costed by the
+                   redistribution plan model)
+``factory``        new array, split from the ``split=`` keyword
+``factory_like``   new array mirroring the input's layout
+``entry_fit``      estimator entry point returning the estimator itself
+``entry_split0``   library entry point whose result is row-split iff
+                   the data argument is row-split (predict family,
+                   cdist, the U factor of svd)
+``entry_svd``      ``SVD(U, S, V)`` namedtuple: U per ``entry_split0``,
+                   S and V replicated
+=================  =====================================================
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, Optional, Tuple
+
+__all__ = [
+    "KINDS",
+    "REGISTRY",
+    "Semantics",
+    "declare_split_semantics",
+    "declare_split_semantics_table",
+    "split_semantics",
+]
+
+KINDS = frozenset(
+    {
+        "elementwise",
+        "binary",
+        "reduction",
+        "cumulative",
+        "matmul",
+        "transpose",
+        "reshape",
+        "concat",
+        "stack",
+        "expand_dims",
+        "squeeze",
+        "flatten",
+        "resplit",
+        "factory",
+        "factory_like",
+        "entry_fit",
+        "entry_split0",
+        "entry_svd",
+    }
+)
+
+
+@dataclass(frozen=True)
+class Semantics:
+    """One op's declared transfer function.
+
+    ``name`` is the public leaf name call sites resolve to (module
+    function or method — the DNDarray methods delegate to the module
+    functions of the same name, so one declaration covers both
+    spellings).  ``module`` records where the declaration lives, for
+    drift diagnostics.  ``params`` is a frozen extras tuple.
+    """
+
+    name: str
+    kind: str
+    module: str
+    params: Tuple[Tuple[str, object], ...] = ()
+
+    def param(self, key: str, default=None):
+        for k, v in self.params:
+            if k == key:
+                return v
+        return default
+
+
+#: leaf name -> declared semantics.  One namespace on purpose: the public
+#: API is flat (``ht.*`` mirrors the reference) and method names shadow
+#: their module functions.
+REGISTRY: Dict[str, Semantics] = {}
+
+
+def declare_split_semantics(name: str, kind: str, *, module: str = "", **params) -> Semantics:
+    """Declare the transfer function of op ``name`` (table form — call at
+    the bottom of the module defining the op)."""
+    if kind not in KINDS:
+        raise ValueError(f"unknown split-semantics kind {kind!r} for {name!r}")
+    prev = REGISTRY.get(name)
+    sem = Semantics(name, kind, module, tuple(sorted(params.items())))
+    if prev is not None and (prev.kind, prev.params) != (sem.kind, sem.params):
+        raise ValueError(
+            f"conflicting split semantics for {name!r}: "
+            f"{prev.kind} from {prev.module} vs {kind} from {module}"
+        )
+    REGISTRY[name] = sem
+    return sem
+
+
+def declare_split_semantics_table(module: str, table: Dict[str, Tuple[str, ...]]) -> None:
+    """Bulk table form: ``{kind: (op names...)}``.  Keep the argument a
+    LITERAL dict — the static analyzer re-reads these declarations from
+    source, and only literal tables parse without execution."""
+    for kind, names in table.items():
+        for name in names:
+            declare_split_semantics(name, kind, module=module)
+
+
+def split_semantics(kind: str, name: Optional[str] = None, **params):
+    """Decorator form of :func:`declare_split_semantics` — registers the
+    function under its own name and returns it UNCHANGED (no wrapper, so
+    tracing, pickling, and ``cache_stable`` identity are unaffected)."""
+
+    def deco(fn):
+        declare_split_semantics(
+            name or fn.__name__, kind, module=getattr(fn, "__module__", ""), **params
+        )
+        fn.__split_semantics__ = REGISTRY[name or fn.__name__]
+        return fn
+
+    return deco

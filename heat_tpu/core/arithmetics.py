@@ -236,3 +236,24 @@ def prod(x, axis=None, out=None, keepdims=None, keepdim=None):
     """Product reduction (reference arithmetics.py:787-833)."""
     keepdims = merge_keepdims(keepdims, keepdim)
     return _operations.__reduce_op(jnp.prod, x, axis, out, neutral=1, keepdims=keepdims)
+
+
+# ----------------------------------------------------------------------- #
+# split semantics (transfer functions for heat_tpu.analysis.splitflow —    #
+# declared here so the registry cannot drift from the ops it describes)    #
+# ----------------------------------------------------------------------- #
+from ._split_semantics import declare_split_semantics_table  # noqa: E402
+
+declare_split_semantics_table(
+    __name__,
+    {
+        "binary": (
+            "add", "sub", "mul", "div", "floordiv", "fmod", "remainder",
+            "mod", "pow", "left_shift", "right_shift", "bitwise_and",
+            "bitwise_or", "bitwise_xor",
+        ),
+        "elementwise": ("invert",),
+        "reduction": ("sum", "prod"),
+        "cumulative": ("cumsum", "cumprod"),
+    },
+)

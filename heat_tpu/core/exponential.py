@@ -51,3 +51,12 @@ def log1p(x, out=None):
 def sqrt(x, out=None):
     """Square root (reference exponential.py:208-222)."""
     return _operations.__local_op(jnp.sqrt, x, out)
+
+
+# split semantics for heat_tpu.analysis.splitflow (see core/_split_semantics.py)
+from ._split_semantics import declare_split_semantics_table  # noqa: E402
+
+declare_split_semantics_table(
+    __name__,
+    {"elementwise": ("exp", "expm1", "exp2", "log", "log2", "log10", "log1p", "sqrt")},
+)

@@ -328,3 +328,18 @@ def logspace(
     if dtype is None:
         return result
     return result.astype(types.canonical_heat_type(dtype))
+
+
+# split semantics for heat_tpu.analysis.splitflow (see core/_split_semantics.py)
+from ._split_semantics import declare_split_semantics_table  # noqa: E402
+
+declare_split_semantics_table(
+    __name__,
+    {
+        "factory": (
+            "array", "arange", "empty", "zeros", "ones", "full", "eye",
+            "linspace", "logspace",
+        ),
+        "factory_like": ("empty_like", "zeros_like", "ones_like", "full_like"),
+    },
+)

@@ -423,3 +423,16 @@ def tril(m: DNDarray, k: int = 0) -> DNDarray:
 def triu(m: DNDarray, k: int = 0) -> DNDarray:
     """Upper-triangular part (reference basics.py:1247-1269)."""
     return __tri_op(m, k, jnp.triu)
+
+
+# split semantics for heat_tpu.analysis.splitflow (see core/_split_semantics.py)
+from .._split_semantics import declare_split_semantics_table  # noqa: E402
+
+declare_split_semantics_table(
+    __name__,
+    {
+        "matmul": ("matmul", "dot"),
+        "transpose": ("transpose",),
+        "elementwise": ("tril", "triu"),
+    },
+)

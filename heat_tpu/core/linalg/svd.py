@@ -144,6 +144,10 @@ def _svd_pipeline(a: DNDarray, osplit, dtype, compute_uv: bool):
 _fused_svd_pipeline = fuse(_svd_pipeline)
 
 
+from .._split_semantics import split_semantics as _split_semantics
+
+
+@_split_semantics("entry_svd")
 def svd(a: DNDarray, full_matrices: bool = False, compute_uv: bool = True):
     """Reduced SVD ``a = U @ diag(S) @ V.T``.
 

@@ -105,3 +105,18 @@ def logical_xor(t1, t2):
 def logical_not(t, out=None):
     """(reference logical.py:321-350)"""
     return _operations.__local_op(jnp.logical_not, t, out, no_cast=True)
+
+
+# split semantics for heat_tpu.analysis.splitflow (see core/_split_semantics.py)
+from ._split_semantics import declare_split_semantics_table  # noqa: E402
+
+declare_split_semantics_table(
+    __name__,
+    {
+        "reduction": ("all", "any"),
+        "binary": ("isclose", "logical_and", "logical_or", "logical_xor"),
+        "elementwise": (
+            "isfinite", "isinf", "isnan", "isneginf", "isposinf", "logical_not",
+        ),
+    },
+)

@@ -774,3 +774,21 @@ def topk(a: DNDarray, k: int, dim: int = -1, largest: bool = True, sorted: bool 
         out[1].larray = indices.larray
         return out
     return values, indices
+
+
+# split semantics for heat_tpu.analysis.splitflow (see core/_split_semantics.py)
+from ._split_semantics import declare_split_semantics_table  # noqa: E402
+
+declare_split_semantics_table(
+    __name__,
+    {
+        "concat": ("concatenate", "hstack", "vstack", "row_stack", "column_stack"),
+        "stack": ("stack",),
+        "expand_dims": ("expand_dims",),
+        "squeeze": ("squeeze",),
+        "flatten": ("flatten", "ravel"),
+        "reshape": ("reshape",),
+        "resplit": ("resplit", "resplit_"),
+        "elementwise": ("flip", "fliplr", "flipud"),
+    },
+)

@@ -60,3 +60,12 @@ def lt(t1, t2):
 def ne(t1, t2):
     """Elementwise != (reference relational.py:239-254)."""
     return _operations.__binary_op(jnp.not_equal, t1, t2)
+
+
+# split semantics for heat_tpu.analysis.splitflow (see core/_split_semantics.py)
+from ._split_semantics import declare_split_semantics_table  # noqa: E402
+
+declare_split_semantics_table(
+    __name__,
+    {"binary": ("eq", "ge", "gt", "le", "lt", "ne")},
+)

@@ -96,3 +96,12 @@ def sign(x, out=None):
 def trunc(x, out=None):
     """Truncate toward zero (reference rounding.py:285-315)."""
     return _operations.__local_op(jnp.trunc, x, out)
+
+
+# split semantics for heat_tpu.analysis.splitflow (see core/_split_semantics.py)
+from ._split_semantics import declare_split_semantics_table  # noqa: E402
+
+declare_split_semantics_table(
+    __name__,
+    {"elementwise": ("abs", "fabs", "ceil", "floor", "round", "sign", "trunc")},
+)

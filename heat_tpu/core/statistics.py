@@ -571,3 +571,18 @@ def var(x, axis=None, ddof: int = 0, **kwargs):
     Note: like the reference, ``ddof`` ∈ {0, 1} (bessel correction via
     ``bessel=True`` kwarg is also accepted)."""
     return _moment2(x, axis, ddof, kwargs, "stat.var", lambda r: r)
+
+
+# split semantics for heat_tpu.analysis.splitflow (see core/_split_semantics.py)
+from ._split_semantics import declare_split_semantics_table  # noqa: E402
+
+declare_split_semantics_table(
+    __name__,
+    {
+        "reduction": (
+            "argmax", "argmin", "max", "mean", "median", "min", "std",
+            "var", "kurtosis", "skew",
+        ),
+        "binary": ("maximum", "minimum"),
+    },
+)

@@ -115,3 +115,18 @@ def tan(x, out=None):
 def tanh(x, out=None):
     """Hyperbolic tangent (reference trigonometrics.py:388-421)."""
     return _operations.__local_op(jnp.tanh, x, out)
+
+
+# split semantics for heat_tpu.analysis.splitflow (see core/_split_semantics.py)
+from ._split_semantics import declare_split_semantics_table  # noqa: E402
+
+declare_split_semantics_table(
+    __name__,
+    {
+        "elementwise": (
+            "arccos", "arcsin", "arctan", "cos", "cosh", "deg2rad",
+            "rad2deg", "sin", "sinh", "tan", "tanh",
+        ),
+        "binary": ("arctan2",),
+    },
+)

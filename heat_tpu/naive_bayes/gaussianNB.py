@@ -20,6 +20,7 @@ import jax
 import jax.numpy as jnp
 
 from ..core import factories, types
+from ..core._split_semantics import split_semantics as _split_semantics
 from ..core.base import BaseEstimator, ClassificationMixin
 from ..core.dndarray import DNDarray
 from ..core.fuse import fuse
@@ -92,6 +93,7 @@ class GaussianNB(ClassificationMixin, BaseEstimator):
         self.epsilon_ = None
 
     # ------------------------------------------------------------------ #
+    @_split_semantics("entry_fit")
     def fit(self, x: DNDarray, y: DNDarray, sample_weight=None) -> "GaussianNB":
         """Fit from scratch (reference gaussianNB.py:81-133)."""
         self.classes_ = None
@@ -231,6 +233,7 @@ class GaussianNB(ClassificationMixin, BaseEstimator):
             np.asarray(self.class_prior_),
         )
 
+    @_split_semantics("entry_split0")
     def predict(self, x: DNDarray) -> DNDarray:
         """argmax-class labels (reference gaussianNB.py:475-500), one fused
         program: likelihood, argmax, class gather, and layout commit in a
@@ -239,6 +242,7 @@ class GaussianNB(ClassificationMixin, BaseEstimator):
         theta, sigma, prior = self._fit_params()
         return _fused_nb_predict(x, theta, sigma, prior, np.asarray(self.classes_))
 
+    @_split_semantics("entry_split0")
     def predict_log_proba(self, x: DNDarray) -> DNDarray:
         """Normalized log posteriors (reference gaussianNB.py:501-520; the
         distributed logsumexp :401-420 is one jax.nn.logsumexp here)."""
@@ -246,6 +250,7 @@ class GaussianNB(ClassificationMixin, BaseEstimator):
         theta, sigma, prior = self._fit_params()
         return _fused_nb_log_proba(x, theta, sigma, prior)
 
+    @_split_semantics("entry_split0")
     def predict_proba(self, x: DNDarray) -> DNDarray:
         """Posterior probabilities (reference gaussianNB.py:521-539)."""
         sanitize_in(x)

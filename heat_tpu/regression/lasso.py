@@ -15,6 +15,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from ..core import factories, types
+from ..core._split_semantics import split_semantics as _split_semantics
 from ..core.base import BaseEstimator, RegressionMixin
 from ..core.dndarray import DNDarray
 from ..core.sanitation import sanitize_in
@@ -113,6 +114,7 @@ class Lasso(RegressionMixin, BaseEstimator):
             comm=comm, splits=splits,
         )
 
+    @_split_semantics("entry_fit")
     def fit(self, x: DNDarray, y: DNDarray,
             resume: Union[bool, str] = False) -> "Lasso":
         """Cyclic coordinate descent (reference lasso.py:104-156).
@@ -372,6 +374,7 @@ class Lasso(RegressionMixin, BaseEstimator):
 
         return lax.while_loop(cond, body, carry)
 
+    @_split_semantics("entry_split0")
     def predict(self, x: DNDarray) -> DNDarray:
         """ŷ = [1, X] θ (reference lasso.py:157-170)."""
         sanitize_in(x)

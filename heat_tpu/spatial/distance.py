@@ -68,6 +68,10 @@ def _euclidean(xa, ya, quadratic_expansion: bool):
     return jnp.sqrt(jnp.sum(diff * diff, axis=-1))
 
 
+from ..core._split_semantics import split_semantics as _split_semantics
+
+
+@_split_semantics("entry_split0")
 def cdist(X: DNDarray, Y: Optional[DNDarray] = None, quadratic_expansion: bool = False) -> DNDarray:
     """Pairwise euclidean distances (reference distance.py:166-172).
 

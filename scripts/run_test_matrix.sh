@@ -9,9 +9,30 @@ sizes=("$@")
 [ $# -eq 0 ] && sizes=(1 2 3 4 7)
 fail=0
 echo "=== spmdlint (static SPMD-correctness gate, docs/lint.md) ==="
+# cold vs warm: first run repopulates the findings cache from scratch,
+# second run should be mostly cache hits — both wall times are printed by
+# the CLI ("[N.NNs, cache H hit, M miss]") for the CI log
+rm -rf .spmdlint-cache
+echo "--- cold (no cache) ---"
 if ! python scripts/spmdlint.py --baseline; then
     echo "FAILED spmdlint"
     fail=1
+fi
+echo "--- warm (cached) ---"
+if ! python scripts/spmdlint.py --baseline -q; then
+    echo "FAILED spmdlint (warm rerun disagrees with cold run)"
+    fail=1
+fi
+# static comm-cost report artifact: splitflow-modeled wire bytes per
+# function, priced with the runtime cost model (docs/lint.md)
+cost_dir="${HEAT_TELEMETRY_ARTIFACT_DIR:-/tmp/heat-telemetry-artifacts}"
+mkdir -p "$cost_dir"
+if ! python scripts/spmdlint.py --cost-report --format=json \
+        heat_tpu tests > "$cost_dir/spmd-cost-report.json"; then
+    echo "FAILED spmdlint --cost-report"
+    fail=1
+else
+    echo "cost report artifact: $cost_dir/spmd-cost-report.json"
 fi
 echo "=== fuse dispatch-count gate (one dispatch per fused pipeline) ==="
 if ! python -m pytest tests/test_fuse.py -q -k "dispatch or single_dispatch"; then

@@ -1,0 +1,88 @@
+"""Oracle pipelines: the SAME source is analyzed statically and executed.
+
+tests/test_splitflow_oracle.py points the splitflow engine at this file,
+reads the inferred split for every local variable, then runs each
+pipeline on a real mesh and asserts the runtime ``.split`` matches the
+static inference exactly — at mesh sizes 1, 2, 4 and 8.  The resplit
+pipeline additionally reconciles the static comm-cost report against the
+telemetry wire-byte ledger.
+
+Keep every shape a literal and divisible by 8 so all mesh sizes shard
+evenly; the engine prices collectives from these literals.
+"""
+
+import heat_tpu as ht
+
+__all__ = [
+    "svd_pipeline", "kmeans_pipeline", "lasso_pipeline", "gnb_pipeline",
+    "fused_pipeline", "resplit_pipeline",
+]
+
+
+def _features(comm=None):
+    """Deterministic row-split design matrix, (64, 32) float32."""
+    flat = ht.arange(2048, dtype=ht.float32, split=0, comm=comm)
+    x = flat.reshape((64, 32))
+    return x
+
+
+def _labels(comm=None):
+    """Alternating binary labels aligned with the rows of _features."""
+    y = ht.arange(64, split=0, comm=comm) % 2
+    return y
+
+
+def svd_pipeline(comm=None):
+    a = _features(comm)
+    u, s, v = ht.linalg.svd(a)
+    return a, u, s, v
+
+
+def kmeans_pipeline(comm=None):
+    x = _features(comm)
+    km = ht.cluster.KMeans(n_clusters=2, max_iter=3, random_state=0)
+    km.fit(x)
+    labels = km.predict(x)
+    return x, labels
+
+
+def lasso_pipeline(comm=None):
+    x = _features(comm)
+    y = _labels(comm)
+    model = ht.regression.Lasso(lam=0.01, max_iter=5)
+    model.fit(x, y)
+    pred = model.predict(x)
+    return x, y, pred
+
+
+def gnb_pipeline(comm=None):
+    x = _features(comm)
+    y = _labels(comm)
+    model = ht.naive_bayes.GaussianNB()
+    model.fit(x, y)
+    pred = model.predict(x)
+    proba = model.predict_proba(x)
+    return x, y, pred, proba
+
+
+@ht.fuse
+def _fused_core(a, b):
+    c = a + b
+    d = ht.sqrt(ht.abs(c))
+    return d
+
+
+def fused_pipeline(comm=None):
+    a = ht.ones((64, 32), dtype=ht.float32, split=0, comm=comm)
+    b = ht.full((64, 32), 3.0, dtype=ht.float32, split=0, comm=comm)
+    out = _fused_core(a, b)
+    return a, b, out
+
+
+def resplit_pipeline(comm=None):
+    """Pure layout traffic — every byte it moves is statically priceable."""
+    x = ht.ones((64, 32), dtype=ht.float32, split=0, comm=comm)
+    y = x.resplit(1)
+    z = ht.zeros((32, 64), dtype=ht.float32, split=1, comm=comm)
+    w = z.resplit(0)
+    return x, y, z, w

@@ -45,7 +45,7 @@ def test_mixed_split_autoresplit():
     a = np.arange(16, dtype=np.float32).reshape(4, 4)
     x0 = ht.array(a, split=0)
     x1 = ht.array(a, split=1)
-    assert_array_equal(x0 + x1, a + a)
+    assert_array_equal(x0 + x1, a + a)  # spmdlint: disable=SPMD501 -- auto-reshard IS the behavior under test
 
 
 def test_broadcasting():

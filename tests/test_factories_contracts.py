@@ -14,7 +14,7 @@ import heat_tpu as ht
 def test_arange_contracts():
     # reference test_factories.py:110-114
     with pytest.raises(ValueError):
-        ht.arange(-5, 3, split=1)
+        ht.arange(-5, 3, split=1)  # spmdlint: disable=SPMD503 -- contract test expects the ValueError
     with pytest.raises(TypeError):
         ht.arange()
     with pytest.raises(TypeError):
@@ -44,7 +44,7 @@ def test_array_contracts():
     with pytest.raises(TypeError):
         ht.array((4,), split="a")
     with pytest.raises(ValueError):
-        ht.array((4,), split=3)
+        ht.array((4,), split=3)  # spmdlint: disable=SPMD503 -- contract test expects the ValueError
     with pytest.raises(TypeError):
         ht.array((4,), comm={})
 
@@ -94,7 +94,7 @@ def test_like_contracts():
 def test_linspace_logspace_contracts():
     # reference test_factories.py:632-636, :686-690
     with pytest.raises(ValueError):
-        ht.linspace(-5, 3, split=1)
+        ht.linspace(-5, 3, split=1)  # spmdlint: disable=SPMD503 -- contract test expects the ValueError
     with pytest.raises(ValueError):
         ht.linspace(-5, 3, num=-1)
     with pytest.raises(ValueError):
@@ -113,7 +113,7 @@ def test_linspace_logspace_contracts():
         rtol=1e-6,
     )
     with pytest.raises(ValueError):
-        ht.logspace(-5, 3, split=1)
+        ht.logspace(-5, 3, split=1)  # spmdlint: disable=SPMD503 -- contract test expects the ValueError
     np.testing.assert_allclose(
         ht.logspace(0, 3, num=4, base=2.0).numpy(),
         np.logspace(0, 3, num=4, base=2.0, dtype=np.float32),

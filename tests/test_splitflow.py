@@ -1,0 +1,706 @@
+"""splitflow: the interprocedural sharding-dataflow engine, unit-level.
+
+Covers the abstract domain lattice, the declared transfer functions, the
+engine's interprocedural/alias/loop machinery, the SPMD501-504 fixture
+pairs (one trigger + one clean each), reason-required suppressions
+(SPMD001), the comm-cost report's determinism, the findings cache, and
+the fingerprint path-insensitivity guarantee.  The runtime ground-truth
+counterpart lives in tests/test_splitflow_oracle.py.
+"""
+
+import ast
+import json
+import os
+
+import pytest
+
+from heat_tpu.analysis import analyze_file, analyze_paths
+from heat_tpu.analysis.cache import FindingsCache
+from heat_tpu.analysis.core import FileContext, norm_relpath
+from heat_tpu.analysis.splitflow import (
+    NOT_ARRAY,
+    Spec,
+    TOP,
+    UNKNOWN,
+    apply_kind,
+    build_program,
+    cost_report,
+    join,
+    package_registry,
+    static_registry,
+)
+from heat_tpu.analysis.splitflow.registry import parse_declarations
+from heat_tpu.analysis.splitflow.transfer import MISSING, NONLIT
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def lint(source, rule=None):
+    findings = analyze_file(
+        os.path.join(REPO, "tests", "_fixture.py"),
+        source=source,
+        relpath="tests/_fixture.py",
+    )
+    if rule is not None:
+        findings = [f for f in findings if f.rule == rule]
+    return findings
+
+
+def program_of(*sources):
+    """Build a Program from fixture sources; each item is either source
+    text (default relpath) or a ``(relpath, source)`` pair."""
+    ctxs = []
+    for i, item in enumerate(sources):
+        rel, src = item if isinstance(item, tuple) else (f"tests/_fix{i}.py", item)
+        ctxs.append(FileContext(os.path.join(REPO, rel), source=src, relpath=rel))
+    return build_program(ctxs)
+
+
+def env_of(program, fn):
+    for (_mod, qual), env in program.fn_envs.items():
+        if qual == fn:
+            return env
+    raise AssertionError(f"no env for {fn}: {sorted(program.fn_envs)}")
+
+
+# --------------------------------------------------------------------- #
+# domain lattice                                                         #
+# --------------------------------------------------------------------- #
+def test_join_is_least_upper_bound():
+    s0 = Spec(split=0)
+    s1 = Spec(split=1)
+    srep = Spec(split=None)
+    assert join(s0, s0).split == 0
+    assert join(s0, s1).split is TOP
+    assert join(s0, srep).split is TOP  # replicated is a KNOWN layout
+    assert join(s0, UNKNOWN).split is TOP
+    assert join(UNKNOWN, UNKNOWN).split is TOP
+
+
+def test_join_merges_shape_dtype_componentwise():
+    a = Spec(split=0, shape=(8, 8), dtype="float32")
+    b = Spec(split=0, shape=(8, 8), dtype="float32")
+    j = join(a, b)
+    assert (j.split, j.shape, j.dtype) == (0, (8, 8), "float32")
+    j2 = join(a, Spec(split=0, shape=(4, 4), dtype="int32"))
+    assert j2.split == 0 and j2.shape is None and j2.dtype is None
+
+
+def test_join_non_array_with_array_stays_sound():
+    assert join(NOT_ARRAY, NOT_ARRAY) is NOT_ARRAY
+    assert join(Spec(split=0), NOT_ARRAY).is_array  # mixed -> array, split ⊤
+
+
+def test_lattice_height_two_loops_converge_in_two_passes():
+    # join(join(a, b), b) == join(a, b) for every pair: one extra pass
+    # can never change the result, which is what lets the engine run
+    # loop bodies exactly twice
+    vals = [Spec(split=0), Spec(split=1), Spec(split=None), UNKNOWN]
+    for a in vals:
+        for b in vals:
+            j = join(a, b)
+            assert join(j, b).split == j.split
+            assert join(j, a).split == j.split
+
+
+# --------------------------------------------------------------------- #
+# transfer functions                                                     #
+# --------------------------------------------------------------------- #
+def test_binary_left_anchor_and_implicit_resplit_fact():
+    a = Spec(split=0, shape=(8, 8), dtype="float32")
+    b = Spec(split=1, shape=(8, 8), dtype="float32")
+    out, facts = apply_kind("binary", [a, b])
+    assert out.split == 0  # the left operand's layout wins
+    assert [f.op for f in facts] == ["implicit_resplit"]
+    assert (facts[0].src, facts[0].dst) == (1, 0)
+    # agreeing splits move no bytes
+    out, facts = apply_kind("binary", [a, a])
+    assert out.split == 0 and facts == []
+
+
+def test_reduction_drops_or_shifts_the_split():
+    x = Spec(split=1, shape=(4, 8, 16), dtype="float32")
+    # reducing the split axis loses the layout (results are combined)
+    out, facts = apply_kind("reduction", [x], axis=1)
+    assert out.split is None
+    assert [f.op for f in facts] == ["reduce"]
+    # reducing below the split axis shifts it down
+    out, facts = apply_kind("reduction", [x], axis=0)
+    assert out.split == 0 and facts == []
+    # reducing above leaves it alone
+    out, _ = apply_kind("reduction", [Spec(split=0, shape=(4, 8))], axis=1)
+    assert out.split == 0
+    # axis=None is a FULL reduction (the runtime default; the ENGINE
+    # supplies it for axis-less calls) — an absent axis here means
+    # "possibly dynamic" and must stay ⊤
+    out, _ = apply_kind("reduction", [x], axis=None)
+    assert out.split is None
+    out, _ = apply_kind("reduction", [x])
+    assert out.split is TOP
+
+
+def test_matmul_row_and_column_anchors():
+    a = Spec(split=0, shape=(8, 4), dtype="float32")
+    b = Spec(split=None, shape=(4, 8), dtype="float32")
+    out, _ = apply_kind("matmul", [a, b])
+    assert out.split == 0  # row-split left -> row-split result
+    out, _ = apply_kind("matmul", [Spec(split=None, shape=(8, 4)),
+                                   Spec(split=1, shape=(4, 8))])
+    assert out.split == 1  # column-split right -> column-split result
+    # sharded contraction axis -> replicated result plus a combine fact
+    out, facts = apply_kind("matmul", [Spec(split=1, shape=(8, 4)),
+                                       Spec(split=None, shape=(4, 8))])
+    assert out.split is None
+    assert [f.op for f in facts] == ["reduce"]
+
+
+def test_transpose_permutes_the_split():
+    x = Spec(split=0, shape=(4, 8, 16), dtype="float32")
+    out, _ = apply_kind("transpose", [x], axis=(2, 0, 1))
+    assert out.split == 1  # axes.index(0)
+    out, _ = apply_kind("transpose", [x], axis=None)  # .T / full reverse
+    assert out.split == 2
+    # absent axes = possibly dynamic -> sound ⊤
+    out, _ = apply_kind("transpose", [x])
+    assert out.split is TOP
+
+
+def test_reshape_keeps_in_range_split():
+    x = Spec(split=1, shape=(8, 8), dtype="float32")
+    out, _ = apply_kind("reshape", [x], shape=(8, 4, 2))
+    assert out.split == 1
+    out, _ = apply_kind("flatten", [x])
+    assert out.split == 0
+
+
+def test_resplit_emits_facts():
+    x = Spec(split=0, shape=(8, 8), dtype="float32")
+    out, facts = apply_kind("resplit", [x], split=1)
+    assert out.split == 1
+    assert [f.op for f in facts] == ["resplit"]
+    # no-op collective
+    out, facts = apply_kind("resplit", [x], split=0)
+    assert [f.op for f in facts] == ["noop_collective"]
+    # out-of-range target is a guaranteed runtime ValueError
+    out, facts = apply_kind("resplit", [x], split=5)
+    assert [f.op for f in facts] == ["split_oob"]
+    # dynamic (non-literal) target: unknown result, NO fact — never guess
+    out, facts = apply_kind("resplit", [x], split=NONLIT)
+    assert out.split is TOP and facts == []
+
+
+def test_factory_literals_and_oob():
+    out, facts = apply_kind("factory", [], shape=(8, 8), split=1,
+                            dtype="float32")
+    assert (out.split, out.shape, out.dtype) == (1, (8, 8), "float32")
+    assert facts == []
+    _, facts = apply_kind("factory", [], shape=(8, 8), split=3)
+    assert [f.op for f in facts] == ["split_oob"]
+    out, _ = apply_kind("factory", [], shape=(8, 8), split=NONLIT)
+    assert out.split is TOP
+
+
+def test_entry_svd_tall_and_wide():
+    u, s, v = apply_kind("entry_svd", [Spec(split=0, shape=(64, 8))])[0]
+    assert (u.split, s.split, v.split) == (0, None, None)
+    u, s, v = apply_kind("entry_svd", [Spec(split=1, shape=(8, 64))])[0]
+    assert (u.split, v.split) == (None, 0)
+
+
+def test_unknown_operands_stay_unknown():
+    out, facts = apply_kind("binary", [UNKNOWN, Spec(split=1)])
+    assert out.split is TOP and facts == []
+    out, facts = apply_kind("resplit", [UNKNOWN], split=1)
+    assert out.split == 1  # explicit resplit pins the layout regardless
+    assert facts == []  # ...but unknown source prices nothing
+
+
+# --------------------------------------------------------------------- #
+# the static registry                                                    #
+# --------------------------------------------------------------------- #
+def test_package_registry_parses_without_importing_heat_tpu():
+    reg = package_registry()
+    assert len(reg) > 50
+    assert reg["add"].kind == "binary"
+    assert reg["resplit"].kind == "resplit"
+    assert reg["ones"].kind == "factory"
+    assert reg["svd"].kind == "entry_svd"
+
+
+def test_parse_declarations_all_three_forms():
+    tree = ast.parse(
+        "declare_split_semantics_table('m', {'binary': ('f', 'g')})\n"
+        "declare_split_semantics('h', 'reduction')\n"
+        "@split_semantics('elementwise')\n"
+        "def k(x):\n    return x\n"
+    )
+    decls = parse_declarations(tree)
+    assert {n: d.kind for n, d in decls.items()} == {
+        "f": "binary", "g": "binary", "h": "reduction", "k": "elementwise",
+    }
+
+
+def test_static_registry_merges_fixture_trees():
+    tree = ast.parse("declare_split_semantics('my_op', 'elementwise')")
+    merged = static_registry([tree])
+    assert merged["my_op"].kind == "elementwise"
+    assert "my_op" not in package_registry()
+
+
+# --------------------------------------------------------------------- #
+# the engine                                                             #
+# --------------------------------------------------------------------- #
+def test_interprocedural_propagation_through_helper():
+    prog = program_of("""
+import heat_tpu as ht
+
+def helper(x):
+    return x.resplit(1)
+
+def caller():
+    a = ht.ones((8, 8), split=0)
+    b = helper(a)
+    return b
+""")
+    assert env_of(prog, "caller")["b"].split == 1
+
+
+def test_star_import_resolves_factory():
+    prog = program_of("""
+from heat_tpu.core.factories import *
+
+def f():
+    a = ones((8, 8), split=0)
+    return a
+""")
+    spec = env_of(prog, "f")["a"]
+    assert (spec.split, spec.shape) == (0, (8, 8))
+
+
+def test_type_checking_imports_do_not_break_resolution():
+    prog = program_of("""
+from typing import TYPE_CHECKING
+if TYPE_CHECKING:
+    from heat_tpu.core.dndarray import DNDarray
+import heat_tpu as ht
+
+def f(x: "DNDarray"):
+    a = ht.ones((8, 8), split=1)
+    return a
+""")
+    assert env_of(prog, "f")["a"].split == 1
+
+
+@pytest.mark.parametrize("init_src", [
+    "from .impl import helper\n",
+    "from .impl import *\n",
+])
+def test_reexport_through_package_init(init_src):
+    prog = program_of(
+        ("pkg/impl.py", "def helper(x):\n    return x.resplit(1)\n"),
+        ("pkg/__init__.py", init_src),
+        ("use.py", """
+import heat_tpu as ht
+from pkg import helper
+
+def caller():
+    a = ht.ones((8, 8), split=0)
+    b = helper(a)
+    return b
+"""),
+    )
+    assert env_of(prog, "caller")["b"].split == 1
+
+
+def test_real_comm_init_reexports_resolve():
+    files = ["heat_tpu/comm/__init__.py", "heat_tpu/comm/redistribute.py"]
+    ctxs = [FileContext(os.path.join(REPO, f), relpath=f) for f in files]
+    prog = build_program(ctxs)
+    resolved = prog.resolve_def("heat_tpu.comm.plan")
+    assert resolved is not None
+    ctx, fn = resolved
+    assert ctx.module == "heat_tpu.comm.redistribute" and fn.name == "plan"
+
+
+def test_loop_fixpoint_stable_and_widening():
+    prog = program_of("""
+import heat_tpu as ht
+
+def f():
+    a = ht.ones((8, 8), split=0)
+    for _ in range(3):
+        a = a + 1.0
+    b = ht.ones((8, 8), split=0)
+    for _ in range(3):
+        b = b.resplit(1)
+    return a, b
+""")
+    env = env_of(prog, "f")
+    assert env["a"].split == 0  # layout-stable body: no widening
+    assert env["b"].split is TOP  # layout changes across iterations: ⊤
+
+
+def test_branch_join_widens_disagreeing_layouts():
+    prog = program_of("""
+import heat_tpu as ht
+
+def f(flag):
+    a = ht.ones((8, 8), split=0)
+    if flag:
+        a = a.resplit(1)
+    return a
+""")
+    assert env_of(prog, "f")["a"].split is TOP
+
+
+def test_inplace_resplit_rebinds_the_receiver():
+    prog = program_of("""
+import heat_tpu as ht
+
+def f():
+    a = ht.ones((8, 8), split=0)
+    a.resplit_(1)
+    return a
+""")
+    assert env_of(prog, "f")["a"].split == 1
+
+
+def test_tuple_unpacking_of_svd():
+    prog = program_of("""
+import heat_tpu as ht
+
+def f():
+    a = ht.ones((64, 8), split=0)
+    u, s, v = ht.linalg.svd(a)
+    return u, s, v
+""")
+    env = env_of(prog, "f")
+    assert env["u"].split == 0
+    assert env["s"].split is None
+    assert env["v"].split is None
+
+
+def test_recursion_terminates_at_unknown():
+    prog = program_of("""
+import heat_tpu as ht
+
+def spin(x):
+    return spin(x.resplit(1))
+
+def f():
+    a = ht.ones((8, 8), split=0)
+    b = spin(a)
+    return b
+""")
+    assert env_of(prog, "f")["b"].split is TOP  # guard, not a hang
+
+
+# --------------------------------------------------------------------- #
+# SPMD501-504 fixtures                                                   #
+# --------------------------------------------------------------------- #
+def test_spmd501_triggers_on_disagreeing_binary_splits():
+    findings = lint("""
+import heat_tpu as ht
+
+def f():
+    a = ht.ones((8, 8), split=0)
+    b = ht.ones((8, 8), split=1)
+    return a + b
+""", "SPMD501")
+    assert findings, "split-0 + split-1 must fire SPMD501"
+    assert "implicit" in findings[0].message
+
+
+def test_spmd501_clean_on_matching_splits():
+    assert lint("""
+import heat_tpu as ht
+
+def f():
+    a = ht.ones((8, 8), split=0)
+    b = ht.ones((8, 8), split=0)
+    return a + b
+""", "SPMD501") == []
+
+
+def test_spmd501_suppressible_inline():
+    assert lint("""
+import heat_tpu as ht
+
+def f():
+    a = ht.ones((8, 8), split=0)
+    b = ht.ones((8, 8), split=1)
+    return a + b  # spmdlint: disable=SPMD501 -- mixed layouts on purpose
+""", "SPMD501") == []
+
+
+def test_spmd502_triggers_on_chained_resplit():
+    findings = lint("""
+import heat_tpu as ht
+
+def f():
+    a = ht.ones((8, 8), split=0)
+    return a.resplit(1).resplit(None)
+""", "SPMD502")
+    assert findings, "nested resplit chain must fire SPMD502"
+
+
+def test_spmd502_triggers_on_single_use_intermediate():
+    findings = lint("""
+import heat_tpu as ht
+
+def f():
+    a = ht.ones((8, 8), split=0)
+    t = a.resplit(1)
+    return t.resplit(None)
+""", "SPMD502")
+    assert findings, "resplit of a once-used resplit result must fire"
+
+
+def test_spmd502_clean_when_intermediate_is_used():
+    assert lint("""
+import heat_tpu as ht
+
+def f():
+    a = ht.ones((8, 8), split=0)
+    t = a.resplit(1)
+    col_sum = t.sum(axis=0)
+    return t.resplit(None), col_sum
+""", "SPMD502") == []
+
+
+def test_spmd503_triggers_on_out_of_range_factory_split():
+    findings = lint("""
+import heat_tpu as ht
+
+def f():
+    return ht.ones((8, 8), split=2)
+""", "SPMD503")
+    assert findings, "split=2 on a rank-2 array must fire SPMD503"
+
+
+def test_spmd503_triggers_on_out_of_range_resplit():
+    findings = lint("""
+import heat_tpu as ht
+
+def f():
+    a = ht.ones((8, 8), split=0)
+    return a.resplit(5)
+""", "SPMD503")
+    assert findings
+
+
+def test_spmd503_clean_in_range():
+    assert lint("""
+import heat_tpu as ht
+
+def f():
+    return ht.ones((8, 8), split=1)
+""", "SPMD503") == []
+
+
+def test_spmd504_triggers_on_noop_resplit():
+    findings = lint("""
+import heat_tpu as ht
+
+def f():
+    a = ht.ones((8, 8), split=0)
+    return a.resplit(0)
+""", "SPMD504")
+    assert findings, "resplit to the current layout must fire SPMD504"
+
+
+def test_spmd504_clean_after_inplace_layout_change():
+    # the regression that motivated in-place modeling: resplit_(None)
+    # then resplit_(0) is NOT a no-op — the first call changed the layout
+    assert lint("""
+import heat_tpu as ht
+
+def f():
+    a = ht.ones((8, 8), split=0)
+    a.resplit_(None)
+    a.resplit_(0)
+    return a
+""", "SPMD504") == []
+
+
+def test_program_rules_never_fire_on_unknown_layouts():
+    # open-world parameters are ⊤; rules must stay silent, not guess
+    assert [f for f in lint("""
+import heat_tpu as ht
+
+def f(a, b):
+    c = a + b
+    return c.resplit(0)
+""") if f.rule.startswith("SPMD5")] == []
+
+
+# --------------------------------------------------------------------- #
+# suppressions: reasons and SPMD001                                      #
+# --------------------------------------------------------------------- #
+def test_spmd001_fires_on_reasonless_required_suppression():
+    findings = lint("""
+try:
+    pass
+except Exception:  # spmdlint: disable=SPMD207
+    pass
+""", "SPMD001")
+    assert findings, "reasonless SPMD207 suppression must fire SPMD001"
+    assert "reason" in findings[0].message
+
+
+def test_spmd001_quiet_with_reason():
+    assert lint("""
+try:
+    pass
+except Exception:  # spmdlint: disable=SPMD207 -- degraded mode is fine here
+    pass
+""", "SPMD001") == []
+
+
+def test_spmd001_quiet_for_rules_not_requiring_reasons():
+    assert lint("""
+import heat_tpu as ht
+
+def f():
+    a = ht.ones((8, 8), split=0)
+    return a.resplit(0)  # spmdlint: disable=SPMD504
+""", "SPMD001") == []
+
+
+def test_spmd001_ignores_pragmas_inside_string_literals():
+    # a lint-testing file quoting a pragma in a fixture string must not
+    # be reported for it — suppressions are read from COMMENT tokens
+    assert lint('''
+SRC = """
+except Exception:  # spmdlint: disable=SPMD207
+"""
+''', "SPMD001") == []
+
+
+# --------------------------------------------------------------------- #
+# cost report                                                            #
+# --------------------------------------------------------------------- #
+COST_SRC = """
+import heat_tpu as ht
+
+def mover():
+    x = ht.ones((64, 8), dtype=ht.float32, split=0)
+    y = x.resplit(1)
+    return y
+"""
+
+
+def test_cost_report_prices_with_the_runtime_model():
+    from heat_tpu.comm import _costs
+
+    prog = program_of(COST_SRC)
+    rep = cost_report(prog, mesh=8, precision="f32")
+    site = "tests/_fix0.py::mover"
+    assert site in rep["functions"]
+    expected = _costs.plan_cost(
+        (64, 8), "float32", 0, 1, 8,
+        mode_for=lambda n: _costs.resolve_mode("float32", n, "f32"),
+    )
+    assert rep["functions"][site]["modeled_wire_bytes"] == expected["wire_bytes"]
+    assert rep["totals"]["modeled_wire_bytes"] == expected["wire_bytes"]
+    assert rep["totals"]["unmodeled_events"] == 0
+
+
+def test_cost_report_counts_unpriceable_events():
+    # dynamic shape: the layout is knowable, the byte count is not
+    prog = program_of("""
+import heat_tpu as ht
+
+def f(n):
+    x = ht.ones(n, split=0)
+    return x.resplit(1)
+""")
+    rep = cost_report(prog, mesh=8)
+    assert rep["totals"]["unmodeled_events"] == 1
+    assert rep["totals"]["modeled_wire_bytes"] == 0
+
+
+def test_cost_report_is_deterministic():
+    prog = program_of(COST_SRC)
+    a = json.dumps(cost_report(prog, mesh=8), sort_keys=True)
+    prog2 = program_of(COST_SRC)
+    b = json.dumps(cost_report(prog2, mesh=8), sort_keys=True)
+    assert a == b
+
+
+def test_cost_report_render_table_smoke():
+    from heat_tpu.analysis.splitflow import render_table
+
+    prog = program_of(COST_SRC)
+    out = render_table(cost_report(prog, mesh=4))
+    assert "mover" in out and "TOTAL" in out
+
+
+# --------------------------------------------------------------------- #
+# findings cache                                                         #
+# --------------------------------------------------------------------- #
+def test_cache_cold_then_warm(tmp_path):
+    target = os.path.join(REPO, "heat_tpu", "analysis", "rules.py")
+    cache = FindingsCache(str(tmp_path / "cache"))
+    cold = analyze_paths([target], root=REPO, cache=cache)
+    assert cache.misses == 1 and cache.hits == 0
+    cache2 = FindingsCache(str(tmp_path / "cache"))
+    warm = analyze_paths([target], root=REPO, cache=cache2)
+    assert cache2.hits == 1 and cache2.misses == 0
+    assert [f.to_dict() for f in cold] == [f.to_dict() for f in warm]
+
+
+def test_cache_invalidates_on_mtime_change(tmp_path):
+    src = tmp_path / "mod.py"
+    src.write_text("import heat_tpu as ht\n")
+    cache = FindingsCache(str(tmp_path / "cache"))
+    analyze_paths([str(src)], root=str(tmp_path), cache=cache)
+    assert cache.misses == 1
+    # touch with a different mtime -> the entry is stale
+    os.utime(str(src), (1, 1))
+    cache2 = FindingsCache(str(tmp_path / "cache"))
+    analyze_paths([str(src)], root=str(tmp_path), cache=cache2)
+    assert cache2.misses == 1 and cache2.hits == 0
+
+
+def test_cache_invalidates_on_rule_subset(tmp_path):
+    src = tmp_path / "mod.py"
+    src.write_text("import heat_tpu as ht\n")
+    cache = FindingsCache(str(tmp_path / "cache"))
+    analyze_paths([str(src)], root=str(tmp_path), cache=cache)
+    cache2 = FindingsCache(str(tmp_path / "cache"))
+    analyze_paths([str(src)], root=str(tmp_path), cache=cache2,
+                  rules=["SPMD207"])
+    assert cache2.hits == 0  # different key: rule subset changes results
+
+
+def test_cache_survives_corrupt_entries(tmp_path):
+    src = tmp_path / "mod.py"
+    src.write_text("import heat_tpu as ht\n")
+    cache = FindingsCache(str(tmp_path / "cache"))
+    analyze_paths([str(src)], root=str(tmp_path), cache=cache)
+    for entry in (tmp_path / "cache").iterdir():
+        entry.write_text("{not json")
+    cache2 = FindingsCache(str(tmp_path / "cache"))
+    analyze_paths([str(src)], root=str(tmp_path), cache=cache2)
+    assert cache2.misses == 1 and cache2.hits == 0  # corrupt == miss
+
+
+# --------------------------------------------------------------------- #
+# fingerprint path-insensitivity                                         #
+# --------------------------------------------------------------------- #
+def test_fingerprints_do_not_depend_on_path_spelling():
+    target = os.path.join(REPO, "heat_tpu", "analysis")
+    spellings = [
+        target,
+        os.path.join(REPO, ".", "heat_tpu", "analysis"),
+        os.path.relpath(target, os.getcwd()),
+    ]
+    prints = []
+    for p in spellings:
+        findings = analyze_paths([p])
+        prints.append(sorted(f.fingerprint() for f in findings))
+        for f in findings:
+            assert not os.path.isabs(f.path), f.path
+            assert not f.path.startswith("."), f.path
+    assert prints[0] == prints[1] == prints[2]

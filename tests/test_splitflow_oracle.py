@@ -1,0 +1,193 @@
+"""The splitflow oracle lane: static inference vs. the running system.
+
+Three ground-truth reconciliations, each pinning the static analyzer to
+something the runtime actually does:
+
+1. **Split oracle** — every pipeline in tests/splitflow_pipelines.py is
+   analyzed statically AND executed on a real mesh (sizes 1/2/4/8); the
+   runtime ``.split`` of every returned array must EQUAL the split the
+   engine inferred for the same variable.  Exact equality, no tolerance:
+   a transfer function that drifts from the runtime semantics fails here
+   before it mis-reports a lint finding anywhere else.
+
+2. **Byte oracle** — the resplit-only pipeline's statically modeled wire
+   bytes (scripts/spmdlint.py --cost-report) must equal the telemetry
+   ledger's ``comm.wire_bytes``/``comm.exact_bytes`` after really
+   running it under the planned redistribution policy at the same mesh.
+   The pipeline moves ONLY layout traffic with literal shapes, f32, and
+   evenly-dividing meshes, so the model is exact, not approximate.
+
+3. **Registry oracle** — the runtime split-semantics registry (built by
+   importing heat_tpu) must equal the static parse of the same
+   declarations (built without importing heat_tpu), name-for-name and
+   kind-for-kind.  This is the no-drift contract that makes the whole
+   static analysis trustworthy.
+"""
+
+import os
+
+import jax
+import pytest
+
+import heat_tpu as ht
+from heat_tpu import telemetry
+from heat_tpu.comm import redistribute as rd
+from heat_tpu.core.communication import XlaCommunication
+
+import tests.splitflow_pipelines as pipelines
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURE = os.path.join(REPO, "tests", "splitflow_pipelines.py")
+
+MESHES = [1, 2, 4, 8]
+
+#: pipeline -> the variable names its return tuple binds, in order
+RETURNS = {
+    "svd_pipeline": ("a", "u", "s", "v"),
+    "kmeans_pipeline": ("x", "labels"),
+    "lasso_pipeline": ("x", "y", "pred"),
+    "gnb_pipeline": ("x", "y", "pred", "proba"),
+    "fused_pipeline": ("a", "b", "out"),
+    "resplit_pipeline": ("x", "y", "z", "w"),
+}
+
+
+def _sub_comm(k):
+    devs = jax.devices()
+    if len(devs) < k:
+        pytest.skip(f"mesh size {k} needs {k} devices, have {len(devs)}")
+    return XlaCommunication(devs[:k])
+
+
+@pytest.fixture(scope="module")
+def program():
+    from heat_tpu.analysis.core import FileContext, norm_relpath
+    from heat_tpu.analysis.splitflow import build_program
+
+    ctx = FileContext(FIXTURE, relpath=norm_relpath(FIXTURE, REPO))
+    assert not ctx.skip_file, ctx.skip_reason
+    return build_program([ctx])
+
+
+def _static_env(program, fn_name):
+    for (mod, qual), env in program.fn_envs.items():
+        if qual == fn_name:
+            return env
+    raise AssertionError(f"no static env for {fn_name}")
+
+
+# --------------------------------------------------------------------- #
+# 1. split oracle
+# --------------------------------------------------------------------- #
+@pytest.mark.parametrize("mesh", MESHES)
+@pytest.mark.parametrize("name", sorted(RETURNS))
+def test_runtime_split_matches_static_inference(program, name, mesh):
+    comm = _sub_comm(mesh)
+    env = _static_env(program, name)
+    out = getattr(pipelines, name)(comm)
+    assert len(out) == len(RETURNS[name])
+    for var, arr in zip(RETURNS[name], out):
+        spec = env[var]
+        assert spec.is_array, (name, var)
+        assert arr.split == spec.split, (
+            f"{name}: runtime {var}.split={arr.split} but splitflow "
+            f"inferred {spec.split} (mesh {mesh})"
+        )
+
+
+def test_static_shapes_match_runtime_shapes(program):
+    """Where the engine inferred a literal shape, it must be the real one."""
+    comm = _sub_comm(1)
+    for name, vars_ in sorted(RETURNS.items()):
+        env = _static_env(program, name)
+        out = getattr(pipelines, name)(comm)
+        for var, arr in zip(vars_, out):
+            spec = env[var]
+            if spec.shape is not None:
+                assert tuple(spec.shape) == tuple(arr.shape), (name, var)
+
+
+# --------------------------------------------------------------------- #
+# 2. byte oracle
+# --------------------------------------------------------------------- #
+@pytest.mark.parametrize("mesh", MESHES)
+def test_modeled_bytes_match_telemetry_ledger(program, mesh):
+    from heat_tpu.analysis.splitflow import cost_report
+
+    comm = _sub_comm(mesh)
+    report = cost_report(program, mesh=mesh, precision="f32")
+    site = "tests/splitflow_pipelines.py::resplit_pipeline"
+    assert site in report["functions"], sorted(report["functions"])
+    modeled = report["functions"][site]
+
+    telemetry.enable()
+    telemetry.reset()
+    try:
+        with rd.redistribution("planned"):
+            pipelines.resplit_pipeline(comm)
+        snap = telemetry.snapshot()
+    finally:
+        telemetry.reset()
+        telemetry.disable()
+
+    counters = snap["counters"]
+    observed_wire = counters.get("comm.wire_bytes", 0)
+    observed_exact = counters.get("comm.exact_bytes", 0)
+    assert modeled["modeled_wire_bytes"] == observed_wire, (
+        f"mesh {mesh}: static model says {modeled['modeled_wire_bytes']} "
+        f"wire bytes, ledger recorded {observed_wire}"
+    )
+    assert modeled["modeled_exact_bytes"] == observed_exact
+    if mesh == 1:
+        # single-device plans are empty; nothing moves, nothing is billed
+        assert observed_wire == 0
+    else:
+        assert observed_wire > 0
+        assert counters.get("comm.resplit.planned", 0) == 2
+
+
+@pytest.mark.parametrize("mesh", [2, 8])
+def test_modeled_bytes_match_plan_objects(program, mesh):
+    """The report's per-event prices must be exactly plan()'s prices."""
+    from heat_tpu.analysis.splitflow import cost_report
+
+    report = cost_report(program, mesh=mesh, precision="f32")
+    fn = report["functions"]["tests/splitflow_pipelines.py::resplit_pipeline"]
+    priced = [e for e in fn["events"] if e.get("wire_bytes") is not None]
+    assert len(priced) == 2
+    for ev in priced:
+        # the report renders splits as strings ("0", "1", "None", "⊤")
+        src, dst = int(ev["src"]), int(ev["dst"])
+        p = rd.plan(tuple(ev["shape"]), ev["dtype"], src, dst, mesh)
+        assert ev["wire_bytes"] == p.wire_bytes
+        assert ev["exact_wire_bytes"] == p.exact_wire_bytes
+
+
+# --------------------------------------------------------------------- #
+# 3. registry oracle
+# --------------------------------------------------------------------- #
+def test_static_registry_equals_runtime_registry():
+    from heat_tpu.analysis.splitflow.registry import package_registry
+    from heat_tpu.core._split_semantics import REGISTRY
+
+    static = package_registry()
+    runtime_names = set(REGISTRY)
+    static_names = set(static)
+    assert static_names == runtime_names, (
+        f"only-static={sorted(static_names - runtime_names)} "
+        f"only-runtime={sorted(runtime_names - static_names)}"
+    )
+    for name, sem in REGISTRY.items():
+        assert static[name].kind == sem.kind, name
+        assert static[name].params == sem.params, name
+
+
+def test_fixture_is_clean_under_program_rules():
+    """The oracle pipelines themselves carry no sharding-dataflow bugs
+    beyond the two deliberate (non-finding) resplit events."""
+    from heat_tpu.analysis.core import FileContext, analyze_contexts, norm_relpath
+
+    ctx = FileContext(FIXTURE, relpath=norm_relpath(FIXTURE, REPO))
+    findings = analyze_contexts([ctx])
+    spmd5 = [f for f in findings if f.rule.startswith("SPMD5")]
+    assert spmd5 == [], [f.render() for f in spmd5]

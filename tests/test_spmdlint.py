@@ -934,8 +934,9 @@ def test_baseline_fingerprint_is_line_insensitive():
 # --------------------------------------------------------------------- #
 def test_every_rule_is_registered():
     assert [r.id for r in all_rules()] == [
-        "SPMD101", "SPMD102", "SPMD201", "SPMD202", "SPMD203", "SPMD204",
-        "SPMD205", "SPMD206", "SPMD207", "SPMD301", "SPMD302", "SPMD401",
+        "SPMD001", "SPMD101", "SPMD102", "SPMD201", "SPMD202", "SPMD203",
+        "SPMD204", "SPMD205", "SPMD206", "SPMD207", "SPMD301", "SPMD302",
+        "SPMD401", "SPMD501", "SPMD502", "SPMD503", "SPMD504",
     ]
 
 
