@@ -2,8 +2,11 @@
 
 Prints ONE JSON line (VERDICT r5 #1: self-contained, < ~1500 chars):
   {"metric": "kmeans_iter_per_sec", "value": N, "unit": "iter/s",
-   "vs_baseline": R, <every headline value>, "golden_health": {...},
-   "vs_golden": {...}, "roofline_pct": {...}, "full_report": ...}
+   "vs_baseline": R, <headline>: [value, vs_golden, roofline_pct?], ...,
+   "golden_health": {...}, "full_report": ...}
+Each headline key maps to a compact triple — measured value, ratio vs its
+bound-type golden control, and (modeled metrics only) %-of-binding-roofline
+— so every headline name is serialized once instead of three times.
 and writes the full verbose report (spreads, dispositions, raw per-group
 goldens, work models, notes) to BENCH_FULL.json beside this script in the
 same run.
@@ -98,6 +101,8 @@ _HEADLINE = {
     "eager_ops_per_sec": True,
     "fused_pipeline_ms": False,
     "lasso_sweeps_per_sec": True,
+    "serve_predictions_per_sec": True,
+    "serve_p99_ms": False,
     "qr_svd_tall_skinny_ms": False,
     "attention_tokens_per_sec": True,
     "causal_attention_tokens_per_sec": True,
@@ -157,6 +162,13 @@ _GOLDEN_MAP = {
     # move together under a slower tunnel, the ratio stays put)
     "fused_pipeline_ms": ("roundtrip_ms", "div"),
     "lasso_sweeps_per_sec": ("reduce_gb_per_sec", "div"),
+    # serving is dispatch-latency bound (one host->device->host round
+    # trip per micro-batch); the PRIMARY control is the in-run unbatched
+    # direct-predict twin (serve_direct_predictions_per_sec, bitwise
+    # compared — ratio = serve_vs_direct), the roundtrip golden is the
+    # secondary machine-health control the _GOLDEN_MAP can express
+    "serve_predictions_per_sec": ("roundtrip_ms", "mul"),
+    "serve_p99_ms": ("roundtrip_ms", "div"),
     # qr_svd is a single fused dispatch as of r6 (the whole QR+SVD
     # pipeline in one fenced fori_loop — see qr_svd_ms), so the metric is
     # back to tracking device compute and its control is the compute
@@ -285,6 +297,15 @@ _NOT_MODELED = {
         "not HBM or MXU — the bytes-moved model lives in resplit_wire_model "
         "(the rotation schedule ships (p-1)/p² of the array per device vs "
         "the monolithic envelope's (p-1)/p, a factor p fewer)",
+    "serve_predictions_per_sec":
+        "dispatch-latency-bound by design: the micro-batch payloads are "
+        "tiny, so the headline measures the serving stack (coalesce, pad, "
+        "commit, one fused dispatch, scatter replies) — the chip-side "
+        "control is the in-run unbatched twin (serve_vs_direct), and "
+        "occupancy/wire stats live in serve_model",
+    "serve_p99_ms":
+        "same serving stack, tail-latency view: p99 is queueing + batching "
+        "delay + dispatch latency, not chip work — no fixed FLOP count",
 }
 
 
@@ -1261,6 +1282,57 @@ def lasso_rate(data: np.ndarray, X):
     return _slope_rate(timed, *_win(50, 1000, 7))
 
 
+def serve_rates(data):
+    """PR-10 tentpole: multi-tenant micro-batched serving on persistent
+    compiled predict programs (heat_tpu.serve).  A KMeans model is
+    published to a throwaway registry and driven with the seeded
+    open-loop generator; the headline pair is throughput
+    (serve_predictions_per_sec) and tail latency (serve_p99_ms).  The
+    PRIMARY golden is the in-run unbatched direct-predict twin — every
+    request re-run without batching, compared BITWISE (the ratio ships
+    as serve_vs_direct); the roundtrip golden is the secondary
+    machine-health control.  The dispatch model rides along:
+    dispatches_per_batch == 1.0 by construction (one compiled dispatch
+    per micro-batch, counted by the telemetry dispatch window), plus
+    batch occupancy and wire bytes per row."""
+    import tempfile
+
+    import heat_tpu as ht
+    from heat_tpu.serve import ModelRegistry, ServeEngine, loadgen
+
+    fit_rows = 2_000 if _SMOKE else 20_000
+    km = ht.cluster.KMeans(n_clusters=K, max_iter=3, random_state=0)
+    km.fit(ht.array(data[:fit_rows], split=0))
+    reg = ModelRegistry(tempfile.mkdtemp(prefix="heat-serve-bench-"))
+    reg.publish("bench", "km", km)
+    eng = ServeEngine(reg, max_batch_rows=64, min_bucket=8)
+    # warmup: trace every row bucket the schedule can hit
+    loadgen.run(eng, "bench", "km", seed=0, n_requests=32, twin=False)
+    n_req = 64 if _SMOKE else 512
+    runs = 3 if _SMOKE else 7
+    reports = [
+        loadgen.run(eng, "bench", "km", seed=s + 1, n_requests=n_req,
+                    twin=(s == 0))
+        for s in range(runs)
+    ]
+    twin = reports[0].twin
+    pps, pps_spread = _summary([r.predictions_per_sec for r in reports])
+    p99, p99_spread = _summary([r.p99_ms for r in reports])
+    stats = eng.stats()
+    model = {
+        "dispatches_per_batch": stats["dispatches_per_batch"],
+        "batch_occupancy": round(stats["batch_occupancy"], 3),
+        "payload_bytes": int(stats["payload_bytes"]),
+        "reply_bytes": int(stats["reply_bytes"]),
+        "wire_bytes_per_row": round(
+            (stats["payload_bytes"] + stats["reply_bytes"]) / stats["rows"], 1
+        ),
+        "direct_bitwise_equal": bool(twin["bitwise_equal"]),
+    }
+    eng.close()
+    return (pps, pps_spread), (p99, p99_spread), twin, model
+
+
 #: headline-metric -> golden measurement group (goldens re-measured at
 #: each group boundary, adjacent in time to the metrics they control)
 _METRIC_GROUP = {
@@ -1276,6 +1348,8 @@ _METRIC_GROUP = {
     "eager_ops_per_sec": "eager_lasso",
     "fused_pipeline_ms": "eager_lasso",
     "lasso_sweeps_per_sec": "eager_lasso",
+    "serve_predictions_per_sec": "serve",
+    "serve_p99_ms": "serve",
     "qr_svd_tall_skinny_ms": "qr",
     "attention_tokens_per_sec": "attention",
     "causal_attention_tokens_per_sec": "attention",
@@ -1286,30 +1360,37 @@ _METRIC_GROUP = {
 def _compact_line(result: dict) -> dict:
     """The ONE printed JSON line (VERDICT r5 #1: self-contained, < ~1500
     chars): every headline value, golden health, per-metric vs_golden, and
-    %-of-binding-roofline for the modeled metrics.  Everything else —
-    spreads, dispositions, raw per-group goldens, work models, the notes —
-    lives in the full report written to BENCH_FULL.json in the same run."""
+    %-of-binding-roofline for the modeled metrics.  Each headline key maps
+    to the triple ``[value, vs_golden, roofline_pct]`` (third slot only
+    when a work model exists) so the long metric names are serialized once,
+    not three times.  Everything else — spreads, dispositions, raw
+    per-group goldens, work models, the notes — lives in the full report
+    written to BENCH_FULL.json in the same run."""
     out = {
         "metric": result["metric"],
         "value": result["value"],
         "unit": result["unit"],
         "vs_baseline": result.get("vs_baseline"),
     }
-    for key in _HEADLINE:
-        if key != result["metric"] and result.get(key) is not None:
-            out[key] = result[key]
-    out["golden_health"] = result["golden"]["health"]
-    out["vs_golden"] = {k: round(v, 2) for k, v in result["vs_golden"].items()}
     roof = result.get("roofline", {})
-    out["roofline_pct"] = {
-        k: v.get(
-            "pct_compute_roofline"
-            if v.get("bound") == "compute"
-            else "pct_hbm_roofline"
-        )
-        for k, v in roof.items()
-        if isinstance(v, dict) and "bound" in v
-    }
+    for key in _HEADLINE:
+        val = result["value"] if key == result["metric"] else result.get(key)
+        if val is None:
+            continue
+        entry = [val]
+        vg = result["vs_golden"].get(key)
+        entry.append(round(vg, 2) if isinstance(vg, (int, float)) else None)
+        rv = roof.get(key)
+        if isinstance(rv, dict) and "bound" in rv:
+            entry.append(
+                rv.get(
+                    "pct_compute_roofline"
+                    if rv.get("bound") == "compute"
+                    else "pct_hbm_roofline"
+                )
+            )
+        out[key] = entry
+    out["golden_health"] = result["golden"]["health"]
     if "regressions_vs_best_round" in result:
         out["flagged"] = sorted(result["regressions_vs_best_round"])
     if result.get("smoke"):
@@ -1356,6 +1437,13 @@ def main():
         pipe_dispatches,
     ) = fused_pipeline_ms(X)
     lasso_sweeps, lasso_spread = lasso_rate(data, X)
+    golden.measure("serve")
+    (
+        (serve_pps, serve_pps_spread),
+        (serve_p99, serve_p99_spread),
+        serve_twin,
+        serve_model,
+    ) = serve_rates(data)
     golden.measure("qr")
     qr_ms, qr_spread = qr_svd_ms()
     golden.measure("attention")
@@ -1414,6 +1502,22 @@ def main():
                 "fused_pipeline_dispatches_per_call": pipe_dispatches["fused"],
                 "eager_pipeline_dispatches_per_call": pipe_dispatches["eager"],
                 "lasso_sweeps_per_sec": round(lasso_sweeps, 2),
+                # PR-10 tentpole: multi-tenant micro-batched serving on
+                # persistent compiled predict programs; the unbatched
+                # direct-predict twin (bitwise-compared in-run) is this
+                # pair's golden, serve_vs_direct the batching verdict,
+                # and the dispatch model pins one dispatch per micro-batch
+                "serve_predictions_per_sec": round(serve_pps, 1),
+                "serve_p99_ms": round(serve_p99, 3),
+                "serve_direct_predictions_per_sec": round(
+                    serve_twin["predictions_per_sec"], 1
+                ),
+                "serve_vs_direct": (
+                    round(serve_pps / serve_twin["predictions_per_sec"], 3)
+                    if serve_twin["predictions_per_sec"]
+                    else None
+                ),
+                "serve_model": serve_model,
                 "qr_svd_tall_skinny_ms": round(qr_ms, 2),
                 # sequence-parallel flagship: fused flash-attention
                 # forwards, bf16 S=4096 H=16 D=64 (tokens/s)
@@ -1442,6 +1546,8 @@ def main():
                     "fused_pipeline_ms": fused_ms_spread,
                     "eager_pipeline_ms": eager_pipe_spread,
                     "lasso_sweeps_per_sec": lasso_spread,
+                    "serve_predictions_per_sec": serve_pps_spread,
+                    "serve_p99_ms": serve_p99_spread,
                     "qr_svd_tall_skinny_ms": qr_spread,
                     "attention_tokens_per_sec": attn_spread,
                     "causal_attention_tokens_per_sec": causal_spread,

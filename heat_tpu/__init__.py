@@ -60,6 +60,7 @@ from . import spatial
 from . import telemetry
 from . import utils
 from . import datasets
+from . import serve
 
 
 def __getattr__(name):

@@ -1112,6 +1112,145 @@ def check_silent_broad_except(ctx: FileContext) -> Iterable[Finding]:
 
 
 # --------------------------------------------------------------------- #
+# SPMD208: unbucketed dynamic batch shape entering a compiled program    #
+# --------------------------------------------------------------------- #
+#: shape-canonicalization helpers: a slice bound routed through one of
+#: these is drawn from a finite shape space (powers of two / shard
+#: multiples), so the compiled-program cache stays bounded
+_BUCKETING_CALLS = {
+    "bucket_rows", "next_pow2", "_padded_len", "pad_to_bucket",
+    "pad_to_shards", "pad_batch",
+}
+
+
+def _is_compiled_callable(ctx: FileContext, func: ast.AST, at: ast.AST) -> bool:
+    """True when ``func`` is a compiled-program value: a direct
+    ``fuse(f)(...)`` / ``jitted(key, make)(...)`` product, a name bound
+    from one, or a function defined under ``@fuse`` / ``@jax.jit``."""
+    if isinstance(func, ast.Call):
+        return ctx.resolves_to(func.func, "fuse", "jitted", "jit", "jax.jit")
+    if isinstance(func, ast.Name):
+        rec = ctx.lookup(func.id, at)
+        if (
+            rec is not None
+            and rec[0] == "expr"
+            and isinstance(rec[1], ast.Call)
+            and ctx.resolves_to(rec[1].func, "fuse", "jitted", "jit", "jax.jit")
+        ):
+            return True
+        fn = ctx.local_function(func.id, at)
+        if isinstance(fn, ast.FunctionDef):
+            for dec in fn.decorator_list:
+                target = dec.func if isinstance(dec, ast.Call) else dec
+                if ctx.resolves_to(target, "fuse", "jit", "jax.jit"):
+                    return True
+    return False
+
+
+def _bound_is_bucketed(ctx: FileContext, bound: ast.AST, at: ast.AST) -> bool:
+    """True when the slice bound routes through a bucketing helper —
+    directly (``x[:bucket_rows(n)]``) or via one local assignment
+    (``b = bucket_rows(n); ... x[:b]``)."""
+    for sub in ast.walk(bound):
+        if isinstance(sub, ast.Call):
+            dotted = ctx.resolve(sub.func) or ""
+            if dotted.rsplit(".", 1)[-1] in _BUCKETING_CALLS:
+                return True
+        if isinstance(sub, ast.Name):
+            rec = ctx.lookup(sub.id, at)
+            if rec is not None and rec[0] == "expr" and isinstance(rec[1], ast.Call):
+                dotted = ctx.resolve(rec[1].func) or ""
+                if dotted.rsplit(".", 1)[-1] in _BUCKETING_CALLS:
+                    return True
+    return False
+
+
+def _dynamic_slice_operand(
+    ctx: FileContext, expr: ast.AST, at: ast.AST
+) -> Optional[ast.Subscript]:
+    """The offending Subscript when ``expr`` is (or is a name
+    once-assigned from) a slice whose bounds are dynamic and unbucketed;
+    None otherwise."""
+    if isinstance(expr, ast.Name):
+        rec = ctx.lookup(expr.id, at)
+        if rec is not None and rec[0] == "expr":
+            expr = rec[1]
+    if not isinstance(expr, ast.Subscript):
+        return None
+    sl = expr.slice
+    slices = [sl] if isinstance(sl, ast.Slice) else (
+        [e for e in sl.elts if isinstance(e, ast.Slice)]
+        if isinstance(sl, ast.Tuple) else []
+    )
+    bounds = [b for s in slices for b in (s.lower, s.upper) if b is not None]
+    dynamic = [
+        b for b in bounds
+        if any(isinstance(sub, (ast.Name, ast.Call)) for sub in ast.walk(b))
+    ]
+    if not dynamic:
+        return None
+    if all(_bound_is_bucketed(ctx, b, at) for b in dynamic):
+        return None
+    return expr
+
+
+@rule("SPMD208", "unbucketed dynamic batch shape entering a compiled program in a loop")
+def check_unbucketed_dynamic_batch(ctx: FileContext) -> Iterable[Finding]:
+    """A call to a compiled program (``fuse(...)`` / ``jitted(...)``
+    product or ``@fuse``-decorated function) lexically inside a
+    ``for``/``while`` body, where an operand is a slice with
+    data-dependent bounds (``queue[off : off + n]``), retraces and
+    recompiles once per DISTINCT shape — the compiled-program cache keys
+    on operand avals, so a request-sized slice turns the cache into an
+    unbounded compile treadmill (one entry per batch size ever seen,
+    each a full trace+lower+compile pause at serving time).
+
+    The fix is the serving pad discipline: round the row count to a
+    power of two and zero-pad (``serve.bucket_rows`` + ``pad_batch``, or
+    ``pad_to_shards`` on a split axis) so the shape space is finite and
+    every steady-state call replays a warm program.  Bounds routed
+    through those bucketing helpers — directly or via one local
+    assignment — are exempt, as are constant bounds and traced bodies
+    (inside a trace the slice is program structure, not a per-call
+    shape)."""
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        if not _is_compiled_callable(ctx, node.func, node):
+            continue
+        if ctx.in_traced_context(node):
+            continue
+        in_loop = False
+        cur: Optional[ast.AST] = node
+        while cur is not None:
+            cur = ctx.parents.get(cur)
+            if isinstance(cur, (ast.For, ast.AsyncFor, ast.While)):
+                in_loop = True
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                break  # loop containment is per-function, as in SPMD206
+        if not in_loop:
+            continue
+        for arg in list(node.args) + [kw.value for kw in node.keywords]:
+            offending = _dynamic_slice_operand(ctx, arg, node)
+            if offending is None:
+                continue
+            yield ctx.finding(
+                "SPMD208", node,
+                f"dynamic-shape operand {ast.unparse(offending)!r} enters a "
+                "compiled program inside a loop — every distinct slice "
+                "length is a fresh trace+compile, an unbounded program "
+                "cache",
+                hint="bucket the row count to a finite shape space before "
+                "the call (serve.bucket_rows + pad_batch zero-padding, or "
+                "pad_to_shards on a split axis) and slice the result "
+                "AFTER the compiled call; mark the call with "
+                "`# spmdlint: disable=SPMD208` if the slice lengths are "
+                "genuinely bounded",
+            )
+            break
+
+
+# --------------------------------------------------------------------- #
 # SPMD301/302: Pallas tiling and grids                                   #
 # --------------------------------------------------------------------- #
 @rule("SPMD301", "Pallas BlockSpec tiles must respect the hardware tile grid")

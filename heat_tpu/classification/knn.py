@@ -15,10 +15,11 @@ import jax.numpy as jnp
 from jax import lax
 
 from ..core import types
+from ..core._split_semantics import split_semantics as _split_semantics
 from ..core.base import BaseEstimator, ClassificationMixin
 from ..core.dndarray import DNDarray
 from ..core.fuse import fuse
-from ..core.sanitation import sanitize_in
+from ..core.sanitation import sanitize_in, sanitize_predict_in
 
 __all__ = ["KNN"]
 
@@ -97,12 +98,13 @@ class KNN(ClassificationMixin, BaseEstimator):
                 f"but got {y.shape}"
             )
 
+    @_split_semantics("entry_split0")
     def predict(self, x: DNDarray) -> DNDarray:
         """Majority vote of the k nearest training samples
         (reference knn.py:83-101), compiled into one fused program —
         distance matmul, top-k, vote, argmax, and layout commit issue a
         single device dispatch per call after warmup."""
-        sanitize_in(x)
+        x = sanitize_predict_in(x, n_features=self.x.shape[1], op="KNN.predict")
         # promote, don't truncate (the distance-module convention): float64
         # inputs keep float64 ordering of near-tie neighbors
         promoted = types.promote_types(

@@ -228,6 +228,13 @@ class _KCluster(ClusteringMixin, BaseEstimator):
             raise RuntimeError(
                 f"{type(self).__name__} has no cluster centers — call fit() first"
             )
+        from ..core.sanitation import sanitize_predict_in
+
+        x = sanitize_predict_in(
+            x,
+            n_features=self._cluster_centers.shape[1],
+            op=f"{type(self).__name__}.predict",
+        )
         return _fused_assign(x, self._cluster_centers, self._metric)
 
     @_split_semantics("entry_fit")
@@ -258,8 +265,6 @@ class _KCluster(ClusteringMixin, BaseEstimator):
     @_split_semantics("entry_split0")
     def predict(self, x: DNDarray) -> DNDarray:
         """Nearest learned centroid for each sample
-        (reference _kcluster.py:233-249)."""
-        from ..core.sanitation import sanitize_in
-
-        sanitize_in(x)
+        (reference _kcluster.py:233-249); input sanitation lives in
+        :meth:`_assign_to_cluster`, the one gate fit() shares."""
         return self._assign_to_cluster(x)

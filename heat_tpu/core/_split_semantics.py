@@ -50,8 +50,13 @@ Kinds (the transfer-function families; ``params`` refine them):
 ``factory_like``   new array mirroring the input's layout
 ``entry_fit``      estimator entry point returning the estimator itself
 ``entry_split0``   library entry point whose result is row-split iff
-                   the data argument is row-split (predict family,
-                   cdist, the U factor of svd)
+                   the data argument is row-split (predict family and
+                   its shared input gate ``sanitize_predict_in``,
+                   cdist, the U factor of svd).  The gate is also the
+                   transfer fact serve pipelines are priced on:
+                   replicated and row-split inputs pass through with
+                   ZERO layout traffic (no resplit event to cost);
+                   only a feature-split input re-splits onto rows
 ``entry_svd``      ``SVD(U, S, V)`` namedtuple: U per ``entry_split0``,
                    S and V replicated
 =================  =====================================================

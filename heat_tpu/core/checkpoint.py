@@ -42,7 +42,7 @@ from . import types
 from .base import BaseEstimator
 from .dndarray import DNDarray
 
-__all__ = ["save_estimator", "load_estimator"]
+__all__ = ["list_checkpoints", "load_estimator", "save_estimator"]
 
 _MANIFEST_ATTR = "heat_tpu_estimator"
 #: manifest schema version this build WRITES (as ``format_version``);
@@ -240,6 +240,72 @@ def save_estimator(est: BaseEstimator, path: str) -> None:
         sorted(ctx.datasets.items()),
         attrs={_MANIFEST_ATTR: json.dumps(manifest)},
     )
+
+
+def list_checkpoints(directory: str):
+    """Scan one directory (non-recursively) for estimator checkpoints.
+
+    Returns one dict per HDF5 file carrying an estimator manifest, sorted
+    by filename: ``{"path", "file", "format_version", "class"}`` with
+    ``class`` the root estimator's ``module:qualname``.  Files without an
+    HDF5 extension are skipped, as are valid HDF5 *data* files (no
+    manifest attribute).  An HDF5-named file that cannot be opened, or
+    whose manifest attribute is not valid JSON, raises ``ValueError``
+    naming the offending file — a registry root must surface a corrupted
+    model version, not silently drop it.  Opens go through the same
+    seeded-retry policy as :func:`load_estimator`, so a transient EIO
+    heals instead of failing the scan.
+    """
+    if not _io.supports_hdf5():
+        raise RuntimeError("h5py is required for estimator checkpointing")
+    import os
+
+    import h5py
+
+    if not os.path.isdir(directory):
+        raise ValueError(f"{directory} is not a directory")
+    out = []
+    for name in sorted(os.listdir(directory)):
+        if os.path.splitext(name)[-1].strip().lower() not in _io.HDF5_EXTENSIONS:
+            continue
+        path = os.path.join(directory, name)
+
+        def _open(path=path):
+            _io._faults().io_open(path)
+            return h5py.File(path, "r")
+
+        try:
+            f = _io._retry_open(_open, "checkpoint.list_checkpoints")
+        except OSError as e:
+            raise ValueError(
+                f"{path} is not a readable checkpoint file (missing, "
+                f"truncated, or not HDF5): {e}"
+            ) from e
+        with f:
+            raw = f.attrs.get(_MANIFEST_ATTR)
+        if raw is None:
+            continue
+        try:
+            manifest = json.loads(raw)
+        except (TypeError, ValueError) as e:
+            raise ValueError(f"{path}: corrupt estimator manifest: {e}") from e
+        if not isinstance(manifest, dict):
+            raise ValueError(
+                f"{path}: corrupt estimator manifest: expected a JSON "
+                f"object, got {type(manifest).__name__}"
+            )
+        root = manifest.get("root")
+        out.append(
+            {
+                "path": path,
+                "file": name,
+                "format_version": manifest.get(
+                    "format_version", manifest.get("format")
+                ),
+                "class": root.get("class") if isinstance(root, dict) else None,
+            }
+        )
+    return out
 
 
 def _resolve_class(class_path: str):

@@ -15,6 +15,8 @@ from typing import Any, List, Sequence, Union
 import numpy as np
 import jax.numpy as jnp
 
+from ._split_semantics import split_semantics as _split_semantics
+
 __all__ = [
     "merge_keepdims",
     "sanitize_in",
@@ -22,6 +24,7 @@ __all__ = [
     "sanitize_in_tensor",
     "sanitize_lshape",
     "sanitize_out",
+    "sanitize_predict_in",
     "sanitize_sequence",
     "scalar_to_1d",
 ]
@@ -42,6 +45,36 @@ def sanitize_in(x: Any) -> None:
 
     if not isinstance(x, DNDarray):
         raise TypeError(f"input needs to be a DNDarray, but was {type(x)}")
+
+
+@_split_semantics("entry_split0")
+def sanitize_predict_in(x: Any, n_features: Any = None, op: str = "predict"):
+    """The ONE input gate of every predict path (KNN, GaussianNB, the
+    k-clusterers, Lasso — and through them the serve engine).
+
+    Validates that ``x`` is a 2-D DNDarray (optionally with exactly
+    ``n_features`` columns) and normalizes its layout for the fused
+    predict programs.  The layout rule is the point: replicated
+    (``split=None``) and row-split (``split=0``) inputs pass through
+    UNTOUCHED — no resplit, no device transfer, no extra dispatch — so a
+    replicated serving micro-batch replays the cached program directly.
+    Only the one layout the predict programs cannot shard over, a
+    feature-split input (``split=1``), is re-split onto rows.
+
+    Returns the (possibly re-split) input, unlike :func:`sanitize_in`
+    which only checks — predict paths must use the returned array.
+    """
+    sanitize_in(x)
+    if x.ndim != 2:
+        raise ValueError(f"{op} expects a 2-D (n_samples, n_features) input, got {x.ndim}-D")
+    if n_features is not None and int(x.shape[1]) != int(n_features):
+        raise ValueError(
+            f"{op} expects {int(n_features)} features, got {int(x.shape[1])} "
+            f"(input shape {tuple(x.shape)})"
+        )
+    if x.split in (None, 0):
+        return x
+    return x.resplit(0)
 
 
 def sanitize_in_tensor(x: Any) -> "jnp.ndarray":

@@ -24,7 +24,7 @@ from ..core._split_semantics import split_semantics as _split_semantics
 from ..core.base import BaseEstimator, ClassificationMixin
 from ..core.dndarray import DNDarray
 from ..core.fuse import fuse
-from ..core.sanitation import sanitize_in
+from ..core.sanitation import sanitize_in, sanitize_predict_in
 
 
 def _joint_log_likelihood(x: DNDarray, theta, sigma, prior) -> jnp.ndarray:
@@ -227,6 +227,8 @@ class GaussianNB(ClassificationMixin, BaseEstimator):
     def _fit_params(self):
         """The fitted parameters as arrays, the dynamic operands of the
         fused predict programs (same shapes across refits → cache hits)."""
+        if self.theta_ is None:
+            raise RuntimeError("fit() must be called before predict()")
         return (
             np.asarray(self.theta_),
             np.asarray(self.sigma_),
@@ -238,21 +240,25 @@ class GaussianNB(ClassificationMixin, BaseEstimator):
         """argmax-class labels (reference gaussianNB.py:475-500), one fused
         program: likelihood, argmax, class gather, and layout commit in a
         single device dispatch."""
-        sanitize_in(x)
         theta, sigma, prior = self._fit_params()
+        x = sanitize_predict_in(x, n_features=theta.shape[1], op="GaussianNB.predict")
         return _fused_nb_predict(x, theta, sigma, prior, np.asarray(self.classes_))
 
     @_split_semantics("entry_split0")
     def predict_log_proba(self, x: DNDarray) -> DNDarray:
         """Normalized log posteriors (reference gaussianNB.py:501-520; the
         distributed logsumexp :401-420 is one jax.nn.logsumexp here)."""
-        sanitize_in(x)
         theta, sigma, prior = self._fit_params()
+        x = sanitize_predict_in(
+            x, n_features=theta.shape[1], op="GaussianNB.predict_log_proba"
+        )
         return _fused_nb_log_proba(x, theta, sigma, prior)
 
     @_split_semantics("entry_split0")
     def predict_proba(self, x: DNDarray) -> DNDarray:
         """Posterior probabilities (reference gaussianNB.py:521-539)."""
-        sanitize_in(x)
         theta, sigma, prior = self._fit_params()
+        x = sanitize_predict_in(
+            x, n_features=theta.shape[1], op="GaussianNB.predict_proba"
+        )
         return _fused_nb_proba(x, theta, sigma, prior)

@@ -18,10 +18,28 @@ from ..core import factories, types
 from ..core._split_semantics import split_semantics as _split_semantics
 from ..core.base import BaseEstimator, RegressionMixin
 from ..core.dndarray import DNDarray
-from ..core.sanitation import sanitize_in
+from ..core.fuse import fuse
+from ..core.sanitation import sanitize_in, sanitize_predict_in
 from ..telemetry import _core as _tel
 
 __all__ = ["Lasso"]
+
+
+def _lasso_predict_program(x: DNDarray, theta: DNDarray) -> DNDarray:
+    """ŷ = [1, X] θ as ONE fused program (matmul + layout commit), so a
+    warm predict — the serve engine's replay path — is a single device
+    dispatch, matching the other estimators' predict discipline."""
+    n = x.shape[0]
+    arr = jnp.concatenate(
+        [jnp.ones((n, 1), dtype=jnp.float32), x.larray.astype(jnp.float32)], axis=1
+    )
+    pred = arr @ theta.larray.reshape(-1)
+    split = x.split if x.split == 0 else None
+    pred = x.comm.apply_sharding(pred.reshape(-1, 1), split)
+    return DNDarray(pred, (n, 1), types.float32, split, x.device, x.comm, True)
+
+
+_fused_lasso_predict = fuse(_lasso_predict_program)
 
 
 class Lasso(RegressionMixin, BaseEstimator):
@@ -376,20 +394,13 @@ class Lasso(RegressionMixin, BaseEstimator):
 
     @_split_semantics("entry_split0")
     def predict(self, x: DNDarray) -> DNDarray:
-        """ŷ = [1, X] θ (reference lasso.py:157-170)."""
-        sanitize_in(x)
+        """ŷ = [1, X] θ (reference lasso.py:157-170), one fused dispatch."""
         if self.__theta is None:
             raise RuntimeError("fit() must be called before predict()")
-        n = x.shape[0]
-        arr = jnp.concatenate(
-            [jnp.ones((n, 1), dtype=jnp.float32), x.larray.astype(jnp.float32)], axis=1
+        x = sanitize_predict_in(
+            x, n_features=int(self.__theta.shape[0]) - 1, op="Lasso.predict"
         )
-        pred = arr @ self.__theta.larray.reshape(-1)
-        pred = x.comm.apply_sharding(pred.reshape(-1, 1), x.split if x.split == 0 else None)
-        return DNDarray(
-            pred, (n, 1), types.float32, x.split if x.split == 0 else None,
-            x.device, x.comm, True,
-        )
+        return _fused_lasso_predict(x, self.__theta)
 
 
 def _gd_segment_q(arr, yv, lam, tol, stop, step, carry, *, comm, mode):

@@ -3,8 +3,9 @@
 A fault plan is a context manager that arms one fault *kind* against the
 seams the library exposes for it — the compressed-collective boundary in
 :mod:`heat_tpu.comm.compressed`, the file-open and slab-write sites in
-:mod:`heat_tpu.core.io`, and the between-segments checkpoint tick of the
-resumable training loops.  Whether a given trigger opportunity actually
+:mod:`heat_tpu.core.io`, the per-request payload boundary of the serve
+engine (:mod:`heat_tpu.serve.engine`), and the between-segments
+checkpoint tick of the resumable training loops.  Whether a given trigger opportunity actually
 fires is decided by a ``numpy`` generator seeded per plan, so a fault
 schedule is a pure function of ``(seed, rate/nth, the sequence of
 trigger opportunities)`` — the same test run replays the same faults,
@@ -228,7 +229,7 @@ def comm_input(site: str, array):
     Applied eagerly at the host boundary; the compiled ring program
     itself is untouched."""
     for plan in list(_PLANS):
-        if plan.kind not in _COMM_INPUT_KINDS or not plan.should_fire():
+        if plan.kind not in _COMM_INPUT_KINDS or not plan.should_fire(site):
             continue
         if plan.kind == "saturate":
             array = (array * jnp.asarray(plan.factor, dtype=array.dtype)).astype(array.dtype)
@@ -244,7 +245,7 @@ def comm_output(site: str, array):
     decoded result — the boundary-visible signature of a bit-flip in a
     forwarded wire scale."""
     for plan in list(_PLANS):
-        if plan.kind not in _COMM_OUTPUT_KINDS or not plan.should_fire():
+        if plan.kind not in _COMM_OUTPUT_KINDS or not plan.should_fire(site):
             continue
         shape, dtype = array.shape, array.dtype
         flat = jnp.ravel(array).astype(jnp.float32)
@@ -253,6 +254,27 @@ def comm_output(site: str, array):
         bits = jax.lax.bitcast_convert_type(flat, jnp.uint32)
         bits = bits.at[idx].set(bits[idx] ^ jnp.uint32(1 << 30))
         array = jax.lax.bitcast_convert_type(bits, jnp.float32).reshape(shape).astype(dtype)
+    return array
+
+
+def payload_input(site: str, array):
+    """Corrupt one serving request's host payload per the armed plans —
+    the per-request seam of the serve engine (``site`` is
+    ``"serve:<tenant>/<model>"``).  Handles the same kinds as
+    :func:`comm_input` (``"nonfinite"``/``"saturate"``) but on the host
+    numpy payload, *before* batch assembly: the engine's health screen
+    then quarantines exactly the requests the deterministic schedule
+    hit, and the shared micro-batch is never touched.  Returns a
+    corrupted copy; the caller's array is never mutated."""
+    for plan in list(_PLANS):
+        if plan.kind not in _COMM_INPUT_KINDS or not plan.should_fire(site):
+            continue
+        out = np.array(array, copy=True)
+        if plan.kind == "saturate":
+            out = (out * plan.factor).astype(out.dtype)
+        else:  # nonfinite
+            out.reshape(-1)[0] = plan.value
+        array = out
     return array
 
 

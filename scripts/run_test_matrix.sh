@@ -113,6 +113,48 @@ then
     echo "FAILED redistribution cost-model smoke"
     fail=1
 fi
+# serve lane: multi-tenant micro-batched serving (docs/design.md §17) —
+# registry/batcher/engine invariants (bitwise batched==unbatched parity,
+# one compiled dispatch per micro-batch, degrade isolation), then the
+# chaos scenario: a fault plan armed over the seeded open-loop generator
+# must poison exactly the requests it hits, and the degraded set +
+# reply checksum must replay as a pure function of HEAT_CHAOS_SEED
+echo "=== serve lane (seed=${HEAT_CHAOS_SEED:-0}: parity, dispatch gate, poisoned-request isolation) ==="
+if ! HEAT_CHAOS_SEED="${HEAT_CHAOS_SEED:-0}" python -m pytest tests/test_serve.py -q; then
+    echo "FAILED serve lane (reproduce with HEAT_CHAOS_SEED=${HEAT_CHAOS_SEED:-0})"
+    fail=1
+fi
+if ! HEAT_CHAOS_SEED="${HEAT_CHAOS_SEED:-0}" python - <<'PY'
+import tempfile
+import numpy as np
+import heat_tpu as ht
+from heat_tpu import resilience
+from heat_tpu.serve import ModelRegistry, ServeEngine, loadgen
+
+rng = np.random.default_rng(0)
+km = ht.cluster.KMeans(n_clusters=3, max_iter=5, random_state=0)
+km.fit(ht.array(rng.normal(size=(64, 5)).astype(np.float32), split=0))
+reg = ModelRegistry(tempfile.mkdtemp(prefix="heat-serve-lane-"))
+reg.publish("ci", "km", km)
+eng = ServeEngine(reg, max_batch_rows=64, min_bucket=8)
+# seed=None -> HEAT_CHAOS_SEED drives arrivals, payloads, AND the plan
+with resilience.inject("nonfinite", rate=0.25, seed=loadgen.chaos_seed()):
+    a = loadgen.run(eng, "ci", "km", n_requests=32, twin=True)
+with resilience.inject("nonfinite", rate=0.25, seed=loadgen.chaos_seed()):
+    b = loadgen.run(eng, "ci", "km", n_requests=32, twin=False)
+assert a.degraded == b.degraded, (a.degraded, b.degraded)
+assert a.checksum == b.checksum, (a.checksum, b.checksum)
+assert a.twin["bitwise_equal"], "batched replies diverged from unbatched twin"
+assert a.dispatches_per_batch == 1.0, a.dispatches_per_batch
+eng.close()
+print(f"serve chaos scenario: {len(a.degraded)}/32 requests poisoned "
+      f"(degraded={a.degraded}), batch-mates bitwise-exact, "
+      f"checksum replayed, one dispatch per micro-batch")
+PY
+then
+    echo "FAILED serve chaos scenario (reproduce with HEAT_CHAOS_SEED=${HEAT_CHAOS_SEED:-0})"
+    fail=1
+fi
 for n in "${sizes[@]}"; do
     echo "=== mesh size $n ==="
     if ! HEAT_TEST_DEVICES="$n" python -m pytest tests/ -q -x; then

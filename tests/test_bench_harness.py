@@ -162,6 +162,8 @@ def _fake_full_result():
         "fused_pipeline_ms": 0.42,
         "eager_pipeline_ms": 2.31,
         "lasso_sweeps_per_sec": 1318.6,
+        "serve_predictions_per_sec": 9919.9,
+        "serve_p99_ms": 27.32,
         "qr_svd_tall_skinny_ms": 2.87,
         "attention_tokens_per_sec": 3400000.0,
         "causal_attention_tokens_per_sec": 3700000.0,
@@ -192,15 +194,23 @@ def test_compact_line_is_self_contained_and_small():
     # headline contract keys survive
     assert line["metric"] == "kmeans_iter_per_sec"
     assert line["value"] == rec["value"]
-    # every headline value, golden health, vs_golden, roofline % present
+    # every headline carries its [value, vs_golden, roofline_pct?] triple
     for key in bench._HEADLINE:
-        assert key == line["metric"] or key in line, key
+        assert key in line, key
+        entry = line[key]
+        expect = rec["value"] if key == rec["metric"] else rec[key]
+        assert entry[0] == expect, key
+        assert entry[1] == round(rec["vs_golden"][key], 2), key
     assert line["golden_health"] == rec["golden"]["health"]
-    assert set(line["vs_golden"]) == set(rec["vs_golden"])
-    assert "attention_tokens_per_sec" in line["roofline_pct"]
+    # modeled metrics get the roofline %-of-peak third slot; dispositioned
+    # ones (bench._NOT_MODELED) stay a pair
+    assert len(line["attention_tokens_per_sec"]) == 3
+    assert line["attention_tokens_per_sec"][2] is not None
+    assert len(line["serve_predictions_per_sec"]) == 2
     assert line["full_report"] == "BENCH_FULL.json"
     # the verbose layers stay OUT of the line
     assert "spread_pct" not in line and "roofline" not in line
+    assert "vs_golden" not in line and "roofline_pct" not in line
 
 
 def test_regression_guard_uses_best_round(tmp_path, monkeypatch):

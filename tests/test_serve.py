@@ -1,0 +1,446 @@
+"""heat_tpu.serve — registry, micro-batching, engine invariants, loadgen.
+
+The load-bearing assertions:
+
+- **bitwise parity**: a batched reply equals the same request's unbatched
+  ``direct_predict`` byte for byte, across bucket boundaries, estimator
+  families, and both micro-batch layouts (replicated and row-split);
+- **one compiled dispatch per micro-batch**, and ZERO steady-state
+  recompiles once a bucket is warm (fuse-cache counters);
+- **degrade isolation**: a poisoned payload degrades exactly its own
+  reply; batch-mates stay bitwise exact;
+- **deterministic replay**: the loadgen report (checksum, degraded set)
+  is a pure function of the seeds.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import heat_tpu as ht
+from heat_tpu import resilience, telemetry
+from heat_tpu.resilience import incidents
+from heat_tpu.serve import (
+    ManifestError,
+    MicroBatcher,
+    ModelNotFoundError,
+    ModelRegistry,
+    ServeEngine,
+    StagingPool,
+    VersionNotFoundError,
+    bucket_rows,
+    loadgen,
+    pad_batch,
+)
+
+RNG = np.random.default_rng(42)
+Xn = RNG.normal(size=(64, 5)).astype(np.float32)
+yn = RNG.integers(0, 3, 64).astype(np.int32)
+
+
+# --------------------------------------------------------------------- #
+# fitted estimators, one per family (module-scoped: fitting is the
+# expensive part and every engine test only reads them)
+# --------------------------------------------------------------------- #
+@pytest.fixture(scope="module")
+def fitted():
+    X = ht.array(Xn, split=0)
+    y = ht.array(yn, split=0)
+    km = ht.cluster.KMeans(n_clusters=3, max_iter=5, random_state=0)
+    km.fit(X)
+    nb = ht.naive_bayes.GaussianNB()
+    nb.fit(X, y)
+    knn = ht.classification.KNN(X, y, 3)
+    lasso = ht.regression.lasso.Lasso(max_iter=15)
+    lasso.fit(X, ht.array(Xn[:, :1].copy(), split=0))
+    return {"km": km, "nb": nb, "knn": knn, "lasso": lasso}
+
+
+@pytest.fixture
+def registry(tmp_path, fitted):
+    reg = ModelRegistry(str(tmp_path / "models"))
+    for name, est in fitted.items():
+        reg.publish("acme", name, est)
+    return reg
+
+
+def payload(rows, seed=0):
+    return np.random.default_rng(seed).normal(size=(rows, 5)).astype(np.float32)
+
+
+# --------------------------------------------------------------------- #
+# bucketing and padding
+# --------------------------------------------------------------------- #
+def test_bucket_rows_powers_of_two():
+    assert [bucket_rows(n) for n in (1, 2, 3, 4, 5, 8, 9, 31, 32, 33)] == [
+        1, 2, 4, 4, 8, 8, 16, 32, 32, 64,
+    ]
+    assert bucket_rows(3, min_bucket=8) == 8
+    assert bucket_rows(9, min_bucket=8) == 16
+    with pytest.raises(ValueError, match="at least one row"):
+        bucket_rows(0)
+
+
+def test_pad_batch_packs_zero_pads_and_masks():
+    a, b = payload(3, 1), payload(2, 2)
+    buf, mask = pad_batch([a, b], 8)
+    assert buf.shape == (8, 5) and buf.dtype == np.float32
+    np.testing.assert_array_equal(buf[:3], a)
+    np.testing.assert_array_equal(buf[3:5], b)
+    np.testing.assert_array_equal(buf[5:], np.zeros((3, 5), np.float32))
+    np.testing.assert_array_equal(mask, [1, 1, 1, 1, 1, 0, 0, 0])
+
+
+def test_pad_batch_donation_path_is_byte_identical():
+    pool = StagingPool()
+    staging = pool.get(8, 5, np.float32)
+    staging[:] = 7.0  # dirty, as after a previous batch
+    fresh, _ = pad_batch([payload(3, 1), payload(2, 2)], 8)
+    reused, _ = pad_batch([payload(3, 1), payload(2, 2)], 8, out=staging)
+    assert reused is staging
+    assert reused.tobytes() == fresh.tobytes()
+    assert len(pool) == 1 and pool.get(8, 5, np.float32) is staging
+
+
+def test_pad_batch_rejects_overflow_and_mixed_payloads():
+    with pytest.raises(ValueError, match="do not fit"):
+        pad_batch([payload(9)], 8)
+    with pytest.raises(ValueError, match="mixed payloads"):
+        pad_batch([payload(2), payload(2).astype(np.float64)], 8)
+    with pytest.raises(ValueError, match="at least one payload"):
+        pad_batch([], 8)
+
+
+def test_micro_batcher_coalesces_fifo_up_to_row_cap():
+    seen = []
+    mb = MicroBatcher(lambda reqs: seen.append([r.rows for r in reqs]),
+                      max_batch_rows=8)
+    futs = [mb.submit(payload(r)) for r in (3, 3, 3, 7, 9)]
+    mb.drain()
+    # 3+3 fits, the next 3 doesn't; 7 alone; oversized 9 is its own batch
+    assert seen == [[3, 3], [3], [7], [9]]
+    del futs
+
+
+# --------------------------------------------------------------------- #
+# checkpoint manifest scan (core satellite)
+# --------------------------------------------------------------------- #
+def test_list_checkpoints_scans_and_skips_foreign_files(tmp_path, fitted):
+    d = tmp_path / "ckpts"
+    d.mkdir()
+    ht.save_estimator(fitted["km"], str(d / "v1.h5"))
+    ht.save_estimator(fitted["nb"], str(d / "v2.h5"))
+    (d / "notes.txt").write_text("not a checkpoint")
+    import h5py
+
+    with h5py.File(str(d / "data.h5"), "w") as f:  # manifest-less data file
+        f.create_dataset("x", data=np.arange(3))
+    entries = ht.list_checkpoints(str(d))
+    assert [e["file"] for e in entries] == ["v1.h5", "v2.h5"]
+    assert all(e["format_version"] == 2 for e in entries)
+    assert entries[0]["class"].endswith("KMeans")
+    assert entries[1]["class"].endswith("GaussianNB")
+
+
+def test_list_checkpoints_errors_name_the_offending_file(tmp_path, fitted):
+    d = tmp_path / "ckpts"
+    d.mkdir()
+    bad = d / "v1.h5"
+    bad.write_bytes(b"this is not hdf5")
+    with pytest.raises(ValueError, match="v1.h5"):
+        ht.list_checkpoints(str(d))
+
+    import h5py
+
+    d2 = tmp_path / "ckpts2"
+    d2.mkdir()
+    ht.save_estimator(fitted["km"], str(d2 / "v1.h5"))
+    with h5py.File(str(d2 / "v1.h5"), "a") as f:
+        f.attrs["heat_tpu_estimator"] = "{not json"
+    with pytest.raises(ValueError, match="corrupt estimator manifest"):
+        ht.list_checkpoints(str(d2))
+    with pytest.raises(ValueError, match="v1.h5"):
+        ht.list_checkpoints(str(d2))
+
+
+# --------------------------------------------------------------------- #
+# registry
+# --------------------------------------------------------------------- #
+def test_registry_publish_versions_and_resolve(registry, fitted):
+    assert registry.tenants() == ["acme"]
+    assert registry.models("acme") == ["km", "knn", "lasso", "nb"]
+    assert registry.versions("acme", "km") == [1]
+    v2 = registry.publish("acme", "km", fitted["km"])
+    assert v2 == 2 and registry.versions("acme", "km") == [1, 2]
+    assert registry.resolve("acme", "km")[0] == 2  # latest by default
+    assert registry.resolve("acme", "km", 1)[0] == 1
+
+
+def test_registry_typed_not_found_errors(registry):
+    with pytest.raises(ModelNotFoundError, match="model='nope'"):
+        registry.load("acme", "nope")
+    with pytest.raises(ModelNotFoundError, match="tenant='ghost'"):
+        registry.load("ghost", "km")
+    with pytest.raises(VersionNotFoundError, match=r"no version 9"):
+        registry.load("acme", "km", 9)
+
+
+def test_registry_versions_are_immutable(registry, fitted):
+    with pytest.raises(Exception, match="immutable"):
+        registry.publish("acme", "km", fitted["km"], version=1)
+
+
+def test_registry_rejects_path_escaping_names(registry):
+    with pytest.raises(Exception, match="plain directory name"):
+        registry.load("../etc", "km")
+    with pytest.raises(Exception, match="plain directory name"):
+        registry.publish("acme", "a/b", object())
+
+
+def test_registry_load_caches_same_object(registry):
+    est1, v1 = registry.load("acme", "km")
+    est2, v2 = registry.load("acme", "km")
+    assert est1 is est2 and v1 == v2 == 1
+    # cache disabled -> fresh object per load
+    reg2 = ModelRegistry(registry.root, max_cached=0)
+    a, _ = reg2.load("acme", "km")
+    b, _ = reg2.load("acme", "km")
+    assert a is not b
+
+
+def test_registry_manifest_error_names_tenant_model_version(registry):
+    path = os.path.join(registry.root, "acme", "km", "v1.h5")
+    with open(path, "wb") as f:
+        f.write(b"garbage, not hdf5")
+    reg2 = ModelRegistry(registry.root, max_cached=0)
+    with pytest.raises(ManifestError, match="tenant='acme' model='km'") as ei:
+        reg2.load("acme", "km", 1)
+    assert "v1.h5" in str(ei.value)
+
+
+# --------------------------------------------------------------------- #
+# engine: bitwise parity, dispatch accounting, degrade isolation
+# --------------------------------------------------------------------- #
+@pytest.mark.parametrize("split", [None, "auto"])
+@pytest.mark.parametrize("name", ["km", "nb", "knn", "lasso"])
+def test_batched_replies_bitwise_equal_unbatched(registry, name, split):
+    eng = ServeEngine(registry, max_batch_rows=32, min_bucket=8, split=split)
+    try:
+        # row mixes crossing the 8-row min bucket and the 8->16 boundary
+        for rows in ([1, 2, 3], [5, 4], [8], [7, 6], [16], [9, 9]):
+            futs = [
+                eng.submit("acme", name, payload(r, seed=100 + r + i))
+                for i, r in enumerate(rows)
+            ]
+            eng.flush()
+            for i, (r, fut) in enumerate(zip(rows, futs)):
+                reply = fut.result()
+                golden = eng.direct_predict(
+                    "acme", name, payload(r, seed=100 + r + i)
+                )
+                assert not reply.degraded
+                assert reply.value.shape == golden.shape
+                assert reply.value.dtype == golden.dtype
+                assert reply.value.tobytes() == golden.tobytes(), (
+                    f"{name} split={split} rows={rows} request {i} diverged"
+                )
+        assert eng.stats()["dispatches_per_batch"] == 1.0
+    finally:
+        eng.close()
+
+
+def test_exactly_one_dispatch_per_micro_batch_and_zero_steady_recompiles(registry):
+    eng = ServeEngine(registry, max_batch_rows=32, min_bucket=8)
+    try:
+        # warm the 8-row bucket (first call traces, still one dispatch)
+        eng.predict("acme", "km", payload(5, seed=0))
+        warm = eng.stats()
+        assert warm["batches"] == warm["dispatches"] == 1
+
+        telemetry.enable()
+        telemetry.reset()
+        try:
+            for seed in range(1, 6):
+                futs = [
+                    eng.submit("acme", "km", payload(3, seed=seed)),
+                    eng.submit("acme", "km", payload(4, seed=seed + 50)),
+                ]
+                eng.flush()
+                for f in futs:
+                    f.result()
+            counters = telemetry.snapshot()["counters"]
+            assert counters.get("fuse.cache.misses", 0) == 0, (
+                "steady-state serving must not recompile"
+            )
+            assert counters["fuse.cache.hits"] >= 5
+            assert counters["serve.batches"] == 5
+        finally:
+            telemetry.disable()
+
+        stats = eng.stats()
+        assert stats["batches"] == 6
+        assert stats["dispatches"] == 6  # exactly one per micro-batch
+        assert stats["dispatches_per_batch"] == 1.0
+    finally:
+        eng.close()
+
+
+def test_degrade_isolates_poisoned_request_batchmates_exact(registry):
+    eng = ServeEngine(registry, max_batch_rows=32, min_bucket=8)
+    incidents.clear_incident_log()
+    try:
+        good1, good2 = payload(3, seed=7), payload(4, seed=8)
+        bad = payload(2, seed=9)
+        bad[1, 3] = np.nan
+        futs = [
+            eng.submit("acme", "km", good1),
+            eng.submit("acme", "km", bad),
+            eng.submit("acme", "km", good2),
+        ]
+        eng.flush()
+        r1, rbad, r2 = (f.result() for f in futs)
+        assert not r1.degraded and not r2.degraded
+        assert rbad.degraded and rbad.value.shape == (2,)
+        # batch-mates bitwise exact despite the poisoned neighbor
+        assert r1.value.tobytes() == eng.direct_predict("acme", "km", good1).tobytes()
+        assert r2.value.tobytes() == eng.direct_predict("acme", "km", good2).tobytes()
+        log = [i for i in incidents.incident_log() if i.kind == "poisoned-payload"]
+        assert len(log) == 1
+        assert log[0].site == "serve:acme/km" and log[0].action == "degraded"
+        assert eng.stats()["degraded"] == 1
+    finally:
+        eng.close()
+
+
+def test_engine_validates_features_and_dtype(registry):
+    eng = ServeEngine(registry, min_bucket=8)
+    try:
+        with pytest.raises(ValueError, match="expects 5 features"):
+            eng.submit("acme", "km", payload(2)[:, :3])
+        with pytest.raises(ValueError, match="2-D"):
+            eng.submit("acme", "km", np.zeros(5, np.float32))
+        eng.predict("acme", "km", payload(2))
+        with pytest.raises(ValueError, match="mixed dtypes"):
+            eng.submit("acme", "km", payload(2).astype(np.float64))
+    finally:
+        eng.close()
+
+
+def test_engine_background_mode_coalesces(registry):
+    eng = ServeEngine(registry, max_batch_rows=32, min_bucket=8,
+                      max_delay_s=0.01)
+    try:
+        eng.start()
+        futs = [eng.submit("acme", "km", payload(2, seed=s)) for s in range(4)]
+        replies = [f.result(timeout=30) for f in futs]
+        assert all(not r.degraded for r in replies)
+        for s, r in enumerate(replies):
+            golden = eng.direct_predict("acme", "km", payload(2, seed=s))
+            assert r.value.tobytes() == golden.tobytes()
+    finally:
+        eng.close()
+    with pytest.raises(RuntimeError, match="closed"):
+        eng.predict("acme", "km", payload(2))
+
+
+def test_engine_serves_specific_versions_side_by_side(registry, fitted):
+    # v2 = a different fit; both versions answer, each from its own lane
+    km2 = ht.cluster.KMeans(n_clusters=2, max_iter=5, random_state=1)
+    km2.fit(ht.array(Xn, split=0))
+    registry.publish("acme", "km", km2)
+    eng = ServeEngine(registry, min_bucket=8)
+    try:
+        p = payload(4, seed=3)
+        r1 = eng.predict("acme", "km", p, version=1)
+        r2 = eng.predict("acme", "km", p, version=2)
+        assert r1.value.tobytes() == eng.direct_predict(
+            "acme", "km", p, version=1).tobytes()
+        assert r2.value.tobytes() == eng.direct_predict(
+            "acme", "km", p, version=2).tobytes()
+        assert int(r2.value.max()) < 2  # 2-cluster model answered v2
+    finally:
+        eng.close()
+
+
+# --------------------------------------------------------------------- #
+# loadgen: determinism, twin golden, chaos double-duty
+# --------------------------------------------------------------------- #
+def test_loadgen_schedule_is_seed_deterministic():
+    a = loadgen.schedule(3, n_requests=16)
+    b = loadgen.schedule(3, n_requests=16)
+    assert a == b
+    assert a != loadgen.schedule(4, n_requests=16)
+    assert all(x.t <= y.t for x, y in zip(a, a[1:]))
+    pa = loadgen.payloads(a, 5, seed=3)
+    pb = loadgen.payloads(a, 5, seed=3)
+    assert all(x.tobytes() == y.tobytes() for x, y in zip(pa, pb))
+
+
+def test_loadgen_run_replays_bitwise_and_twin_matches(registry):
+    eng = ServeEngine(registry, max_batch_rows=32, min_bucket=8)
+    try:
+        rep = loadgen.run(eng, "acme", "km", seed=11, n_requests=24, twin=True)
+        assert rep.n_requests == 24 and rep.degraded == ()
+        assert rep.twin["bitwise_equal"] and rep.twin["compared"] == 24
+        assert rep.dispatches_per_batch == 1.0
+        assert rep.predictions_per_sec > 0 and rep.p99_ms > 0
+        assert 0 < rep.batch_occupancy <= 1.0
+        rep2 = loadgen.run(eng, "acme", "km", seed=11, n_requests=24, twin=False)
+        assert rep2.checksum == rep.checksum
+        assert rep2.rows == rep.rows
+    finally:
+        eng.close()
+
+
+def test_loadgen_chaos_poisons_exactly_the_requests_it_hits(registry):
+    eng = ServeEngine(registry, max_batch_rows=64, min_bucket=8)
+    incidents.clear_incident_log()
+    try:
+        with resilience.inject("nonfinite", nth=(3, 7)):
+            rep = loadgen.run(eng, "acme", "km", seed=11, n_requests=12,
+                              twin=True)
+        # nth is 1-based over submit order -> 0-based request indices 2, 6
+        assert rep.degraded == (2, 6)
+        assert rep.twin["bitwise_equal"] and rep.twin["compared"] == 10
+        hits = [i for i in incidents.incident_log()
+                if i.kind == "poisoned-payload"]
+        assert len(hits) == 2
+        # pure function of the seeds: same plan + same seed -> same victims
+        with resilience.inject("nonfinite", nth=(3, 7)):
+            rep2 = loadgen.run(eng, "acme", "km", seed=11, n_requests=12,
+                               twin=False)
+        assert rep2.degraded == rep.degraded
+    finally:
+        eng.close()
+
+
+def test_loadgen_honors_chaos_seed_env(monkeypatch):
+    monkeypatch.setenv("HEAT_CHAOS_SEED", "123")
+    assert loadgen.chaos_seed() == 123
+    assert loadgen.schedule(n_requests=4) == loadgen.schedule(123, n_requests=4)
+
+
+# --------------------------------------------------------------------- #
+# sanitation satellite: split=None payloads take no spurious resplit
+# --------------------------------------------------------------------- #
+@pytest.mark.parametrize("split", [None, 0])
+def test_predict_paths_accept_any_split_without_spurious_resplit(fitted, split):
+    x = ht.array(Xn[:16], split=split)
+    for name in ("km", "nb", "knn", "lasso"):
+        out = fitted[name].predict(x)
+        ref = fitted[name].predict(ht.array(Xn[:16], split=0))
+        np.testing.assert_array_equal(np.asarray(out.numpy()),
+                                      np.asarray(ref.numpy()))
+
+
+def test_predict_rejects_bad_rank_and_feature_count(fitted):
+    with pytest.raises(ValueError, match="2-D"):
+        fitted["km"].predict(ht.array(Xn[0]))
+    with pytest.raises(ValueError, match="features"):
+        fitted["nb"].predict(ht.array(Xn[:4, :3].copy()))
+    with pytest.raises(RuntimeError, match="fit"):
+        ht.naive_bayes.GaussianNB().predict(ht.array(Xn[:4]))

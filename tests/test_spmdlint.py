@@ -774,6 +774,132 @@ def shuffle(x, comm):
 
 
 # --------------------------------------------------------------------- #
+# SPMD208: unbucketed dynamic batch shape entering a compiled program    #
+# --------------------------------------------------------------------- #
+def test_spmd208_triggers_on_dynamic_slice_into_fused_in_loop():
+    src = """
+from heat_tpu import fuse
+
+def program(x):
+    return x
+
+compiled = fuse(program)
+
+def serve_loop(queue, sizes):
+    off = 0
+    out = []
+    for n in sizes:
+        out.append(compiled(queue[off : off + n]))
+        off += n
+    return out
+"""
+    findings = lint(src, "SPMD208")
+    assert findings, "dynamic slice into a fused program in a loop must fire"
+    assert "fresh trace" in findings[0].message
+    assert "bucket" in findings[0].hint
+
+
+def test_spmd208_triggers_via_named_slice_and_jitted_product():
+    src = """
+from heat_tpu.core.compile import jitted
+
+def serve_loop(queue, sizes, key, make):
+    prog = jitted(key, make)
+    for n in sizes:
+        chunk = queue[:n]
+        prog(chunk)
+"""
+    assert lint(src, "SPMD208")
+
+
+def test_spmd208_clean_when_bounds_are_bucketed():
+    src = """
+from heat_tpu import fuse
+from heat_tpu.serve import bucket_rows
+
+def program(x):
+    return x
+
+compiled = fuse(program)
+
+def serve_loop(queue, sizes):
+    out = []
+    for n in sizes:
+        out.append(compiled(queue[: bucket_rows(n)]))
+    return out
+
+def serve_loop_named(queue, sizes):
+    out = []
+    for n in sizes:
+        b = bucket_rows(n)
+        out.append(compiled(queue[:b]))
+    return out
+"""
+    assert lint(src, "SPMD208") == []
+
+
+def test_spmd208_clean_outside_loops_constant_bounds_and_traced_bodies():
+    src = """
+import jax
+from heat_tpu import fuse
+
+def program(x):
+    return x
+
+compiled = fuse(program)
+
+def once(queue, n):
+    return compiled(queue[:n])
+
+def static_bounds(queue):
+    out = []
+    for _ in range(4):
+        out.append(compiled(queue[:32]))
+    return out
+
+@jax.jit
+def traced(queue, sizes):
+    acc = 0
+    for n in sizes:
+        acc = acc + compiled(queue[:n])
+    return acc
+"""
+    assert lint(src, "SPMD208") == []
+
+
+def test_spmd208_plain_function_calls_do_not_fire():
+    src = """
+def helper(x):
+    return x
+
+def serve_loop(queue, sizes):
+    out = []
+    for n in sizes:
+        out.append(helper(queue[:n]))
+    return out
+"""
+    assert lint(src, "SPMD208") == []
+
+
+def test_spmd208_suppression_comment_silences():
+    src = """
+from heat_tpu import fuse
+
+def program(x):
+    return x
+
+compiled = fuse(program)
+
+def serve_loop(queue, sizes):
+    out = []
+    for n in sizes:
+        out.append(compiled(queue[:n]))  # spmdlint: disable=SPMD208
+    return out
+"""
+    assert lint(src, "SPMD208") == []
+
+
+# --------------------------------------------------------------------- #
 # SPMD301/302: Pallas tiling and grids                                   #
 # --------------------------------------------------------------------- #
 def test_spmd301_triggers_on_off_tile_blocks():
@@ -935,7 +1061,8 @@ def test_baseline_fingerprint_is_line_insensitive():
 def test_every_rule_is_registered():
     assert [r.id for r in all_rules()] == [
         "SPMD001", "SPMD101", "SPMD102", "SPMD201", "SPMD202", "SPMD203",
-        "SPMD204", "SPMD205", "SPMD206", "SPMD207", "SPMD301", "SPMD302",
+        "SPMD204", "SPMD205", "SPMD206", "SPMD207", "SPMD208", "SPMD301",
+        "SPMD302",
         "SPMD401", "SPMD501", "SPMD502", "SPMD503", "SPMD504",
     ]
 
