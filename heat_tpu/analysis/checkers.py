@@ -16,7 +16,7 @@ import ast
 import builtins
 from typing import Dict, Iterable, List, Optional, Sequence, Tuple
 
-from .core import FileContext
+from .core import _FUNC_TYPES, FileContext
 from .rules import Finding, rule
 
 __all__ = [
@@ -1248,6 +1248,166 @@ def check_unbucketed_dynamic_batch(ctx: FileContext) -> Iterable[Finding]:
                 "genuinely bounded",
             )
             break
+
+
+# --------------------------------------------------------------------- #
+# SPMD209: serialized ring body — same-round ppermute consumption        #
+# --------------------------------------------------------------------- #
+#: loop-tracing entry points whose body argument runs once per ring
+#: round; the indices name the traced body function(s), mirroring
+#: :data:`~heat_tpu.analysis.core._TRACING_CALLS`
+_LOOP_BODY_CALLS = {"fori_loop": (2,), "scan": (0,), "while_loop": (0, 1)}
+
+#: calls that package a ppermute result without touching its values —
+#: building a payload tuple is shipping, not consuming
+_CONTAINER_CALLS = {"tuple", "list"}
+
+
+def _overlap_gated(ctx: FileContext, node: ast.AST) -> bool:
+    """True when ``node`` sits under an ``if`` whose test — or a ``with``
+    whose context manager — names the overlap policy (an identifier
+    containing ``overlap``).  That is the exemption: the file already
+    branches on the double-buffer schedule, and BOTH arms of the branch
+    are deliberate (the serial arm is the policy's bitwise twin, not an
+    oversight).  The walk crosses function boundaries on purpose: a loop
+    body ``def`` nested under ``if overlapped:`` is gated too."""
+    cur: Optional[ast.AST] = node
+    while cur is not None:
+        cur = ctx.parents.get(cur)
+        if isinstance(cur, ast.If):
+            for sub in ast.walk(cur.test):
+                label = sub.id if isinstance(sub, ast.Name) else (
+                    sub.attr if isinstance(sub, ast.Attribute) else ""
+                )
+                if "overlap" in label.lower():
+                    return True
+        if isinstance(cur, ast.With):
+            for item in cur.items:
+                expr = item.context_expr
+                target = expr.func if isinstance(expr, ast.Call) else expr
+                dotted = ctx.resolve(target) or ""
+                if "overlap" in dotted.rsplit(".", 1)[-1].lower():
+                    return True
+    return False
+
+
+def _round_body(ctx: FileContext, node: ast.AST, loop_fns: set):
+    """The per-round body containing ``node``: the nearest lexical
+    ``for``/``while`` inside the enclosing function, or the enclosing
+    function itself when it is the body argument of a jax loop
+    combinator.  ``None`` when ``node`` does not run once per round."""
+    cur: Optional[ast.AST] = node
+    while cur is not None:
+        cur = ctx.parents.get(cur)
+        if isinstance(cur, (ast.For, ast.AsyncFor, ast.While)):
+            return cur
+        if isinstance(cur, _FUNC_TYPES):
+            return cur if cur in loop_fns else None
+    return None
+
+
+def _same_round_consumption(ctx: FileContext, node: ast.AST, body: ast.AST):
+    """How the ppermute result is consumed inside its own round, or
+    ``None`` when it only feeds the next round's carry.
+
+    Two shapes count: the call nested under arithmetic or a non-container
+    call in the same statement, and an assigned name loaded again later
+    in the body.  Loads inside ``return`` statements are excluded — a
+    returned carry IS the pipelined pattern (the value crosses into the
+    next round, where overlap is possible); same-round reuse is what
+    pins the wire onto the critical path."""
+    stmt = ctx.enclosing_statement(node)
+    cur: Optional[ast.AST] = node
+    while cur is not stmt and cur is not None:
+        cur = ctx.parents.get(cur)
+        if isinstance(cur, (ast.BinOp, ast.UnaryOp, ast.Compare)):
+            return "folded into arithmetic in the same statement"
+        if isinstance(cur, ast.Call):
+            leaf = (ctx.resolve(cur.func) or "").rsplit(".", 1)[-1]
+            if leaf not in _CONTAINER_CALLS:
+                return f"passed straight into {leaf or 'a call'}()"
+    if isinstance(stmt, ast.AugAssign):
+        return "augmented-assigned into live state"
+    targets: set = set()
+    if isinstance(stmt, ast.Assign):
+        for t in stmt.targets:
+            for sub in ast.walk(t):
+                if isinstance(sub, ast.Name):
+                    targets.add(sub.id)
+    elif isinstance(stmt, ast.AnnAssign) and isinstance(stmt.target, ast.Name):
+        targets.add(stmt.target.id)
+    if not targets:
+        return None
+    after = getattr(stmt, "end_lineno", stmt.lineno) or stmt.lineno
+    for sub in ast.walk(body):
+        if (
+            isinstance(sub, ast.Name)
+            and isinstance(sub.ctx, ast.Load)
+            and sub.id in targets
+            and getattr(sub, "lineno", 0) > after
+        ):
+            ret: Optional[ast.AST] = sub
+            while ret is not None and ret is not body:
+                if isinstance(ret, ast.Return):
+                    break
+                ret = ctx.parents.get(ret)
+            if isinstance(ret, ast.Return):
+                continue  # next-round carry, not same-round consumption
+            return f"read back as {sub.id!r} later in the round"
+    return None
+
+
+@rule("SPMD209", "serialized ring body: ppermute result consumed in the same round")
+def check_serialized_ring_body(ctx: FileContext) -> Iterable[Finding]:
+    """A ``jax.lax.ppermute`` inside a per-round body — a lexical
+    ``for``/``while`` or a function passed to
+    ``fori_loop``/``scan``/``while_loop`` — whose result is consumed in
+    the SAME round (nested under arithmetic or a consuming call, or its
+    assigned name is loaded again before the round ends) puts the wire
+    hop on the critical path: every round is ``wire + compute`` instead
+    of ``max(wire, compute)``, and no scheduler can hide the transfer
+    because the data dependency forbids it.  Results that only feed the
+    ``return``-ed carry are exempt — that IS the double-buffered shape
+    (the in-flight slab crosses into the next round while this round's
+    math runs).  Bodies gated on the overlap policy (under an ``if``
+    test or ``with`` manager naming ``overlap``) are exempt as a pair:
+    the serial arm there is the policy's deliberate bitwise twin."""
+    loop_fns: set = set()
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        dotted = ctx.resolve(node.func) or ""
+        leaf = dotted.rsplit(".", 1)[-1]
+        if leaf in _LOOP_BODY_CALLS and (
+            dotted == leaf or "jax" in dotted or "lax" in dotted
+        ):
+            for idx in _LOOP_BODY_CALLS[leaf]:
+                if idx < len(node.args):
+                    fn = ctx._fn_node_of(node.args[idx], node)
+                    if fn is not None:
+                        loop_fns.add(fn)
+
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call) or not ctx.resolves_to(node.func, "ppermute"):
+            continue
+        body = _round_body(ctx, node, loop_fns)
+        if body is None or _overlap_gated(ctx, node):
+            continue
+        how = _same_round_consumption(ctx, node, body)
+        if how is None:
+            continue
+        yield ctx.finding(
+            "SPMD209", node,
+            f"ppermute result {how} — the ring round serializes as "
+            "wire + compute, every hop on the critical path",
+            hint="double-buffer the ring: carry (current, in-flight) "
+            "slabs, issue the next round's ppermute first, and fold the "
+            "PREVIOUS round's operand (parallel/primitives.py ring_map; "
+            "policy in heat_tpu.comm.overlap) — or gate the serial body "
+            "under `if overlap_enabled(...)` so it is the policy's "
+            "deliberate twin; mark with `# spmdlint: disable=SPMD209` if "
+            "the same-round dependency is inherent to the algorithm",
+        )
 
 
 # --------------------------------------------------------------------- #

@@ -70,6 +70,7 @@ def cost_report(
             "events": [],
             "modeled_wire_bytes": 0,
             "modeled_exact_bytes": 0,
+            "modeled_critical_path_ms": {"serial": 0.0, "overlap": 0.0},
         })
         record = {
             "line": ev.line,
@@ -98,17 +99,33 @@ def cost_report(
             plan = costs.plan_cost(
                 tuple(f.shape), f.dtype, f.src, f.dst, mesh, mode_for=mode_for
             )
+            # time model per schedule: serial rings sum wire + compute per
+            # hop, overlapped rings pay max(wire, compute) after a warm-up
+            # hop (compute is not statically known — 0 here, so this is
+            # the pure wire-bound floor under each schedule)
+            hops = sum(1 for s in plan["steps"] if s[0] == "rotate")
+            cp = {
+                "serial": costs.critical_path_ms(
+                    plan["wire_bytes"], hops, overlap=False
+                ),
+                "overlap": costs.critical_path_ms(
+                    plan["wire_bytes"], hops, overlap=True
+                ),
+            }
             record.update({
                 "wire_bytes": plan["wire_bytes"],
                 "exact_wire_bytes": plan["exact_wire_bytes"],
                 "peak_live_bytes": plan["peak_live_bytes"],
                 "mode": plan["mode"],
+                "critical_path_ms": cp,
                 "monolithic_wire_bytes": costs.monolithic_cost(
                     tuple(f.shape), item, f.src, f.dst, mesh
                 )["wire_bytes"],
             })
             entry["modeled_wire_bytes"] += plan["wire_bytes"]
             entry["modeled_exact_bytes"] += plan["exact_wire_bytes"]
+            entry["modeled_critical_path_ms"]["serial"] += cp["serial"]
+            entry["modeled_critical_path_ms"]["overlap"] += cp["overlap"]
         else:
             record["wire_bytes"] = None
             if f.op in _PRICED_OPS:
@@ -127,6 +144,13 @@ def cost_report(
             "modeled_exact_bytes": sum(
                 e["modeled_exact_bytes"] for e in functions.values()
             ),
+            "modeled_critical_path_ms": {
+                sched: sum(
+                    e["modeled_critical_path_ms"][sched]
+                    for e in functions.values()
+                )
+                for sched in ("serial", "overlap")
+            },
             "events": sum(len(e["events"]) for e in functions.values()),
             "unmodeled_events": unmodeled,
         },

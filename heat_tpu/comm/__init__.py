@@ -13,9 +13,22 @@ wire format and the error-feedback machinery.
 compiles to a minimal-traffic, bounded-memory schedule of
 allgather / dynamic-slice / ppermute steps executed as one dispatch
 (arXiv 2112.01075; docs/design.md §14).
+
+``ht.comm.set_overlap("on")`` switches every hot ring — attention,
+compressed allreduce/allgather, planned-redistribution rotations,
+``ring_map`` — onto its double-buffered latency-hiding body, which
+issues each round's ``ppermute`` while the previous round's operand is
+consumed (:mod:`heat_tpu.comm.overlap`; docs/design.md §18).  Values
+stay bitwise-identical to the serial bodies.
 """
 
 from . import compressed, redistribute
+from .overlap import (
+    get_overlap,
+    overlap,
+    overlap_enabled,
+    set_overlap,
+)
 from .redistribute import (
     Plan,
     get_redistribution,
@@ -53,9 +66,12 @@ __all__ = [
     "dequantize_blocks",
     "get_collective_precision",
     "get_collective_threshold",
+    "get_overlap",
     "get_redistribution",
     "get_redistribution_threshold",
     "monolithic_model",
+    "overlap",
+    "overlap_enabled",
     "plan",
     "quantize_blocks",
     "redistribute",
@@ -66,6 +82,7 @@ __all__ = [
     "ring_allreduce_q_ef",
     "set_collective_precision",
     "set_collective_threshold",
+    "set_overlap",
     "set_redistribution",
     "set_redistribution_threshold",
 ]

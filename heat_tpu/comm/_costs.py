@@ -32,6 +32,8 @@ from typing import Callable, Optional, Tuple
 
 __all__ = [
     "BLOCK",
+    "DEFAULT_ICI_GBPS",
+    "critical_path_ms",
     "encoded_bytes",
     "itemsize",
     "monolithic_cost",
@@ -58,6 +60,38 @@ _ITEMSIZES = {
 #: dtype names the collective-precision policy may compress; everything
 #: else always rides the wire exact (spmdlint SPMD203's runtime twin).
 _COMPRESSIBLE = ("float32", "bfloat16")
+
+#: Nominal per-link ICI bandwidth (GB/s, one direction) used when a
+#: critical-path estimate needs a wire-time denominator and no measured
+#: figure is supplied.  A planning constant, not a measurement — bench
+#: headlines always pair modeled time with a same-run measured twin.
+DEFAULT_ICI_GBPS = 45.0
+
+
+def critical_path_ms(
+    wire_bytes: int,
+    hops: int,
+    compute_ms_per_step: float = 0.0,
+    *,
+    gbps: float = DEFAULT_ICI_GBPS,
+    overlap: bool = False,
+) -> float:
+    """Modeled critical-path time of a ring whose ``wire_bytes`` travel
+    in ``hops`` equal steps, each step followed (serial) or accompanied
+    (overlap) by ``compute_ms_per_step`` of math.
+
+    ``overlap=False`` is the strictly alternating schedule — every hop
+    pays wire + compute in sequence.  ``overlap=True`` is the
+    double-buffered schedule: after one warm-up hop, each step costs
+    ``max(wire, compute)`` — the concurrent-DMA/MXU roofline the overlap
+    policy targets (docs/design.md §18).  ``hops == 0`` degenerates to a
+    single transfer plus one compute step on both schedules.
+    """
+    h = max(int(hops), 1)
+    step_wire = (int(wire_bytes) / h) / (float(gbps) * 1e6)  # ms
+    if not overlap:
+        return h * (step_wire + float(compute_ms_per_step))
+    return step_wire + h * max(step_wire, float(compute_ms_per_step))
 
 
 def itemsize(dtype_name: str) -> int:
@@ -181,6 +215,7 @@ def plan_cost(
     size: int,
     *,
     mode_for: Optional[Callable[[int], Optional[str]]] = None,
+    overlap: bool = False,
 ) -> dict:
     """Schedule + cost model of the planned redistribution.
 
@@ -191,6 +226,10 @@ def plan_cost(
     wire payload's byte count to its compression mode (defaults to exact
     transmission); the runtime passes the live collective-precision
     policy, the static analyzer whatever policy it is asked to model.
+
+    ``overlap=True`` models the pipelined rotation schedule (two pieces
+    in flight instead of one): wire bytes are unchanged, the split→split
+    peak grows by one piece (plus its f32 staging when compressed).
 
     Steps and figures are identical to the runtime planner's — the
     runtime delegates here, so they cannot diverge.
@@ -258,9 +297,10 @@ def plan_cost(
     exact = (p - 1) * piece_elems * item
     wire = (p - 1) * encoded_bytes(piece_elems, mode, item)
     slab = p * piece_elems * item  # == padded input shard == output shard
-    peak = 2 * slab + piece_elems * item
+    in_flight = 2 if overlap else 1  # pipelined rotations double-buffer
+    peak = 2 * slab + in_flight * piece_elems * item
     if mode is not None:
-        peak += piece_elems * 4  # f32 staging of the encoded piece
+        peak += in_flight * piece_elems * 4  # f32 staging of encoded pieces
     return {
         "steps": tuple(steps), "mode": mode, "wire_bytes": wire,
         "exact_wire_bytes": exact, "peak_live_bytes": peak,

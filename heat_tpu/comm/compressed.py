@@ -76,6 +76,7 @@ from ..core._jax_compat import shape_dtype_struct, shard_map
 from ..core.communication import sanitize_comm
 from ..telemetry import _core as _tel
 from . import _costs
+from .overlap import overlap_enabled, timed_dispatch
 
 __all__ = [
     "BLOCK",
@@ -342,6 +343,16 @@ def ring_allreduce_q(value, axis_name, *, size: int, mode: str, block: int = BLO
     exactly ONCE and the same bytes are forwarded around the ring — all
     devices decode identical payloads, so the result is bit-identical
     across positions (safe to declare replicated).
+
+    Under the overlap policy (:mod:`heat_tpu.comm.overlap`) each chunk is
+    split at a block-aligned boundary into two independent streams whose
+    encode → ppermute → decode chains interleave, so one stream's wire
+    time hides behind the other's quantization math.  The reduce-scatter
+    hops themselves are data-dependent (hop ``s+1`` ships what hop ``s``
+    produced), which is why the latency hiding lives WITHIN each hop
+    rather than across iterations.  Per-``block`` quantization is
+    row-independent, so the split streams carry bit-identical payloads
+    and the result is bitwise-equal to the serial body.
     """
     if size == 1:
         return value
@@ -354,26 +365,55 @@ def ring_allreduce_q(value, axis_name, *, size: int, mode: str, block: int = BLO
     chunks = flat.reshape(size, chunk)
     idx = jax.lax.axis_index(axis_name)
     perm = [(i, (i + 1) % size) for i in range(size)]
+    # both stream halves must be non-empty block multiples
+    overlapped = overlap_enabled(size) and chunk >= 2 * block
+    h = (chunk // block // 2) * block
 
     # stage 1 — reduce-scatter: position i accumulates chunk (i+1) mod size
     cur = jnp.take(chunks, idx, axis=0)
-    for s in range(size - 1):
-        payload = _encode(cur, mode, block)
-        payload = tuple(jax.lax.ppermute(leaf, axis_name, perm) for leaf in payload)
-        cur = _decode(payload, mode) + jnp.take(chunks, (idx - s - 1) % size, axis=0)
+    if overlapped:
+        for s in range(size - 1):
+            add = jnp.take(chunks, (idx - s - 1) % size, axis=0)
+            pa = _encode(cur[:h], mode, block)
+            pa = tuple(jax.lax.ppermute(leaf, axis_name, perm) for leaf in pa)
+            pb = _encode(cur[h:], mode, block)
+            pb = tuple(jax.lax.ppermute(leaf, axis_name, perm) for leaf in pb)
+            cur = jnp.concatenate(
+                [_decode(pa, mode) + add[:h], _decode(pb, mode) + add[h:]]
+            )
+    else:
+        for s in range(size - 1):
+            payload = _encode(cur, mode, block)
+            payload = tuple(jax.lax.ppermute(leaf, axis_name, perm) for leaf in payload)
+            cur = _decode(payload, mode) + jnp.take(chunks, (idx - s - 1) % size, axis=0)
 
     # stage 2 — all-gather: quantize each reduced chunk once, forward the
     # bytes verbatim so every device decodes the same values
-    payload = _encode(cur, mode, block)
     out = jnp.zeros((size, chunk), jnp.float32)
-    out = jax.lax.dynamic_update_slice_in_dim(
-        out, _decode(payload, mode)[None], (idx + 1) % size, axis=0
-    )
-    for s in range(size - 1):
-        payload = tuple(jax.lax.ppermute(leaf, axis_name, perm) for leaf in payload)
+    if overlapped:
+        pa = _encode(cur[:h], mode, block)
+        pb = _encode(cur[h:], mode, block)
+        dec = jnp.concatenate([_decode(pa, mode), _decode(pb, mode)])
         out = jax.lax.dynamic_update_slice_in_dim(
-            out, _decode(payload, mode)[None], (idx - s) % size, axis=0
+            out, dec[None], (idx + 1) % size, axis=0
         )
+        for s in range(size - 1):
+            pa = tuple(jax.lax.ppermute(leaf, axis_name, perm) for leaf in pa)
+            pb = tuple(jax.lax.ppermute(leaf, axis_name, perm) for leaf in pb)
+            dec = jnp.concatenate([_decode(pa, mode), _decode(pb, mode)])
+            out = jax.lax.dynamic_update_slice_in_dim(
+                out, dec[None], (idx - s) % size, axis=0
+            )
+    else:
+        payload = _encode(cur, mode, block)
+        out = jax.lax.dynamic_update_slice_in_dim(
+            out, _decode(payload, mode)[None], (idx + 1) % size, axis=0
+        )
+        for s in range(size - 1):
+            payload = tuple(jax.lax.ppermute(leaf, axis_name, perm) for leaf in payload)
+            out = jax.lax.dynamic_update_slice_in_dim(
+                out, _decode(payload, mode)[None], (idx - s) % size, axis=0
+            )
     return out.reshape(total)[:n].reshape(shape).astype(dtype)
 
 
@@ -402,7 +442,12 @@ def ring_allgather_q(value, axis_name, *, size: int, mode: str, block: int = BLO
     once, the bytes make ``size - 1`` ``ppermute`` hops, and every
     position decodes the identical payloads into a stacked
     ``(size,) + value.shape`` result (row r = position r's value),
-    bit-identical across devices."""
+    bit-identical across devices.
+
+    Under the overlap policy the payload is split into two block-aligned
+    streams (see :func:`ring_allreduce_q`): each hop's two half-size
+    ppermutes interleave with the halves' decodes, and decode-of-halves
+    concatenated equals the serial decode bit for bit."""
     shape, dtype = value.shape, value.dtype
     if size == 1:
         return value[None]
@@ -412,17 +457,32 @@ def ring_allgather_q(value, axis_name, *, size: int, mode: str, block: int = BLO
     flat = jnp.pad(flat, (0, padded - n))
     idx = jax.lax.axis_index(axis_name)
     perm = [(i, (i + 1) % size) for i in range(size)]
+    overlapped = overlap_enabled(size) and padded >= 2 * block
+    h = (padded // block // 2) * block
 
-    payload = _encode(flat, mode, block)
     out = jnp.zeros((size, padded), jnp.float32)
-    out = jax.lax.dynamic_update_slice_in_dim(
-        out, _decode(payload, mode)[None], idx, axis=0
-    )
-    for s in range(size - 1):
-        payload = tuple(jax.lax.ppermute(leaf, axis_name, perm) for leaf in payload)
+    if overlapped:
+        pa = _encode(flat[:h], mode, block)
+        pb = _encode(flat[h:], mode, block)
+        dec = jnp.concatenate([_decode(pa, mode), _decode(pb, mode)])
+        out = jax.lax.dynamic_update_slice_in_dim(out, dec[None], idx, axis=0)
+        for s in range(size - 1):
+            pa = tuple(jax.lax.ppermute(leaf, axis_name, perm) for leaf in pa)
+            pb = tuple(jax.lax.ppermute(leaf, axis_name, perm) for leaf in pb)
+            dec = jnp.concatenate([_decode(pa, mode), _decode(pb, mode)])
+            out = jax.lax.dynamic_update_slice_in_dim(
+                out, dec[None], (idx - s - 1) % size, axis=0
+            )
+    else:
+        payload = _encode(flat, mode, block)
         out = jax.lax.dynamic_update_slice_in_dim(
-            out, _decode(payload, mode)[None], (idx - s - 1) % size, axis=0
+            out, _decode(payload, mode)[None], idx, axis=0
         )
+        for s in range(size - 1):
+            payload = tuple(jax.lax.ppermute(leaf, axis_name, perm) for leaf in payload)
+            out = jax.lax.dynamic_update_slice_in_dim(
+                out, _decode(payload, mode)[None], (idx - s - 1) % size, axis=0
+            )
     return out[:, :n].reshape((size,) + shape).astype(dtype)
 
 
@@ -560,9 +620,19 @@ def allreduce_q(
     eager = not isinstance(array, jax.core.Tracer)
     payload = faults.comm_input("allreduce_q", array) if eager and faults.any_active() else array
     if _tel.enabled and eager:
-        _account_wire("allreduce", wire, int(np.prod(shape[1:])) if len(shape) > 1 else 1, p)
+        n_res = int(np.prod(shape[1:])) if len(shape) > 1 else 1
+        _account_wire("allreduce", wire, n_res, p)
+        # whether THIS dispatch traced the two-stream latency-hiding body
+        ring_ov = (
+            wire is not None
+            and overlap_enabled(p)
+            and _padded_len(-(-n_res // p), blk) >= 2 * blk
+        )
         with _tel.span("commq:allreduce", mode=wire or "f32", mesh=p):
-            out = fn(payload, error) if has_err else fn(payload)
+            out = timed_dispatch(
+                "allreduce_q", ring_ov,
+                (lambda: fn(payload, error)) if has_err else (lambda: fn(payload)),
+            )
     else:
         out = fn(payload, error) if has_err else fn(payload)
     if eager and faults.any_active():
@@ -683,9 +753,11 @@ def allgather_q(
     eager = not isinstance(array, jax.core.Tracer)  # see allreduce_q
     payload = faults.comm_input("allgather_q", array) if eager and faults.any_active() else array
     if _tel.enabled and eager:
-        _account_wire("allgather", mode, int(np.prod(shape)) // p, p)
+        n_loc = int(np.prod(shape)) // p
+        _account_wire("allgather", mode, n_loc, p)
+        ring_ov = overlap_enabled(p) and _padded_len(n_loc, blk) >= 2 * blk
         with _tel.span("commq:allgather", mode=mode, mesh=p):
-            out = fn(payload)
+            out = timed_dispatch("allgather_q", ring_ov, lambda: fn(payload))
     else:
         out = fn(payload)
     if eager and faults.any_active():

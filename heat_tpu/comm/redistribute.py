@@ -91,6 +91,7 @@ from ..telemetry import _core as _tel
 from . import _costs
 from . import compressed as _cq
 from .compressed import BLOCK
+from .overlap import overlap_enabled, timed_dispatch
 
 __all__ = [
     "Plan",
@@ -221,17 +222,33 @@ class Plan:
             shape[self.dst] = self.size * w
         return tuple(shape)
 
-    def wire_model(self) -> dict:
+    def wire_model(self, compute_ms_per_step: float = 0.0) -> dict:
         """Cost-model dict in the :func:`compressed.wire_model` shape —
-        the single source for bench headlines and telemetry accounting."""
+        the single source for bench headlines and telemetry accounting.
+
+        ``critical_path_ms`` prices the schedule's wire time under both
+        ring schedules (:func:`heat_tpu.comm._costs.critical_path_ms`):
+        ``"serial"`` sums wire + compute per hop, ``"overlap"`` is the
+        pipelined ``max(wire, compute)`` roofline the overlap policy
+        targets.  ``compute_ms_per_step`` defaults to 0 (pure wire
+        bound); bench passes its measured per-step compute probe."""
         exact = self.exact_wire_bytes
+        hops = sum(1 for s in self.steps if s[0] == "rotate")
         return {
             "steps": len(self.steps),
-            "rotate_hops_per_device": sum(1 for s in self.steps if s[0] == "rotate"),
+            "rotate_hops_per_device": hops,
             "exact_wire_bytes": exact,
             "wire_bytes": self.wire_bytes,
             "peak_live_bytes": self.peak_live_bytes,
             "bytes_ratio": round(self.wire_bytes / exact, 4) if exact else None,
+            "critical_path_ms": {
+                "serial": _costs.critical_path_ms(
+                    self.wire_bytes, hops, compute_ms_per_step, overlap=False
+                ),
+                "overlap": _costs.critical_path_ms(
+                    self.wire_bytes, hops, compute_ms_per_step, overlap=True
+                ),
+            },
         }
 
     def explain(self) -> str:
@@ -369,20 +386,39 @@ def _build_plan(shape, dtype, src, dst, p, max_live_bytes) -> Plan:
 # --------------------------------------------------------------------- #
 # execution: one compiled shard_map program per plan                     #
 # --------------------------------------------------------------------- #
-def _ship(piece, axis_name, perm, mode: Optional[str]):
-    """Move one rotation piece to its destination: a raw ppermute when
-    transmission is exact, else encode → ppermute the wire leaves →
-    decode (the quantize-once-forward-bytes discipline of the rings)."""
+def _ship_start(piece, mode: Optional[str]):
+    """Phase 1 of one rotation ship: encode the piece into its wire
+    leaves (the piece itself when transmission is exact)."""
     if mode is None:
-        return jax.lax.ppermute(piece, axis_name, perm)
-    shape, dtype = piece.shape, piece.dtype
-    n = int(math.prod(shape)) if shape else 1
+        return (piece,)
+    n = int(math.prod(piece.shape)) if piece.shape else 1
     flat = piece.reshape(-1).astype(jnp.float32)
     padded = max(BLOCK, -(-n // BLOCK) * BLOCK)
     flat = jnp.pad(flat, (0, padded - n))
-    payload = _cq._encode(flat, mode, BLOCK)
-    payload = tuple(jax.lax.ppermute(leaf, axis_name, perm) for leaf in payload)
-    return _cq._decode(payload, mode)[:n].reshape(shape).astype(dtype)
+    return _cq._encode(flat, mode, BLOCK)
+
+
+def _ship_send(leaves, axis_name, perm):
+    """Phase 2: put the wire leaves on the ring."""
+    return tuple(jax.lax.ppermute(leaf, axis_name, perm) for leaf in leaves)
+
+
+def _ship_finish(leaves, mode: Optional[str], shape, dtype):
+    """Phase 3: decode the received leaves back into a piece."""
+    if mode is None:
+        return leaves[0]
+    n = int(math.prod(shape)) if shape else 1
+    return _cq._decode(leaves, mode)[:n].reshape(shape).astype(dtype)
+
+
+def _ship(piece, axis_name, perm, mode: Optional[str]):
+    """Move one rotation piece to its destination: a raw ppermute when
+    transmission is exact, else encode → ppermute the wire leaves →
+    decode (the quantize-once-forward-bytes discipline of the rings).
+    The three phases are split out so the overlapped schedule can issue
+    rotation ``k+1``'s send before finishing rotation ``k``."""
+    leaves = _ship_send(_ship_start(piece, mode), axis_name, perm)
+    return _ship_finish(leaves, mode, piece.shape, piece.dtype)
 
 
 def _pad_axis(x, axis: int, pad: int):
@@ -399,6 +435,9 @@ def _make_program(p_obj: Plan, comm):
     src, dst, mode = p_obj.src, p_obj.dst, p_obj.mode
     shape = p_obj.global_shape
     ndim = len(shape)
+    # pipelined rotation schedule under the overlap policy (in every
+    # compiled-program cache key via the registered token)
+    overlapped = overlap_enabled(p)
 
     if not p_obj.steps:  # identity: let apply_sharding's no-op path handle it
         return None
@@ -449,12 +488,37 @@ def _make_program(p_obj: Plan, comm):
             out = jax.lax.dynamic_update_slice_in_dim(
                 out, piece_at(i), i * w_s, axis=src
             )
-            for k in range(1, p):
+            pshape = tuple(
+                w_d if a == dst else d for a, d in enumerate(x.shape)
+            )
+
+            def send(k):
                 perm = [(t, (t + k) % p) for t in range(p)]
-                pc = _ship(piece_at((i + k) % p), name, perm, mode)
-                out = jax.lax.dynamic_update_slice_in_dim(
-                    out, pc, ((i - k) % p) * w_s, axis=src
+                return _ship_send(
+                    _ship_start(piece_at((i + k) % p), mode), name, perm
                 )
+
+            if overlapped:
+                # pipelined rotations: the p-1 ships are data-independent,
+                # so rotation k+1's encode + ppermute is issued before
+                # rotation k's decode + update — at most two pieces in
+                # flight, and each hop's wire hides behind the previous
+                # hop's decode math.  Same encode/decode per piece, updates
+                # at distinct offsets: bitwise-equal to the serial arm.
+                inflight = send(1)
+                for k in range(1, p):
+                    nxt = send(k + 1) if k + 1 < p else None
+                    pc = _ship_finish(inflight, mode, pshape, x.dtype)
+                    out = jax.lax.dynamic_update_slice_in_dim(
+                        out, pc, ((i - k) % p) * w_s, axis=src
+                    )
+                    inflight = nxt
+            else:
+                for k in range(1, p):
+                    pc = _ship_finish(send(k), mode, pshape, x.dtype)
+                    out = jax.lax.dynamic_update_slice_in_dim(
+                        out, pc, ((i - k) % p) * w_s, axis=src
+                    )
             return out
 
         in_spec, out_spec = comm.spec(ndim, src), comm.spec(ndim, dst)
@@ -523,10 +587,13 @@ def execute(array, p_obj: Plan, comm):
             "resplit", p_obj.mode or "f32", p_obj.exact_wire_bytes, p_obj.wire_bytes
         )
         _tel.inc("comm.resplit.planned")
+        ring_ov = overlap_enabled(p_obj.size) and any(
+            s[0] == "rotate" for s in p_obj.steps
+        )
         with _tel.span(
             "comm:resplit",
             src=p_obj.src, dst=p_obj.dst, mesh=p_obj.size,
             steps=len(p_obj.steps), mode=p_obj.mode or "f32",
         ):
-            return fn(array)
+            return timed_dispatch("resplit", ring_ov, lambda: fn(array))
     return fn(array)

@@ -16,6 +16,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec
 
+from ..comm.overlap import overlap_enabled, timed_dispatch
 from ..core._compile import cache_stable, jitted
 from ..core._jax_compat import pcast, shard_map
 from ..core.communication import XlaCommunication, get_comm
@@ -88,23 +89,45 @@ def ring_map(
 
     mesh, name = comm.mesh, comm.axis_name
     perm = [(i, (i + 1) % size) for i in range(size)]
+    overlapped = overlap_enabled(size)
 
     def kernel(block):
         stationary = block
 
-        def body(r, carry):
-            rotating, acc = carry
+        def fold(r, rotating, acc):
             res = fn(stationary, rotating, r)
-            acc = acc.at[r].set(res)
-            rotating = jax.lax.ppermute(rotating, name, perm)
-            return rotating, acc
+            return acc.at[r].set(res)
 
         probe = fn(stationary, stationary, 0)
         acc0 = jnp.zeros((size,) + probe.shape, probe.dtype)
         # freshly-created carries are axis-invariant; the loop makes them
         # varying over the mesh axis — align the types up front
         acc0 = pcast(acc0, (name,), to="varying")
-        _, acc = jax.lax.fori_loop(0, size, body, (stationary, acc0))
+        if overlapped:
+            # double-buffered: round r issues the hop that produces
+            # operand r+2 while the fold consumes operand r, so the DMA
+            # runs behind the math.  Same ppermute chain applied to the
+            # same operands, same fold order — bitwise equal to the
+            # serial body (design.md §18); costs one extra in-flight slab
+            # and one extra (unconsumed) hop.
+            def body(r, carry):
+                cur, inflight, acc = carry
+                nxt = jax.lax.ppermute(inflight, name, perm)
+                acc = fold(r, cur, acc)
+                return inflight, nxt, acc
+
+            inflight0 = jax.lax.ppermute(stationary, name, perm)
+            _, _, acc = jax.lax.fori_loop(
+                0, size, body, (stationary, inflight0, acc0)
+            )
+        else:
+            def body(r, carry):
+                rotating, acc = carry
+                acc = fold(r, rotating, acc)
+                rotating = jax.lax.ppermute(rotating, name, perm)
+                return rotating, acc
+
+            _, acc = jax.lax.fori_loop(0, size, body, (stationary, acc0))
         if probe.ndim == 0:
             # scalar per round: materialize the per-position axis so the
             # global result is (rounds, positions)
@@ -126,10 +149,12 @@ def ring_map(
     # keying on per-call identities would grow the global cache by one
     # dead entry per call without ever hitting
     if cache_stable(fn):
-        out = jitted(("ring_map", comm, fn), make)(arr)  # spmdlint: disable=SPMD401
+        ring = jitted(("ring_map", comm, fn), make)  # spmdlint: disable=SPMD401
     else:
-        out = jax.jit(make())(arr)
-    return out
+        ring = jax.jit(make())
+    if isinstance(arr, jax.core.Tracer):  # inside fuse/jit: no host timing
+        return ring(arr)
+    return timed_dispatch("ring_map", overlapped, lambda: ring(arr))
 
 
 def halo_exchange(

@@ -6,9 +6,13 @@ key/value blocks rotate one hop per round on the ``ppermute`` ring — the
 identical communication shape as the reference's pairwise-distance ring
 (spatial/distance.py:261-345), upgraded with the blockwise-softmax
 (running log-sum-exp) accumulation so the result is *exact* attention, not
-an approximation.  Compute (the q·kᵀ and p·v matmuls, MXU) overlaps with
-the next block's transfer (ICI) because XLA schedules the ppermute
-asynchronously inside the fori_loop.
+an approximation.  Under the overlap policy
+(:func:`heat_tpu.comm.overlap.set_overlap`; docs/design.md §18) the ring
+bodies are double-buffered: round ``r`` issues the ``ppermute`` for the
+round-``r+1`` K/V operand while the MXU folds the round-``r`` operand, so
+the ICI transfer hides behind the q·kᵀ and p·v matmuls instead of
+serializing with them.  The fold schedule is identical either way —
+overlapped and serial programs are bitwise-equal.
 
 No reference analog (HeAT has no attention); included because long-context
 sequence parallelism is a first-class capability of this framework.
@@ -24,6 +28,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec
 
+from ..comm.overlap import overlap_enabled, timed_dispatch
 from ..core._compile import jitted
 from ..core._jax_compat import pcast, shard_map
 from ..core.communication import XlaCommunication, get_comm
@@ -169,6 +174,17 @@ def ring_attention(
     Lh = L // 2
     perm = [(i, (i + 1) % size) for i in range(size)]
     spec = PartitionSpec(None, name, None, None)
+    # double-buffered ring bodies under the overlap policy; part of every
+    # jitted cache key via the registered policy token, so the serial
+    # twin and the overlapped ring coexist as separate compiled programs
+    overlapped = overlap_enabled(size)
+
+    def run_ring(ring_fn):
+        if isinstance(q, jax.core.Tracer):  # inside fuse/jit: no host timing
+            return ring_fn(q, k, v)
+        return timed_dispatch(
+            "ring_attention", overlapped, lambda: ring_fn(q, k, v)
+        )
 
     # Causal load balancing: under contiguous sharding device 0's queries
     # see one non-empty round while device size-1's see all of them, so
@@ -241,6 +257,11 @@ def ring_attention(
                         vma_axes=() if interp else (name,),
                     )
 
+                if overlapped:
+                    # issue hop 1 ahead of the round-0 folds: the first
+                    # transfer runs behind the two diagonal tiles
+                    kz1 = jax.lax.ppermute(kz, name, perm)
+                    vz1 = jax.lax.ppermute(vz, name, perm)
                 # round 0 — the origin is this device: the two diagonal
                 # Lh-tiles (the ONLY masked folds in the whole program)
                 # plus the always-full (high-q, low-k) pair
@@ -250,11 +271,9 @@ def ring_attention(
                              False, 0, 0)
                 st_hi = fold(q_hi, kz[:, Lh:], vz[:, Lh:], st_hi,
                              True, base_hi, base_hi)
-                kz = jax.lax.ppermute(kz, name, perm)
-                vz = jax.lax.ppermute(vz, name, perm)
 
-                def body(r, carry):
-                    kz, vz, m_lo, l_lo, a_lo, m_hi, l_hi, a_hi = carry
+                def round_folds(r, kz, vz, st):
+                    m_lo, l_lo, a_lo, m_hi, l_hi, a_hi = st
                     j = (my - r) % size  # visiting pair's home device
                     ks, vs = kz[:, :Lh], vz[:, :Lh]  # chunk j
                     kh, vh = kz[:, Lh:], vz[:, Lh:]  # chunk 2*size-1-j
@@ -283,13 +302,39 @@ def ring_attention(
                         jnp.where(sel, o, n)
                         for n, o in zip((m2, l2, a2), (m_hi, l_hi, a_hi))
                     )
-                    kz = jax.lax.ppermute(kz, name, perm)
-                    vz = jax.lax.ppermute(vz, name, perm)
-                    return kz, vz, m_lo, l_lo, a_lo, m_hi, l_hi, a_hi
+                    return m_lo, l_lo, a_lo, m_hi, l_hi, a_hi
 
-                _, _, m_lo, l_lo, a_lo, m_hi, l_hi, a_hi = jax.lax.fori_loop(
-                    1, size, body, (kz, vz, *st_lo, *st_hi)
-                )
+                if overlapped:
+                    # double-buffered: round r issues the hop producing
+                    # the round-r+1 pair while the folds consume the
+                    # round-r pair — same ppermute chain, same fold
+                    # schedule as the serial arm, bitwise equal
+                    def body(r, carry):
+                        kc, vc, ki, vi = carry[:4]
+                        kn = jax.lax.ppermute(ki, name, perm)
+                        vn = jax.lax.ppermute(vi, name, perm)
+                        st = round_folds(r, kc, vc, carry[4:])
+                        return (ki, vi, kn, vn, *st)
+
+                    kz2 = jax.lax.ppermute(kz1, name, perm)
+                    vz2 = jax.lax.ppermute(vz1, name, perm)
+                    out_st = jax.lax.fori_loop(
+                        1, size, body, (kz1, vz1, kz2, vz2, *st_lo, *st_hi)
+                    )[4:]
+                else:
+                    def body(r, carry):
+                        kz, vz = carry[:2]
+                        st = round_folds(r, kz, vz, carry[2:])
+                        kz = jax.lax.ppermute(kz, name, perm)
+                        vz = jax.lax.ppermute(vz, name, perm)
+                        return (kz, vz, *st)
+
+                    kz1 = jax.lax.ppermute(kz, name, perm)
+                    vz1 = jax.lax.ppermute(vz, name, perm)
+                    out_st = jax.lax.fori_loop(
+                        1, size, body, (kz1, vz1, *st_lo, *st_hi)
+                    )[2:]
+                m_lo, l_lo, a_lo, m_hi, l_hi, a_hi = out_st
                 out_lo = a_lo / jnp.maximum(l_lo, 1e-30)[..., None]
                 out_hi = a_hi / jnp.maximum(l_hi, 1e-30)[..., None]
                 out = zigzag_merge(out_lo, out_hi, 1, name, size)
@@ -304,7 +349,7 @@ def ring_attention(
 
         if zigzag and conforms(Lh, D, q.dtype):
             key = ("ring_attention.flash_zz", comm, B, S, H, D, str(q.dtype))
-            out = jitted(key, make_flash_zigzag)(q, k, v)
+            out = run_ring(jitted(key, make_flash_zigzag))
             return out if batched else out[0]
 
         # contiguous layout: non-causal, or a causal shape the zig-zag
@@ -336,22 +381,43 @@ def ring_attention(
                     jnp.zeros((B * H, L, D), jnp.float32), (name,), to="varying"
                 )
 
-                def body(r, carry):
-                    kb, vb, m, l, acc = carry
+                def fold(r, kb, vb, m, l, acc):
                     origin = (my - r) % size if causal else 0
-                    m, l, acc = flash_attention_partial(
+                    return flash_attention_partial(
                         qf, kb, vb, m, l, acc,
                         q_base=my * L, k_base=origin * L,
                         causal=causal, interpret=interp,
                         vma_axes=() if interp else (name,),
                     )
-                    kb = jax.lax.ppermute(kb, name, perm)
-                    vb = jax.lax.ppermute(vb, name, perm)
-                    return kb, vb, m, l, acc
 
-                _, _, m, l, acc = jax.lax.fori_loop(
-                    0, size, body, (kf, vf, m0, l0, acc0)
-                )
+                if overlapped:
+                    # double-buffered: issue the hop producing the
+                    # round-r+1 K/V while the kernel folds round r's —
+                    # same ppermute chain and fold order as the serial
+                    # arm, bitwise equal (design.md §18)
+                    def body(r, carry):
+                        kc, vc, ki, vi, m, l, acc = carry
+                        kn = jax.lax.ppermute(ki, name, perm)
+                        vn = jax.lax.ppermute(vi, name, perm)
+                        m, l, acc = fold(r, kc, vc, m, l, acc)
+                        return ki, vi, kn, vn, m, l, acc
+
+                    ki0 = jax.lax.ppermute(kf, name, perm)
+                    vi0 = jax.lax.ppermute(vf, name, perm)
+                    _, _, _, _, m, l, acc = jax.lax.fori_loop(
+                        0, size, body, (kf, vf, ki0, vi0, m0, l0, acc0)
+                    )
+                else:
+                    def body(r, carry):
+                        kb, vb, m, l, acc = carry
+                        m, l, acc = fold(r, kb, vb, m, l, acc)
+                        kb = jax.lax.ppermute(kb, name, perm)
+                        vb = jax.lax.ppermute(vb, name, perm)
+                        return kb, vb, m, l, acc
+
+                    _, _, m, l, acc = jax.lax.fori_loop(
+                        0, size, body, (kf, vf, m0, l0, acc0)
+                    )
                 out = acc / jnp.maximum(l, 1e-30)[..., None]  # (B*H, L, D)
                 out = jnp.moveaxis(out.reshape(B, H, L, D), 1, 2)
                 return out.astype(q_blk.dtype)  # (B, L, H, D)
@@ -370,7 +436,7 @@ def ring_attention(
             )
 
         key = ("ring_attention.flash", comm, causal, B, S, H, D, str(q.dtype))
-        out = jitted(key, make_flash)(q, k, v)
+        out = run_ring(jitted(key, make_flash))
         return out if batched else out[0]
 
     def make_xla_zigzag():
@@ -401,6 +467,10 @@ def ring_attention(
                     pcast(jnp.zeros((B, H, Lh), acc_dt), (name,), to="varying"),
                 )
 
+            if overlapped:
+                # issue hop 1 ahead of the round-0 diagonal updates
+                kz1 = jax.lax.ppermute(kz, name, perm)
+                vz1 = jax.lax.ppermute(vz, name, perm)
             st_lo = _blockwise_update(
                 qlo, kz[:, :, :Lh], vz[:, :, :Lh], *init(), scale, mask=tri
             )
@@ -410,11 +480,9 @@ def ring_attention(
             st_hi = _blockwise_update(
                 qhi, kz[:, :, Lh:], vz[:, :, Lh:], *st_hi, scale, mask=tri
             )
-            kz = jax.lax.ppermute(kz, name, perm)
-            vz = jax.lax.ppermute(vz, name, perm)
 
-            def body(r, carry):
-                kz, vz, m_lo, n_lo, d_lo, m_hi, n_hi, d_hi = carry
+            def round_folds(r, kz, vz, st):
+                m_lo, n_lo, d_lo, m_hi, n_hi, d_hi = st
                 j = (my - r) % size
                 ks, vs = kz[:, :, :Lh], vz[:, :, :Lh]  # chunk j
                 kh, vh = kz[:, :, Lh:], vz[:, :, Lh:]  # chunk 2*size-1-j
@@ -438,13 +506,37 @@ def ring_attention(
                     jnp.where(sel, o, n)
                     for n, o in zip((m2, n2, d2), (m_hi, n_hi, d_hi))
                 )
-                kz = jax.lax.ppermute(kz, name, perm)
-                vz = jax.lax.ppermute(vz, name, perm)
-                return kz, vz, m_lo, n_lo, d_lo, m_hi, n_hi, d_hi
+                return m_lo, n_lo, d_lo, m_hi, n_hi, d_hi
 
-            _, _, m_lo, n_lo, d_lo, m_hi, n_hi, d_hi = jax.lax.fori_loop(
-                1, size, body, (kz, vz, *st_lo, *st_hi)
-            )
+            if overlapped:
+                # double-buffered: same ppermute chain, same fold
+                # schedule as the serial arm — bitwise equal
+                def body(r, carry):
+                    kc, vc, ki, vi = carry[:4]
+                    kn = jax.lax.ppermute(ki, name, perm)
+                    vn = jax.lax.ppermute(vi, name, perm)
+                    st = round_folds(r, kc, vc, carry[4:])
+                    return (ki, vi, kn, vn, *st)
+
+                kz2 = jax.lax.ppermute(kz1, name, perm)
+                vz2 = jax.lax.ppermute(vz1, name, perm)
+                out_st = jax.lax.fori_loop(
+                    1, size, body, (kz1, vz1, kz2, vz2, *st_lo, *st_hi)
+                )[4:]
+            else:
+                def body(r, carry):
+                    kz, vz = carry[:2]
+                    st = round_folds(r, kz, vz, carry[2:])
+                    kz = jax.lax.ppermute(kz, name, perm)
+                    vz = jax.lax.ppermute(vz, name, perm)
+                    return (kz, vz, *st)
+
+                kz1 = jax.lax.ppermute(kz, name, perm)
+                vz1 = jax.lax.ppermute(vz, name, perm)
+                out_st = jax.lax.fori_loop(
+                    1, size, body, (kz1, vz1, *st_lo, *st_hi)
+                )[2:]
+            m_lo, n_lo, d_lo, m_hi, n_hi, d_hi = out_st
             out_lo = n_lo / jnp.maximum(d_lo, 1e-30)[..., None]
             out_hi = n_hi / jnp.maximum(d_hi, 1e-30)[..., None]
             out = zigzag_merge(out_lo, out_hi, 2, name, size)  # (B, H, L, D)
@@ -456,7 +548,7 @@ def ring_attention(
 
     if zigzag:
         key = ("ring_attention.xla_zz", comm, B, S, H, D, str(q.dtype))
-        out = jitted(key, make_xla_zigzag)(q, k, v)
+        out = run_ring(jitted(key, make_xla_zigzag))
         return out if batched else out[0]
 
     def make_xla():
@@ -473,24 +565,43 @@ def ring_attention(
             num0 = pcast(jnp.zeros((B, H, L, D), acc_dt), (name,), to="varying")
             den0 = pcast(jnp.zeros((B, H, L), acc_dt), (name,), to="varying")
 
-            def body(r, carry):
-                kb, vb, m, num, den = carry
+            def fold(r, kb, vb, m, num, den):
                 origin = (my - r) % size  # this kv block's home shard
                 k_pos = origin * L + jnp.arange(L)
                 kbt = jnp.moveaxis(kb, 2, 1)
                 vbt = jnp.moveaxis(vb, 2, 1)
                 mask = (q_pos[:, None] >= k_pos[None, :]) if causal else None
-                m, num, den = _blockwise_update(
+                return _blockwise_update(
                     qb, kbt, vbt, m, num, den, scale,
                     mask=None if mask is None else mask[None, None],
                 )
-                kb = jax.lax.ppermute(kb, name, perm)
-                vb = jax.lax.ppermute(vb, name, perm)
-                return kb, vb, m, num, den
 
-            _, _, m, num, den = jax.lax.fori_loop(
-                0, size, body, (k_blk, v_blk, m0, num0, den0)
-            )
+            if overlapped:
+                # double-buffered: same ppermute chain, same fold order
+                # as the serial arm — bitwise equal (design.md §18)
+                def body(r, carry):
+                    kc, vc, ki, vi, m, num, den = carry
+                    kn = jax.lax.ppermute(ki, name, perm)
+                    vn = jax.lax.ppermute(vi, name, perm)
+                    m, num, den = fold(r, kc, vc, m, num, den)
+                    return ki, vi, kn, vn, m, num, den
+
+                ki0 = jax.lax.ppermute(k_blk, name, perm)
+                vi0 = jax.lax.ppermute(v_blk, name, perm)
+                _, _, _, _, m, num, den = jax.lax.fori_loop(
+                    0, size, body, (k_blk, v_blk, ki0, vi0, m0, num0, den0)
+                )
+            else:
+                def body(r, carry):
+                    kb, vb, m, num, den = carry
+                    m, num, den = fold(r, kb, vb, m, num, den)
+                    kb = jax.lax.ppermute(kb, name, perm)
+                    vb = jax.lax.ppermute(vb, name, perm)
+                    return kb, vb, m, num, den
+
+                _, _, m, num, den = jax.lax.fori_loop(
+                    0, size, body, (k_blk, v_blk, m0, num0, den0)
+                )
             out = num / jnp.maximum(den, 1e-30)[..., None]  # (B, H, L, D)
             return jnp.moveaxis(out, 1, 2).astype(q_blk.dtype)  # (B, L, H, D)
 
@@ -499,7 +610,7 @@ def ring_attention(
         )
 
     key = ("ring_attention.xla", comm, causal, B, S, H, D, str(q.dtype))
-    out = jitted(key, make_xla)(q, k, v)
+    out = run_ring(jitted(key, make_xla))
     return out if batched else out[0]
 
 

@@ -155,6 +155,67 @@ then
     echo "FAILED serve chaos scenario (reproduce with HEAT_CHAOS_SEED=${HEAT_CHAOS_SEED:-0})"
     fail=1
 fi
+# overlap lane: the latency-hiding policy (docs/design.md §18) — every
+# double-buffered ring against its same-run serial twin at byte
+# granularity, then the compressed + redistribution suites re-run with
+# the policy forced "on" process-wide: the whole tree must be
+# schedule-agnostic, not just the dedicated parity tests
+echo "=== overlap lane (double-buffered rings vs serial twins, bitwise) ==="
+if ! python -m pytest tests/test_overlap.py -q; then
+    echo "FAILED overlap twin parity"
+    fail=1
+fi
+if ! python - <<'PY'
+import os
+n = os.environ.get("HEAT_TEST_DEVICES", "8")
+flag = f"--xla_force_host_platform_device_count={n}"
+if flag not in os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") + " " + flag).strip()
+
+from heat_tpu.comm.overlap import set_overlap
+
+set_overlap("on")  # force the double-buffered schedule for the whole run
+import sys
+
+import pytest
+
+raise SystemExit(pytest.main([
+    "tests/test_compressed_collectives.py", "tests/test_redistribute.py",
+    "-q", "-p", "no:cacheprovider",
+]))
+PY
+then
+    echo "FAILED overlap lane (suite under set_overlap('on'))"
+    fail=1
+fi
+# fresh overlap-efficiency headline, archived beside the telemetry
+# artifacts: on CPU the roofline is not modeled (value null, disposition
+# recorded) but the serial-twin bitwise gate still runs for real
+if ! HEAT_BENCH_SMOKE=1 python - <<'PY'
+import json
+import os
+
+import numpy as np
+
+import heat_tpu as ht
+import bench
+
+X = ht.array(np.random.default_rng(0).normal(
+    size=(64 * ht.get_comm().size, 8)).astype(np.float32), split=0)
+value, ratios, model = bench.overlap_efficiency_rates(X)
+art = os.environ.get("HEAT_TELEMETRY_ARTIFACT_DIR", "/tmp/heat-telemetry-artifacts")
+os.makedirs(art, exist_ok=True)
+path = os.path.join(art, "overlap-headline.json")
+with open(path, "w") as fh:
+    json.dump({"ring_overlap_efficiency": value, "overlap_vs_serial": ratios,
+               "ring_overlap_model": model}, fh, indent=1)
+assert all(f["bitwise_equal"] for f in model["families"].values()), model
+print("overlap headline artifact:", path)
+PY
+then
+    echo "FAILED overlap headline (bench smoke / twin parity)"
+    fail=1
+fi
 for n in "${sizes[@]}"; do
     echo "=== mesh size $n ==="
     if ! HEAT_TEST_DEVICES="$n" python -m pytest tests/ -q -x; then

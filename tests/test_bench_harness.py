@@ -168,6 +168,7 @@ def _fake_full_result():
         "attention_tokens_per_sec": 3400000.0,
         "causal_attention_tokens_per_sec": 3700000.0,
         "causal_attention_f32_tokens_per_sec": 620000.0,
+        "ring_overlap_efficiency": 0.87,
         "spread_pct": {k: 12.3 for k in bench._HEADLINE},
         "golden": {
             "health": {

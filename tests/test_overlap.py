@@ -1,0 +1,428 @@
+"""Latency-hiding policy (PR 11): every double-buffered ring vs its
+same-run serial twin.
+
+The contract under test is the one docs/design.md §18 states: flipping
+``ht.comm.set_overlap`` between ``"on"`` and ``"off"`` changes the ring
+*schedule* (when ppermutes are issued relative to the folds), never the
+*algebra* (which operands are folded, in which order).  For every
+converted family that makes the overlapped ring bitwise equal to the
+serial one:
+
+- ring attention (all engines, zig-zag causal AND the non-divisible-S
+  contiguous fallback) — same ppermute chain, same `_blockwise_update`
+  calls on the same operands;
+- ``ring_map`` — distance-2 double buffer, identical fold order;
+- the compressed rings (``allreduce_q`` / ``allgather_q``) — the
+  two-stream split re-quantizes per 128-row block, and int8 block
+  quantization is row-independent, so even the int8_block codec is
+  bitwise;
+- planned redistribution — `_ship` start/send/finish pipelining moves
+  the same pieces through the same adds.
+
+Error feedback rides on the same guarantee: the residual carry is a pure
+function of (input, quantization), so an EF iteration *sequence* — and a
+mid-stream policy flip — must be bitwise reproducible.
+
+Policy plumbing asserted alongside: mode validation, context-manager
+restore, the compile-cache token (serial twin and overlapped ring
+coexist as separate cache entries, one dispatch each), and the
+``comm.overlap_ratio`` / ``comm:<ring>:step`` telemetry with its
+zero-overhead-when-disabled contract.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import heat_tpu as ht
+from heat_tpu import telemetry
+from heat_tpu.comm import compressed as cq
+from heat_tpu.comm import redistribute as rd
+from heat_tpu.comm.overlap import (
+    get_overlap,
+    overlap,
+    overlap_enabled,
+    set_overlap,
+)
+from heat_tpu.core import _tracing
+from heat_tpu.core.communication import XlaCommunication
+from heat_tpu.parallel import ring_map
+
+RNG = np.random.default_rng(29)
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _release_compiled_programs():
+    """This module deliberately compiles every ring family twice per mesh
+    size (the serial twin AND the overlapped body are distinct cache
+    entries by design) — ~150 extra executables.  Release them when the
+    module finishes: holding that much extra JIT-compiled code alive for
+    the rest of a full-suite run pushes the process-wide native ceiling
+    (observed as an XLA segfault compiling an unrelated program hundreds
+    of tests later).  Later modules simply retrace on first use."""
+    yield
+    from heat_tpu.core import _compile
+
+    _compile.clear_cache()
+    jax.clear_caches()
+
+
+def _sub_comm(k):
+    devs = jax.devices()
+    if len(devs) < k:
+        pytest.skip(f"needs {k} devices")
+    return XlaCommunication(devs[:k])
+
+
+def _committed(comm, data, split):
+    with rd.redistribution("monolithic"):
+        return comm.commit_split(jnp.asarray(data), split)
+
+
+def _bitwise(got, ref, what):
+    got, ref = np.asarray(got), np.asarray(ref)
+    assert got.dtype == ref.dtype and got.shape == ref.shape
+    np.testing.assert_array_equal(got, ref, err_msg=f"{what}: overlap twin diverged")
+
+
+# --------------------------------------------------------------------- #
+# policy surface                                                        #
+# --------------------------------------------------------------------- #
+
+def test_policy_validation_and_restore():
+    prev = get_overlap()
+    with pytest.raises(ValueError, match="on.*off.*auto"):
+        set_overlap("bogus")
+    assert get_overlap() == prev  # failed set leaves the policy alone
+    with overlap("on"):
+        assert get_overlap() == "on"
+        with overlap("off"):
+            assert get_overlap() == "off"
+        assert get_overlap() == "on"
+    assert get_overlap() == prev
+
+
+def test_overlap_enabled_semantics():
+    with overlap("off"):
+        assert not overlap_enabled(8)
+    with overlap("on"):
+        assert overlap_enabled(2) and overlap_enabled(8)
+        # a size-1 "ring" has no wire to hide
+        assert not overlap_enabled(1)
+    with overlap("auto"):
+        assert overlap_enabled(8) == (jax.default_backend() == "tpu")
+
+
+def test_policy_rekeys_compiled_programs():
+    """The cache token: the serial twin and the overlapped ring live as
+    distinct compiled entries, each reused (one dispatch) on repeat."""
+    comm = _sub_comm(4)
+    x = jnp.asarray(RNG.normal(size=(4, 4096)).astype(np.float32))
+    for mode in ("on", "off", "on", "off"):  # revisits must hit the cache
+        with overlap(mode):
+            cq.allreduce_q(x, comm=comm, precision="int8_block")  # warm
+            _tracing.reset_dispatch_count()
+            cq.allreduce_q(x, comm=comm, precision="int8_block")
+            assert _tracing.dispatch_count() == 1, f"retrace under {mode!r}"
+
+
+# --------------------------------------------------------------------- #
+# ring attention                                                        #
+# --------------------------------------------------------------------- #
+
+def _attn_pair(comm, S, H, D, **kw):
+    q, k, v = (RNG.normal(size=(S, H, D)).astype(np.float32) for _ in range(3))
+    qs, ks, vs = (comm.apply_sharding(jnp.asarray(x), 0) for x in (q, k, v))
+    with overlap("off"):
+        ref = ht.parallel.ring_attention(qs, ks, vs, comm=comm, **kw)
+    with overlap("on"):
+        got = ht.parallel.ring_attention(qs, ks, vs, comm=comm, **kw)
+    return got, ref
+
+
+@pytest.mark.parametrize("mesh_size", [1, 2, 4, 8])
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_attention_overlap_bitwise(mesh_size, causal):
+    comm = _sub_comm(mesh_size)
+    # S = 8*size: divisible by 2*size, so causal takes the zig-zag ring
+    got, ref = _attn_pair(comm, 8 * mesh_size, 2, 16, causal=causal)
+    _bitwise(got, ref, f"ring_attention causal={causal} p={mesh_size}")
+
+
+@pytest.mark.parametrize("mesh_size", [2, 4])
+def test_ring_attention_flash_overlap_bitwise(mesh_size):
+    comm = _sub_comm(mesh_size)
+    # Lh = S/(2*size) = 128 so the flash engine conforms
+    got, ref = _attn_pair(
+        comm, 256 * mesh_size, 2, 16, causal=True, local_kernel="flash"
+    )
+    _bitwise(got, ref, f"zig-zag flash p={mesh_size}")
+
+
+@pytest.mark.parametrize("mesh_size", [2, 4, 8])
+def test_ring_attention_nondivisible_zigzag_fallback(mesh_size):
+    # S % size == 0 but S % (2*size) != 0: causal keeps the CONTIGUOUS
+    # ring (no zig-zag), which has its own overlapped warm-up arm
+    comm = _sub_comm(mesh_size)
+    S = mesh_size * 5
+    got, ref = _attn_pair(comm, S, 2, 8, causal=True)
+    _bitwise(got, ref, f"contiguous causal S={S} p={mesh_size}")
+
+
+@pytest.mark.parametrize("mesh_size", [2, 8])
+def test_ring_attention_batched_overlap_bitwise(mesh_size):
+    comm = _sub_comm(mesh_size)
+    B, S, H, D = 2, 4 * mesh_size, 2, 8
+    q, k, v = (
+        RNG.normal(size=(B, S, H, D)).astype(np.float32) for _ in range(3)
+    )
+    qs, ks, vs = (comm.apply_sharding(jnp.asarray(x), 1) for x in (q, k, v))
+    with overlap("off"):
+        ref = ht.parallel.ring_attention(qs, ks, vs, causal=True, comm=comm)
+    with overlap("on"):
+        got = ht.parallel.ring_attention(qs, ks, vs, causal=True, comm=comm)
+    _bitwise(got, ref, f"batched causal p={mesh_size}")
+
+
+# --------------------------------------------------------------------- #
+# ring_map                                                              #
+# --------------------------------------------------------------------- #
+
+@pytest.mark.parametrize("mesh_size", [1, 2, 4, 8])
+def test_ring_map_overlap_bitwise(mesh_size):
+    comm = _sub_comm(mesh_size)
+    data = RNG.normal(size=(mesh_size * 3, 6)).astype(np.float32)
+    x = comm.apply_sharding(jnp.asarray(data), 0)
+    fn = lambda stat, rot, r: stat @ rot.T + jnp.float32(r)
+    with overlap("off"):
+        ref = ring_map(fn, x, comm=comm)
+    with overlap("on"):
+        got = ring_map(fn, x, comm=comm)
+    _bitwise(got, ref, f"ring_map p={mesh_size}")
+
+
+# --------------------------------------------------------------------- #
+# compressed rings                                                      #
+# --------------------------------------------------------------------- #
+
+@pytest.mark.parametrize("mesh_size", [1, 2, 4, 8])
+@pytest.mark.parametrize("mode", ["int8_block", "bf16"])
+def test_allreduce_q_overlap_bitwise(mesh_size, mode):
+    comm = _sub_comm(mesh_size)
+    # 4096 elements => per-device chunk >= 2 blocks on every mesh size,
+    # so the two-stream body actually engages
+    x = jnp.asarray(RNG.normal(size=(mesh_size, 4096)).astype(np.float32))
+    with overlap("off"):
+        ref = cq.allreduce_q(x, comm=comm, precision=mode)
+    with overlap("on"):
+        got = cq.allreduce_q(x, comm=comm, precision=mode)
+    _bitwise(got, ref, f"allreduce_q[{mode}] p={mesh_size}")
+
+
+@pytest.mark.parametrize("mesh_size", [2, 8])
+def test_allreduce_q_small_payload_stays_serial_and_bitwise(mesh_size):
+    # below 2 blocks/chunk the gate keeps the serial body under "on":
+    # still one dispatch, still bitwise
+    comm = _sub_comm(mesh_size)
+    x = jnp.asarray(RNG.normal(size=(mesh_size, 40)).astype(np.float32))
+    with overlap("off"):
+        ref = cq.allreduce_q(x, comm=comm, precision="int8_block")
+    with overlap("on"):
+        got = cq.allreduce_q(x, comm=comm, precision="int8_block")
+    _bitwise(got, ref, f"small allreduce_q p={mesh_size}")
+
+
+@pytest.mark.parametrize("mesh_size", [1, 2, 4, 8])
+@pytest.mark.parametrize("mode", ["int8_block", "bf16"])
+def test_allgather_q_overlap_bitwise(mesh_size, mode):
+    comm = _sub_comm(mesh_size)
+    data = RNG.normal(size=(mesh_size * 70, 8)).astype(np.float32)
+    x = comm.apply_sharding(jnp.asarray(data), 0)
+    with overlap("off"):
+        ref = cq.allgather_q(x, axis=0, comm=comm, precision=mode)
+    with overlap("on"):
+        got = cq.allgather_q(x, axis=0, comm=comm, precision=mode)
+    _bitwise(got, ref, f"allgather_q[{mode}] p={mesh_size}")
+
+
+@pytest.mark.parametrize("mesh_size", [2, 8])
+def test_error_feedback_sequence_bitwise_under_overlap(mesh_size):
+    """EF residual carry: the whole (reduced, error) iteration sequence
+    is bitwise identical under the two schedules."""
+    comm = _sub_comm(mesh_size)
+    x = jnp.asarray(RNG.normal(size=(mesh_size, 4096)).astype(np.float32))
+
+    def run(mode, steps=4):
+        outs = []
+        err = jnp.zeros_like(x)
+        with overlap(mode):
+            for _ in range(steps):
+                red, err = cq.allreduce_q(
+                    x, comm=comm, precision="int8_block", error=err
+                )
+                outs.append(np.asarray(red))
+        return outs, np.asarray(err)
+
+    outs_on, err_on = run("on")
+    outs_off, err_off = run("off")
+    for i, (a, b) in enumerate(zip(outs_on, outs_off)):
+        np.testing.assert_array_equal(a, b, err_msg=f"EF step {i}")
+    np.testing.assert_array_equal(err_on, err_off, err_msg="EF residual")
+
+
+@pytest.mark.parametrize("mesh_size", [2, 4])
+def test_error_feedback_resumes_bitwise_across_policy_flip(mesh_size):
+    """A checkpoint-resume that flips the overlap policy mid-stream must
+    continue the exact serial trajectory — the residual is schedule-
+    independent, so restoring it under the other policy is lossless."""
+    comm = _sub_comm(mesh_size)
+    x = jnp.asarray(RNG.normal(size=(mesh_size, 4096)).astype(np.float32))
+
+    def step(err, mode):
+        with overlap(mode):
+            return cq.allreduce_q(
+                x, comm=comm, precision="int8_block", error=err
+            )
+
+    err_ref = jnp.zeros_like(x)
+    refs = []
+    for _ in range(4):
+        red, err_ref = step(err_ref, "off")
+        refs.append(np.asarray(red))
+
+    # serial for 2 steps, "resume from checkpoint" overlapped for 2 more
+    err = jnp.zeros_like(x)
+    for _ in range(2):
+        _, err = step(err, "off")
+    err = jnp.asarray(np.asarray(err))  # round-trip: the checkpoint
+    for i in (2, 3):
+        red, err = step(err, "on")
+        np.testing.assert_array_equal(
+            np.asarray(red), refs[i], err_msg=f"resumed EF step {i}"
+        )
+    np.testing.assert_array_equal(np.asarray(err), np.asarray(err_ref))
+
+
+# --------------------------------------------------------------------- #
+# planned redistribution                                                #
+# --------------------------------------------------------------------- #
+
+@pytest.mark.parametrize("mesh_size", [1, 2, 4, 8])
+@pytest.mark.parametrize("src,dst", [(0, 1), (1, 0)])
+def test_planned_resplit_overlap_bitwise(mesh_size, src, dst):
+    comm = _sub_comm(mesh_size)
+    data = RNG.normal(size=(16, 24)).astype(np.float32)
+    x = _committed(comm, data, src)
+    with rd.redistribution("planned"):
+        with overlap("off"):
+            ref = comm.resplit(x, dst)
+        with overlap("on"):
+            got = comm.resplit(x, dst)
+    assert got.sharding == ref.sharding
+    _bitwise(got, ref, f"planned resplit {src}->{dst} p={mesh_size}")
+    _bitwise(got, data, "resplit vs input")  # and both equal the input
+
+
+@pytest.mark.parametrize("mesh_size", [2, 8])
+def test_planned_alltoall_overlap_bitwise(mesh_size):
+    comm = _sub_comm(mesh_size)
+    data = RNG.normal(size=(mesh_size * 4, mesh_size * 4)).astype(np.float32)
+    x = _committed(comm, data, 0)
+    with rd.redistribution("planned"):
+        with overlap("off"):
+            ref = comm.alltoall(x, send_axis=1, recv_axis=0)
+        with overlap("on"):
+            got = comm.alltoall(x, send_axis=1, recv_axis=0)
+    _bitwise(got, ref, f"planned alltoall p={mesh_size}")
+
+
+def test_planned_resplit_one_dispatch_under_overlap():
+    comm = _sub_comm(4)
+    x = _committed(comm, RNG.normal(size=(16, 8)).astype(np.float32), 0)
+    with rd.redistribution("planned"), overlap("on"):
+        comm.resplit(x, 1)  # warm
+        _tracing.reset_dispatch_count()
+        out = comm.resplit(x, 1)
+        assert _tracing.dispatch_count() == 1
+    jax.block_until_ready(out)
+
+
+# --------------------------------------------------------------------- #
+# telemetry                                                             #
+# --------------------------------------------------------------------- #
+
+def test_overlap_telemetry_gauge_and_span_pairs():
+    comm = _sub_comm(4)
+    x = jnp.asarray(RNG.normal(size=(4, 4096)).astype(np.float32))
+    # warm both cache entries OUTSIDE telemetry so spans time dispatches
+    with overlap("on"):
+        cq.allreduce_q(x, comm=comm, precision="int8_block")
+    with overlap("off"):
+        cq.allreduce_q(x, comm=comm, precision="int8_block")
+    telemetry.enable()
+    try:
+        telemetry.reset()
+        with overlap("on"):
+            cq.allreduce_q(x, comm=comm, precision="int8_block")
+        with overlap("off"):
+            cq.allreduce_q(x, comm=comm, precision="int8_block")
+        snap = telemetry.snapshot()
+    finally:
+        telemetry.disable()
+    assert snap["counters"]["comm.ring.dispatch.overlapped"] == 1
+    assert snap["counters"]["comm.ring.dispatch.serial"] == 1
+    assert snap["gauges"]["comm.overlap_ratio"] == pytest.approx(0.5)
+    for half in ("issue", "consume"):
+        site = f"comm:allreduce_q:step:{half}"
+        assert snap["spans"][site]["count"] == 2, snap["spans"]
+
+
+def test_overlap_telemetry_sites_cover_every_ring_family():
+    comm = _sub_comm(2)
+    x = jnp.asarray(RNG.normal(size=(2, 4096)).astype(np.float32))
+    g = comm.apply_sharding(jnp.asarray(RNG.normal(size=(4, 4)).astype(np.float32)), 0)
+    qkv = comm.apply_sharding(
+        jnp.asarray(RNG.normal(size=(8, 2, 8)).astype(np.float32)), 0
+    )
+    r = _committed(comm, RNG.normal(size=(8, 6)).astype(np.float32), 0)
+    telemetry.enable()
+    try:
+        telemetry.reset()
+        with overlap("on"):
+            cq.allreduce_q(x, comm=comm, precision="int8_block")
+            cq.allgather_q(g, axis=0, comm=comm, precision="int8_block")
+            ht.parallel.ring_attention(qkv, qkv, qkv, comm=comm)
+            ring_map(lambda s, rot, k: rot.sum(), r, comm=comm)
+            with rd.redistribution("planned"):
+                comm.resplit(r, 1)
+        snap = telemetry.snapshot()
+    finally:
+        telemetry.disable()
+    for ring in ("allreduce_q", "allgather_q", "ring_attention", "ring_map",
+                 "resplit"):
+        assert f"comm:{ring}:step:issue" in snap["spans"], ring
+        assert f"comm:{ring}:step:consume" in snap["spans"], ring
+    # not all payloads clear their family's overlap gate (the small
+    # allgather stays serial by design) — the gauge is a fraction, not 1.0
+    assert 0.0 < snap["gauges"]["comm.overlap_ratio"] <= 1.0
+
+
+def test_overlap_telemetry_zero_overhead_when_disabled():
+    from heat_tpu.comm.overlap import timed_dispatch
+
+    assert not telemetry.is_enabled()
+    calls = []
+    out = timed_dispatch("probe", True, lambda: calls.append(1) or 41 + 1)
+    assert out == 42 and calls == [1]
+    telemetry.enable()
+    try:
+        telemetry.reset()
+        snap = telemetry.snapshot()  # nothing recorded while disabled
+        assert "comm.ring.dispatch.overlapped" not in snap["counters"]
+        assert not any(s.startswith("comm:probe") for s in snap["spans"])
+    finally:
+        telemetry.disable()

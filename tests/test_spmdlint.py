@@ -900,6 +900,120 @@ def serve_loop(queue, sizes):
 
 
 # --------------------------------------------------------------------- #
+# SPMD209: serialized ring body — same-round ppermute consumption        #
+# --------------------------------------------------------------------- #
+def test_spmd209_triggers_on_ship_then_consume_fori_body():
+    src = """
+import jax
+
+def ring_sum(x, size, name, perm):
+    def body(r, carry):
+        x, acc = carry
+        x = jax.lax.ppermute(x, name, perm)
+        acc = acc + x
+        return x, acc
+    return jax.lax.fori_loop(0, size, body, (x, x * 0.0))
+"""
+    findings = lint(src, "SPMD209")
+    assert len(findings) == 1
+    assert "same" in findings[0].message or "critical path" in findings[0].message
+    assert "double-buffer" in findings[0].hint
+
+
+def test_spmd209_triggers_on_arithmetic_and_call_consumption():
+    src = """
+import jax
+
+def ring_a(x, acc, size, name, perm):
+    def body(r, carry):
+        x, acc = carry
+        acc = acc + jax.lax.ppermute(x, name, perm)
+        return x, acc
+    return jax.lax.fori_loop(0, size, body, (x, acc))
+
+def ring_b(payload, out, size, name, perm, decode):
+    for s in range(size - 1):
+        payload = tuple(jax.lax.ppermute(leaf, name, perm) for leaf in payload)
+        out = decode(payload) + out
+    return out
+"""
+    findings = lint(src, "SPMD209")
+    assert len(findings) == 2
+
+
+def test_spmd209_clean_on_returned_carry_and_double_buffer():
+    src = """
+import jax
+
+def serial_consume_then_ship(x, size, name, perm):
+    # the shipped slab is only the NEXT round's carry — exempt
+    def body(r, carry):
+        rotating, acc = carry
+        acc = acc + rotating
+        rotating = jax.lax.ppermute(rotating, name, perm)
+        return rotating, acc
+    return jax.lax.fori_loop(0, size, body, (x, x * 0.0))
+
+def double_buffered(x, size, name, perm):
+    def body(r, carry):
+        cur, inflight, acc = carry
+        nxt = jax.lax.ppermute(inflight, name, perm)
+        acc = acc + cur
+        return inflight, nxt, acc
+    inflight0 = jax.lax.ppermute(x, name, perm)
+    return jax.lax.fori_loop(0, size, body, (x, inflight0, x * 0.0))
+
+def halo(tail, head, name, fwd, bwd):
+    # consumed immediately, but not in a per-round body
+    prev = jax.lax.ppermute(tail, name, fwd)
+    nxt = jax.lax.ppermute(head, name, bwd)
+    return prev + nxt
+"""
+    assert lint(src, "SPMD209") == []
+
+
+def test_spmd209_clean_when_gated_on_overlap_policy():
+    src = """
+import jax
+from heat_tpu.comm.overlap import overlap, overlap_enabled
+
+def ring(x, size, name, perm, decode):
+    overlapped = overlap_enabled(size)
+    if overlapped:
+        x = jax.lax.ppermute(x, name, perm)
+    else:
+        # serial twin of the policy's overlapped arm — deliberate
+        for s in range(size - 1):
+            x = jax.lax.ppermute(x, name, perm)
+            x = decode(x)
+    return x
+
+def ring_with(x, size, name, perm, decode):
+    with overlap("off"):
+        for s in range(size - 1):
+            x = jax.lax.ppermute(x, name, perm)
+            x = decode(x)
+    return x
+"""
+    assert lint(src, "SPMD209") == []
+
+
+def test_spmd209_suppression_comment_silences():
+    src = """
+import jax
+
+def ring(x, size, name, perm):
+    def body(r, carry):
+        x, acc = carry
+        x = jax.lax.ppermute(x, name, perm)  # spmdlint: disable=SPMD209
+        acc = acc + x
+        return x, acc
+    return jax.lax.fori_loop(0, size, body, (x, x * 0.0))
+"""
+    assert lint(src, "SPMD209") == []
+
+
+# --------------------------------------------------------------------- #
 # SPMD301/302: Pallas tiling and grids                                   #
 # --------------------------------------------------------------------- #
 def test_spmd301_triggers_on_off_tile_blocks():
@@ -1061,8 +1175,8 @@ def test_baseline_fingerprint_is_line_insensitive():
 def test_every_rule_is_registered():
     assert [r.id for r in all_rules()] == [
         "SPMD001", "SPMD101", "SPMD102", "SPMD201", "SPMD202", "SPMD203",
-        "SPMD204", "SPMD205", "SPMD206", "SPMD207", "SPMD208", "SPMD301",
-        "SPMD302",
+        "SPMD204", "SPMD205", "SPMD206", "SPMD207", "SPMD208", "SPMD209",
+        "SPMD301", "SPMD302",
         "SPMD401", "SPMD501", "SPMD502", "SPMD503", "SPMD504",
     ]
 
