@@ -1639,6 +1639,29 @@ def serve_rates(data):
         ),
         "direct_bitwise_equal": bool(twin["bitwise_equal"]),
     }
+    # PR-12 obs twin: the SAME warm engine re-driven with full request-
+    # scoped observability on — telemetry collection, trace-id tagging,
+    # latency histograms, an attached SLO monitor, the flight recorder —
+    # on the identical seeded schedules.  The p99 ratio is the overhead
+    # contract (docs/design.md §19: full obs within ~5% of the obs-off
+    # twin); the headline serve_p99_ms above stays the obs-off number.
+    from heat_tpu import telemetry
+    from heat_tpu.telemetry import SloMonitor
+
+    was_enabled = telemetry.is_enabled()
+    telemetry.enable()
+    eng.slo = SloMonitor("bench.serve", target_ms=1e9)  # never burns
+    obs_reports = [
+        loadgen.run(eng, "bench", "km", seed=s + 1, n_requests=n_req,
+                    twin=False)
+        for s in range(runs)
+    ]
+    eng.slo = None
+    if not was_enabled:
+        telemetry.disable()
+    p99_obs, _ = _summary([r.p99_ms for r in obs_reports])
+    model["obs_p99_ms"] = round(p99_obs, 3)
+    model["obs_overhead_p99"] = round(p99_obs / p99, 3) if p99 else None
     eng.close()
     return (pps, pps_spread), (p99, p99_spread), twin, model
 

@@ -58,6 +58,7 @@ from . import regression
 from . import resilience
 from . import spatial
 from . import telemetry
+from . import obs
 from . import utils
 from . import datasets
 from . import serve

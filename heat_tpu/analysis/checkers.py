@@ -1411,6 +1411,73 @@ def check_serialized_ring_body(ctx: FileContext) -> Iterable[Finding]:
 
 
 # --------------------------------------------------------------------- #
+# SPMD210: request-scoped observability inside traced functions          #
+# --------------------------------------------------------------------- #
+#: dotted-suffix forms of the request-scoped observability entry points
+#: (`heat_tpu.telemetry`, the `heat_tpu.obs` facade, and the internal
+#: `from ..telemetry import _core` spelling) — context managers and
+#: calls that run at TRACE time inside a traced body
+_OBS_CTX_SUFFIXES = (
+    "telemetry.trace_ctx", "telemetry._core.trace_ctx", "obs.trace_ctx",
+)
+_OBS_CALL_SUFFIXES = (
+    "telemetry.observe", "telemetry._core.observe", "obs.observe",
+)
+_OBS_FLIGHT_SUFFIXES = ("flight.note",)
+
+
+def _obs_match(dotted: str, suffixes) -> bool:
+    return any(dotted == s or dotted.endswith("." + s) for s in suffixes)
+
+
+@rule("SPMD210", "request-scoped observability inside traced functions records trace time, not run time")
+def check_traced_observability(ctx: FileContext) -> Iterable[Finding]:
+    """The SPMD205 argument, extended to the observability layer: a
+    ``telemetry.trace_ctx`` entered, a ``telemetry.observe`` recorded, or
+    a ``flight.note`` appended inside a jit/shard_map/fuse-traced body
+    runs ONCE, at trace time, against abstract tracers.  The trace
+    context is set and torn down before the compiled program ever
+    executes (no run-time event can carry the ids); the observation
+    lands a single trace-time value (often a tracer's ``str()``) in the
+    histogram instead of per-execution samples; the flight note records
+    the *tracing* of the program, not its launches.  All three belong at
+    the HOST call site — around the jitted/fused call, where the serve
+    engine places them."""
+    for node in ast.walk(ctx.tree):
+        if not (isinstance(node, ast.Call) and ctx.in_traced_context(node)):
+            continue
+        dotted = ctx.resolve(node.func)
+        if dotted is None:
+            continue
+        if _obs_match(dotted, _OBS_CTX_SUFFIXES):
+            yield ctx.finding(
+                "SPMD210", node,
+                "telemetry.trace_ctx entered inside a traced function",
+                hint="the context is installed and reset during TRACING — "
+                "compiled executions carry no request ids; wrap the host "
+                "call site instead (the serve engine re-establishes the "
+                "context per micro-batch around its fused predict call)",
+            )
+        elif _obs_match(dotted, _OBS_CALL_SUFFIXES):
+            yield ctx.finding(
+                "SPMD210", node,
+                "telemetry.observe recorded inside a traced function",
+                hint="the histogram receives ONE trace-time observation "
+                "(possibly of a tracer), not per-execution samples; "
+                "observe the measured value at the host call site after "
+                "block_until_ready",
+            )
+        elif _obs_match(dotted, _OBS_FLIGHT_SUFFIXES):
+            yield ctx.finding(
+                "SPMD210", node,
+                "flight-recorder note inside a traced function",
+                hint="the note records the one-time tracing, not the "
+                "compiled executions; note at the host call site, or rely "
+                "on the _emit mirror for enabled-telemetry events",
+            )
+
+
+# --------------------------------------------------------------------- #
 # SPMD301/302: Pallas tiling and grids                                   #
 # --------------------------------------------------------------------- #
 @rule("SPMD301", "Pallas BlockSpec tiles must respect the hardware tile grid")

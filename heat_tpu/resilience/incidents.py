@@ -7,6 +7,13 @@ operator (or a test) can assert on after the fact.  The log is
 process-wide and append-only between explicit :func:`clear_incident_log`
 calls; it never touches the device, so recording is free relative to the
 collectives it describes.
+
+Every recorded incident also triggers the always-on flight recorder
+(:mod:`heat_tpu.telemetry.flight`): the incident lands on the bounded
+event ring and a deterministic postmortem JSON is dumped (to
+``HEAT_FLIGHT_DIR`` when set, retained in memory otherwise) — so even a
+process that never enabled telemetry leaves an incident-adjacent
+artifact behind.
 """
 
 from __future__ import annotations
@@ -16,6 +23,7 @@ from dataclasses import dataclass, field
 from typing import List, Tuple
 
 from ..telemetry import _core as _telemetry
+from ..telemetry import flight as _flight
 
 __all__ = ["Incident", "record", "incident_log", "clear_incident_log"]
 
@@ -63,7 +71,9 @@ def record(kind: str, site: str, policy: str, action: str, detail: str = "") -> 
     With telemetry enabled the incident is also published on the event
     stream (type ``"incident"``) and counted under
     ``resilience.incidents`` / ``resilience.incidents.<action>`` — the
-    resilience log doubles as a telemetry event source."""
+    resilience log doubles as a telemetry event source.  Regardless of
+    the telemetry flag, the flight recorder notes the incident and dumps
+    a postmortem (see module docs)."""
     inc = Incident(
         seq=next(_SEQ),
         kind=kind,
@@ -86,6 +96,9 @@ def record(kind: str, site: str, policy: str, action: str, detail: str = "") -> 
             detail=detail,
             seq=inc.seq,
         )
+    # always-on: ring note (skipped when the event above already reached
+    # the ring via the _emit mirror) + deterministic postmortem dump
+    _flight.on_incident(inc, already_streamed=_telemetry.enabled)
     return inc
 
 

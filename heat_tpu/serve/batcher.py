@@ -126,13 +126,22 @@ class StagingPool:
 
 @dataclass
 class Request:
-    """One queued predict request (engine-internal bookkeeping)."""
+    """One queued predict request (engine-internal bookkeeping).
+
+    ``trace_id`` is the request-scoped observability handle: it rides
+    the queue with the payload (contextvars do not cross the worker
+    thread, so the id must travel on the request itself), and the engine
+    re-establishes ``telemetry.trace_ctx`` from the batch's ids around
+    execution — that is how the ``serve:batch`` span, the Perfetto
+    events, and the flight ring all get tagged with the requests of the
+    micro-batch they belong to."""
 
     seq: int
     payload: np.ndarray
     future: Future = field(default_factory=Future)
     t_submit: float = field(default_factory=time.monotonic)
     healthy: bool = True
+    trace_id: str = ""
 
     @property
     def rows(self) -> int:
@@ -175,23 +184,48 @@ class MicroBatcher:
     def queue_depth(self) -> int:
         return len(self._queue)
 
-    def submit(self, payload: np.ndarray, *, healthy: bool = True) -> Future:
+    def submit(
+        self,
+        payload: np.ndarray,
+        *,
+        healthy: bool = True,
+        trace_id: Optional[str] = None,
+    ) -> Future:
         """Enqueue one request; the future resolves to the engine's Reply
-        when a flush processes the batch it lands in."""
+        when a flush processes the batch it lands in.
+
+        ``trace_id`` names the request for end-to-end tracing; when the
+        caller supplies none (or an ambient :func:`telemetry.trace_ctx`
+        carries none), the batcher mints ``"<lane>#<seq>"`` so every
+        request is traceable even from uninstrumented clients."""
         if payload.ndim != 2:
             raise ValueError(
                 f"payload must be 2-D (rows, features), got {payload.ndim}-D"
             )
         if payload.shape[0] < 1:
             raise ValueError("payload needs at least one row")
+        if trace_id is None:
+            ambient = _tel.current_trace()
+            trace_id = ambient[-1] if ambient else None
         with self._cond:
             if self._closed:
                 raise RuntimeError(f"MicroBatcher {self.name!r} is closed")
             self._seq += 1
-            req = Request(seq=self._seq, payload=payload, healthy=healthy)
+            rid = trace_id if trace_id is not None else f"{self.name}#{self._seq}"
+            req = Request(
+                seq=self._seq, payload=payload, healthy=healthy, trace_id=rid
+            )
+            if _tel.is_deterministic():
+                # deterministic mode: latency math must be replayable, so
+                # submit times come from the sequence clock too
+                req.t_submit = _tel.clock()
             self._queue.append(req)
             if _tel.enabled:
                 _tel.gauge(f"{self.name}.queue_depth", len(self._queue))
+                _tel.record_event(
+                    "serve.enqueue", site=self.name, rid=[rid],
+                    rows=req.rows, healthy=healthy,
+                )
             self._cond.notify_all()
         return req.future
 

@@ -33,6 +33,18 @@ Telemetry: ``serve:*`` spans around batch execution and registry
 traffic, ``serve.queue_depth`` / ``serve.batch_occupancy`` gauges, and
 ``serve.requests`` / ``serve.batches`` / ``serve.rows`` /
 ``serve.degraded`` counters.
+
+Request-scoped observability (docs/design.md §19): every request gets a
+``trace_id`` (caller-supplied ``request_id`` or a minted
+``<lane>#<seq>``), the engine re-establishes ``telemetry.trace_ctx``
+with the batch's ids around execution — so the ``serve:batch`` span,
+its Perfetto record, and the flight-recorder ring all say *which*
+requests the micro-batch served — and the id comes back on the
+:class:`Reply`.  Per-request latencies stream into the
+``serve.latency_ms`` histogram (``telemetry.observe``), feed the
+optional :class:`~heat_tpu.telemetry.slo.SloMonitor`, and
+:meth:`ServeEngine.start_metrics_server` exposes it all on a
+loopback-only ``/metrics``/``/healthz``/``/varz`` endpoint.
 """
 
 from __future__ import annotations
@@ -54,6 +66,8 @@ from ..resilience import faults as _faults
 from ..resilience import guards as _guards
 from ..resilience import incidents as _incidents
 from ..telemetry import _core as _tel
+from ..telemetry import flight as _flight
+from ..telemetry.httpz import MetricsServer
 from .batcher import MicroBatcher, Request, StagingPool, bucket_rows, pad_batch
 from .registry import ModelRegistry, RegistryError
 
@@ -63,12 +77,16 @@ __all__ = ["Reply", "ServeEngine"]
 @dataclass
 class Reply:
     """One request's outcome: the per-row prediction values (host numpy,
-    exactly the request's rows), the degrade flag, and bookkeeping."""
+    exactly the request's rows), the degrade flag, and bookkeeping.
+    ``trace_id`` is the request's observability handle — grep it in the
+    event stream / Perfetto export / flight postmortem to walk this
+    request's path through the engine."""
 
     value: np.ndarray
     degraded: bool
     seq: int
     latency_s: float
+    trace_id: str = ""
 
 
 def _payload_healthy(payload: np.ndarray) -> bool:
@@ -161,6 +179,8 @@ class ServeEngine:
     donate : bool — reuse one persistent host staging buffer per bucket
         (zero allocations per batch in steady state).
     method : str — the estimator method lanes serve (default "predict").
+    slo : SloMonitor | None — when given, every reply's latency feeds
+        the monitor (burn-rate gauges + ``slo-burn`` incident on burn).
     """
 
     def __init__(
@@ -173,6 +193,7 @@ class ServeEngine:
         split="auto",
         donate: bool = True,
         method: str = "predict",
+        slo=None,
     ):
         if split not in (None, 0, "auto"):
             raise ValueError(f'split must be None, 0 or "auto", got {split!r}')
@@ -183,6 +204,8 @@ class ServeEngine:
         self.split = split
         self.donate = bool(donate)
         self.method = method
+        self.slo = slo
+        self._metrics: Optional[MetricsServer] = None
         self._staging = StagingPool()
         self._lanes: Dict[Tuple[str, str, int], _Lane] = {}
         self._lock = threading.Lock()
@@ -241,11 +264,18 @@ class ServeEngine:
     # ------------------------------------------------------------------ #
     # request path
     # ------------------------------------------------------------------ #
-    def submit(self, tenant: str, model: str, payload, *, version: Optional[int] = None):
+    def submit(self, tenant: str, model: str, payload, *,
+               version: Optional[int] = None,
+               request_id: Optional[str] = None):
         """Enqueue one predict request; returns a Future resolving to a
         :class:`Reply`.  The payload is screened here: the fault seam
         applies any armed plan, then the health predicate routes the
-        request to the shared batch or the per-request degrade path."""
+        request to the shared batch or the per-request degrade path.
+
+        ``request_id`` names the request for end-to-end tracing (an
+        ambient :func:`telemetry.trace_ctx` id is picked up when none is
+        given; otherwise the lane mints ``<lane>#<seq>``); the id comes
+        back on ``Reply.trace_id``."""
         payload = np.asarray(payload)
         if payload.ndim != 2:
             raise ValueError(
@@ -260,13 +290,15 @@ class ServeEngine:
             _tel.inc("serve.requests")
         self.n_requests += 1
         self.payload_bytes += int(payload.nbytes)
-        return lane.batcher.submit(payload, healthy=healthy)
+        return lane.batcher.submit(payload, healthy=healthy, trace_id=request_id)
 
     def predict(self, tenant: str, model: str, payload, *,
-                version: Optional[int] = None) -> Reply:
+                version: Optional[int] = None,
+                request_id: Optional[str] = None) -> Reply:
         """Synchronous convenience: submit, flush the lane, return the
         Reply (background mode: just waits on the future)."""
-        fut = self.submit(tenant, model, payload, version=version)
+        fut = self.submit(tenant, model, payload, version=version,
+                          request_id=request_id)
         if not self._background:
             self.flush()
         return fut.result()
@@ -308,6 +340,27 @@ class ServeEngine:
             if isinstance(e, (KeyboardInterrupt, SystemExit)):
                 raise
 
+    @staticmethod
+    def _now() -> float:
+        """Reply-latency timestamp source: wall clock normally, the
+        telemetry sequence clock in deterministic mode — so latencies
+        (and the histograms/postmortems they stream into) are replayable
+        under ``enable(deterministic=True)``."""
+        return _tel.clock() if _tel.is_deterministic() else time.monotonic()
+
+    def _reply(self, req: Request, value: np.ndarray, degraded: bool,
+               t_done: float) -> None:
+        """Resolve one request: stream its latency into the
+        ``serve.latency_ms`` histogram and the SLO monitor, then set the
+        future's Reply (carrying the request's trace id back out)."""
+        lat_s = t_done - req.t_submit
+        lat_ms = lat_s * 1e3
+        if _tel.enabled:
+            _tel.observe("serve.latency_ms", lat_ms)
+        if self.slo is not None:
+            self.slo.observe(lat_ms)
+        req.future.set_result(Reply(value, degraded, req.seq, lat_s, req.trace_id))
+
     def _run_batch(self, lane: _Lane, batch: List[Request]) -> None:
         rows = sum(r.rows for r in batch)
         bucket = bucket_rows(rows, min_bucket=self.min_bucket)
@@ -333,12 +386,20 @@ class ServeEngine:
             if _tel.enabled
             else contextlib.nullcontext()
         )
-        with counting_dispatches() as window:
-            x = self._commit(lane, buf, split)
-            with ctx:
-                out = lane.predict(x)
-                host = out.numpy()
-            count = int(window.count)
+        # the micro-batch trace context: every span/event below (the
+        # serve:batch span, nested comm:* spans, Perfetto records, flight
+        # notes) is tagged with ALL coalesced request ids; ids already in
+        # the ambient context (sync flush inside the caller's trace_ctx)
+        # are not repeated
+        ambient = set(_tel.current_trace())
+        with _tel.trace_ctx([r.trace_id for r in batch
+                             if r.trace_id not in ambient]):
+            with counting_dispatches() as window:
+                x = self._commit(lane, buf, split)
+                with ctx:
+                    out = lane.predict(x)
+                    host = out.numpy()
+                count = int(window.count)
         self.n_batches += 1
         self.n_rows += rows
         self.n_padded_rows += bucket
@@ -348,36 +409,41 @@ class ServeEngine:
             _tel.inc("serve.batches")
             _tel.inc("serve.rows", rows)
             _tel.gauge("serve.batch_occupancy", rows / bucket)
-        t_done = time.monotonic()
+        t_done = self._now()
         off = 0
         for req in batch:
             value = np.array(host[off : off + req.rows], copy=True)
             off += req.rows
-            req.future.set_result(
-                Reply(value, False, req.seq, t_done - req.t_submit)
-            )
+            self._reply(req, value, False, t_done)
 
     def _degrade_one(self, lane: _Lane, req: Request) -> None:
         """The per-request degrade path: the poisoned payload runs as its
         own isolated dispatch under ``guard("degrade")`` — whatever its
         values poison, they poison only this reply."""
-        with _guards.guard("degrade"):
-            x = self._commit(lane, np.ascontiguousarray(req.payload), None)
-            value = np.asarray(lane.predict(x).numpy())
-        _incidents.record(
-            "poisoned-payload", lane.site, "degrade", "degraded",
-            detail="request quarantined to an isolated dispatch; "
-            "batch-mates unaffected",
-        )
-        self.n_degraded += 1
-        if _tel.enabled:
-            _tel.inc("serve.degraded")
-            _tel.record_event(
-                "serve.degrade", site=lane.site, seq=req.seq, rows=req.rows
+        with _tel.trace_ctx(
+            () if req.trace_id in _tel.current_trace() else (req.trace_id,)
+        ):
+            with _guards.guard("degrade"):
+                x = self._commit(lane, np.ascontiguousarray(req.payload), None)
+                value = np.asarray(lane.predict(x).numpy())
+            _incidents.record(
+                "poisoned-payload", lane.site, "degrade", "degraded",
+                detail="request quarantined to an isolated dispatch; "
+                "batch-mates unaffected",
             )
-        req.future.set_result(
-            Reply(value, True, req.seq, time.monotonic() - req.t_submit)
-        )
+            self.n_degraded += 1
+            if _tel.enabled:
+                _tel.inc("serve.degraded")
+                _tel.record_event(
+                    "serve.degrade", site=lane.site, seq=req.seq, rows=req.rows
+                )
+            else:
+                # telemetry off: the degrade still leaves flight-ring
+                # context next to the incident (always-on contract)
+                _flight.note(
+                    "serve.degrade", site=lane.site, seq=req.seq, rows=req.rows
+                )
+        self._reply(req, value, True, self._now())
 
     # ------------------------------------------------------------------ #
     # lifecycle / introspection
@@ -399,6 +465,44 @@ class ServeEngine:
             lanes = list(self._lanes.values())
         for lane in lanes:
             lane.batcher.close()
+        if self._metrics is not None:
+            self._metrics.close()
+            self._metrics = None
+
+    # ------------------------------------------------------------------ #
+    # live endpoint
+    # ------------------------------------------------------------------ #
+    def start_metrics_server(self, *, port: int = 0, host: str = "127.0.0.1"):
+        """Bind the loopback-only introspection endpoint for this engine:
+        ``/metrics`` (Prometheus text off the telemetry registry),
+        ``/healthz``, and ``/varz`` (JSON: :meth:`varz`).  Runs on its
+        own daemon thread, entirely off the request path; ``port=0``
+        picks a free port (read it from the returned server's ``.port``).
+        Closed with the engine."""
+        if self._metrics is None:
+            self._metrics = MetricsServer(port=port, host=host, varz=self.varz)
+        return self._metrics
+
+    def varz(self) -> Dict:
+        """The engine's ``/varz`` contribution: aggregate stats, the live
+        lanes, and the SLO burn state when a monitor is attached."""
+        with self._lock:
+            lanes = list(self._lanes.values())
+        doc: Dict = {
+            "serve": self.stats(),
+            "lanes": [
+                {
+                    "tenant": ln.tenant,
+                    "model": ln.model,
+                    "version": ln.version,
+                    "queue_depth": ln.batcher.queue_depth,
+                }
+                for ln in lanes
+            ],
+        }
+        if self.slo is not None:
+            doc["slo"] = self.slo.state()
+        return doc
 
     def stats(self) -> Dict[str, float]:
         """Aggregate serving counters, plus the derived dispatch model:

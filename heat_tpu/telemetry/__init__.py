@@ -15,7 +15,24 @@ One registry for everything the runtime can tell you about itself:
 - **exporters** — ``snapshot()`` (in-memory dict), a JSONL sink
   (``set_jsonl(path)``), and Chrome/Perfetto trace-event JSON
   (``start_trace(path)`` / ``stop_trace()``, optionally interleaved
-  with ``jax.profiler`` device capture).
+  with ``jax.profiler`` device capture);
+- **request tracing** — ``trace_ctx("req-1")`` tags every span and
+  event emitted inside the context with the active request ids
+  (``rid``), which is how a serve request is walked from the loadgen
+  reply through the ``serve:batch`` span into the Perfetto timeline
+  and the flight-recorder postmortem;
+- **streaming histograms & SLOs** — ``observe(name, value)`` feeds a
+  fixed-memory log-bucketed :class:`~heat_tpu.telemetry.hist.Histogram`
+  (quantiles within a documented ~4.4% relative bound, mergeable across
+  threads); :class:`~heat_tpu.telemetry.slo.SloMonitor` turns a latency
+  stream into multi-window burn-rate gauges and a structured incident
+  when the error budget burns;
+- **flight recorder** — :mod:`heat_tpu.telemetry.flight`, an always-on
+  bounded ring of recent events that dumps a deterministic postmortem
+  JSON whenever an incident records;
+- **live endpoint** — :class:`~heat_tpu.telemetry.httpz.MetricsServer`,
+  a loopback-only ``/metrics`` (Prometheus text) + ``/healthz`` +
+  ``/varz`` listener (``ServeEngine.start_metrics_server``).
 
 Disabled (the default) it costs one predicate per instrumented site and
 contributes nothing to compile-cache keys; ``enable(deterministic=True)``
@@ -37,17 +54,26 @@ from ._core import (
     inc,
     is_deterministic,
     is_enabled,
+    current_trace,
+    histogram,
     jsonl_path,
+    observe,
     record_dispatch,
     record_event,
     reset,
     reset_dispatch_count,
     set_clock,
     set_jsonl,
+    set_max_events,
     snapshot,
     span,
+    trace_ctx,
 )
 from .export import start_trace, stop_trace, trace_active
+from .hist import Histogram
+from .slo import SloMonitor
+from . import flight
+from .httpz import MetricsServer, prometheus_text
 
 __all__ = [
     "enable",
@@ -74,6 +100,16 @@ __all__ = [
     "start_trace",
     "stop_trace",
     "trace_active",
+    "trace_ctx",
+    "current_trace",
+    "observe",
+    "histogram",
+    "set_max_events",
+    "Histogram",
+    "SloMonitor",
+    "flight",
+    "MetricsServer",
+    "prometheus_text",
 ]
 
 

@@ -41,12 +41,15 @@ core module can import it without ordering constraints.
 from __future__ import annotations
 
 import contextlib
+import contextvars
 import functools
 import json
 import os
 import threading
 import time
 from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from .hist import Histogram
 
 __all__ = [
     "enabled",
@@ -59,6 +62,8 @@ __all__ = [
     "span",
     "inc",
     "gauge",
+    "observe",
+    "histogram",
     "record_event",
     "account_bytes",
     "events",
@@ -66,6 +71,9 @@ __all__ = [
     "reset",
     "set_jsonl",
     "jsonl_path",
+    "set_max_events",
+    "trace_ctx",
+    "current_trace",
     "record_dispatch",
     "dispatch_count",
     "reset_dispatch_count",
@@ -86,9 +94,24 @@ _counters: Dict[str, int] = {}
 _gauges: Dict[str, float] = {}
 #: per-site span aggregates: site -> [count, total_seconds]
 _spans: Dict[str, List[float]] = {}
+#: streaming histograms (telemetry.hist.Histogram) fed by observe()
+_hists: Dict[str, Histogram] = {}
 #: the bounded event list (newest last); spans append one event at exit
 _events: List[dict] = []
 _MAX_EVENTS = 1 << 16
+
+#: the flight recorder's always-on ring append, registered by
+#: :mod:`heat_tpu.telemetry.flight` at import so _emit never has to
+#: import it (None until that module loads)
+_flight_append: Optional[Callable[[dict], None]] = None
+
+#: the ambient request-trace ids (tentpole: request-scoped tracing).
+#: A contextvar, not a threading.local: the serve engine re-establishes
+#: it per micro-batch from the Request records, so worker threads and
+#: async callers both see the right ids.
+_trace_var: "contextvars.ContextVar[Tuple[str, ...]]" = contextvars.ContextVar(
+    "heat_tpu_trace_ids", default=()
+)
 
 #: optional JSONL sink: every event is also appended to this file
 _jsonl = None  # type: Optional[Any]
@@ -175,6 +198,7 @@ def reset() -> None:
         _counters.clear()
         _gauges.clear()
         _spans.clear()
+        _hists.clear()
         _events.clear()
         _tids.clear()
         if _trace_buf is not None:
@@ -196,18 +220,40 @@ def _tid() -> int:
 
 def _emit(ev: dict) -> None:
     """Append one event under the lock: bounded in-memory list, JSONL
-    sink, and the Perfetto buffer when a trace is being collected."""
+    sink, the flight-recorder ring, and the Perfetto buffer when a trace
+    is being collected.
+
+    Overflow of the bounded list is NEVER silent: the drop is counted
+    under ``telemetry.events.dropped`` — surfaced by ``snapshot()`` and
+    the ``/metrics`` endpoint — so a long-running server that outlives
+    the buffer shows exactly how much of the stream it lost.  The JSONL
+    sink, flight ring, and trace buffer still receive the event (each is
+    bounded or externally drained on its own)."""
     with _lock:
         if len(_events) < _MAX_EVENTS:
             _events.append(ev)
         else:
-            _counters["telemetry.dropped_events"] = (
-                _counters.get("telemetry.dropped_events", 0) + 1
+            _counters["telemetry.events.dropped"] = (
+                _counters.get("telemetry.events.dropped", 0) + 1
             )
         if _jsonl is not None:
             _jsonl.write(json.dumps(ev, sort_keys=True, default=str) + "\n")
+        if _flight_append is not None:
+            _flight_append(ev)
         if _trace_buf is not None:
             _trace_buf.append(_trace_event(ev))
+
+
+def set_max_events(n: Optional[int]) -> int:
+    """Cap the bounded in-memory event list at ``n`` (``None`` restores
+    the default 2**16); returns the previous cap.  Tests shrink the cap
+    to exercise the ``telemetry.events.dropped`` overflow accounting
+    without emitting 65k events."""
+    global _MAX_EVENTS
+    with _lock:
+        prev = _MAX_EVENTS
+        _MAX_EVENTS = (1 << 16) if n is None else int(n)
+    return prev
 
 
 def _trace_event(ev: dict) -> dict:
@@ -237,10 +283,15 @@ def _trace_event(ev: dict) -> dict:
 
 def record_event(etype: str, site: str = "", **fields) -> None:
     """Record one instant event (guard incidents, checkpoint saves,
-    compile-cache misses …) of type ``etype``.  No-op while disabled."""
+    compile-cache misses …) of type ``etype``.  No-op while disabled.
+    Events emitted inside a :func:`trace_ctx` carry the active request
+    ids under ``rid``."""
     if not enabled:
         return
     ev = {"type": etype, "site": site, "ts": clock(), "tid": _tid()}
+    rids = _trace_var.get()
+    if rids:
+        ev["rid"] = list(rids)
     ev.update(fields)
     _emit(ev)
 
@@ -273,6 +324,76 @@ def gauge(name: str, value: float) -> None:
                     "args": {"value": value},
                 }
             )
+
+
+def observe(name: str, value: float) -> None:
+    """Record one observation into the named streaming histogram
+    (:class:`heat_tpu.telemetry.hist.Histogram` — fixed memory,
+    log-bucketed, quantiles within the documented ~4.4% relative bound).
+    No-op while disabled; the histogram appears in ``snapshot()`` under
+    ``hists`` and on ``/metrics`` as a Prometheus histogram."""
+    if not enabled:
+        return
+    with _lock:
+        h = _hists.get(name)
+        if h is None:
+            h = _hists[name] = Histogram()
+        h.record(value)
+
+
+def histogram(name: str) -> Optional[Histogram]:
+    """The live histogram registered under ``name`` (None if nothing has
+    been observed there).  The object is shared — copy() before mutating."""
+    with _lock:
+        return _hists.get(name)
+
+
+# --------------------------------------------------------------------- #
+# request-scoped trace context                                          #
+# --------------------------------------------------------------------- #
+@contextlib.contextmanager
+def trace_ctx(*request_ids):
+    """Tag everything telemetry records in this context with request ids.
+
+    The tentpole of request-scoped observability: ``trace_ctx("rq-17")``
+    installs the id in a contextvar, and every span and instant event
+    that closes inside the context carries ``rid=[...]`` — on the event
+    stream, in the JSONL sink, in the flight-recorder ring, and in the
+    Perfetto export (as ``args.rid``), so one slow request can be walked
+    from its reply back through the micro-batch's ``serve:*`` span and
+    any nested ``comm:*`` spans to the device dispatch that served it.
+
+    Nested contexts ACCUMULATE: a micro-batch context carrying every
+    coalesced request's id may sit inside (or around) a single request's
+    context, and the union is what lands on the events.  Ids may be
+    strings or anything ``str()``-able; an iterable argument is
+    flattened one level so ``trace_ctx(ids_list)`` works.
+
+    Cost: one contextvar set/reset per ``with`` block — no predicate on
+    the telemetry flag, because the context must already be installed
+    when collection is enabled mid-request; the per-site disabled cost
+    contract is untouched (sites still guard on ``_core.enabled``).
+
+    Host-side only: inside a jit/shard_map/fuse-traced body the context
+    manager runs at *trace* time and tags nothing at run time — spmdlint
+    rule SPMD210 flags that misuse.
+    """
+    flat: List[str] = []
+    for rid in request_ids:
+        if isinstance(rid, (list, tuple, set, frozenset)):
+            flat.extend(str(r) for r in rid)
+        else:
+            flat.append(str(rid))
+    token = _trace_var.set(_trace_var.get() + tuple(flat))
+    try:
+        yield tuple(flat)
+    finally:
+        _trace_var.reset(token)
+
+
+def current_trace() -> Tuple[str, ...]:
+    """The active request ids (empty tuple outside any trace_ctx)."""
+    return _trace_var.get()
 
 
 def account_bytes(op: str, mode: str, exact_bytes: int, wire_bytes: int) -> None:
@@ -345,6 +466,9 @@ class _Span:
             "dur": dur,
             "tid": _tid(),
         }
+        rids = _trace_var.get()
+        if rids:
+            ev["rid"] = list(rids)
         if self.fields:
             ev.update(self.fields)
         if exc_type is not None:
@@ -412,6 +536,7 @@ def snapshot() -> dict:
                 site: {"count": int(c), "total_s": t}
                 for site, (c, t) in sorted(_spans.items())
             },
+            "hists": {name: _hists[name].state() for name in sorted(_hists)},
             "events": len(_events),
         }
 

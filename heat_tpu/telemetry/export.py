@@ -21,7 +21,9 @@ time; ``HEAT_TELEMETRY_JSONL=<path>`` opens the JSONL sink and
 ``HEAT_TELEMETRY_TRACE=<path>`` starts a trace that is flushed at
 process exit — the hooks the CI telemetry lane
 (scripts/run_test_matrix.sh) uses to archive artifacts from an
-otherwise unmodified test run.
+otherwise unmodified test run.  ``HEAT_FLIGHT_DIR=<dir>`` points the
+always-on flight recorder's postmortem dumps at a directory (the
+recorder itself needs no flag — it is on by default).
 """
 
 from __future__ import annotations
@@ -111,6 +113,11 @@ def _env_autostart() -> None:
     if trace:
         start_trace(trace)
         atexit.register(stop_trace)
+    flight_dir = os.environ.get("HEAT_FLIGHT_DIR")
+    if flight_dir:
+        from . import flight
+
+        flight.set_dump_dir(flight_dir)
 
 
 _env_autostart()

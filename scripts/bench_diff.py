@@ -1,0 +1,135 @@
+#!/usr/bin/env python
+"""Compare two BENCH_FULL.json reports headline by headline.
+
+The obs lane's regression gate: for every headline metric the bench
+suite watches (``bench._HEADLINE`` — the single source of truth for the
+metric list and each metric's direction), compare the current report
+against a committed prior and flag any value that moved more than
+``--threshold`` (default 10%) in the WORSE direction.  Each flagged
+headline is printed with its standing disposition from
+``bench._FLAG_DISPOSITIONS`` (the per-metric reading guide the bench
+report ships), so a flag arrives with the context needed to judge it —
+spread history, golden controls, known bimodality.
+
+Exit status: 0 when no headline regressed, 1 when any did — the CI
+contract (scripts/run_test_matrix.sh obs lane).  Metrics that are null
+or absent on either side are reported and skipped, never flagged: an
+off-TPU run's unmodeled metrics (e.g. ``ring_overlap_efficiency``)
+must not fail the gate.
+
+``--inject METRIC=FACTOR`` multiplies one CURRENT headline by FACTOR
+before comparing — the lane's self-test knob: injecting a synthetic
+regression must flip the exit status to nonzero, proving the gate is
+actually wired.
+
+Usage::
+
+    python scripts/bench_diff.py                    # current vs itself (sanity: 0 flags)
+    python scripts/bench_diff.py --prior old.json   # current vs a saved prior
+    python scripts/bench_diff.py --inject serve_p99_ms=2.0   # must exit 1
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+# bench.py keeps its top-level imports light (no jax) precisely so the
+# headline table is importable from tooling like this
+import bench  # noqa: E402
+
+
+def headline_value(report: dict, key: str):
+    """One headline's value in a BENCH_FULL.json document.  The lead
+    metric is stored as ``{"metric": <name>, "value": ...}``; every
+    other headline is a top-level key."""
+    if report.get("metric") == key:
+        return report.get("value")
+    return report.get(key)
+
+
+def compare(prior: dict, current: dict, threshold: float):
+    """Yield one record per headline: ``(key, prior, current, ratio,
+    verdict)`` where verdict is "ok" / "regressed" / "skipped"."""
+    for key, higher_better in bench._HEADLINE.items():
+        p = headline_value(prior, key)
+        c = headline_value(current, key)
+        if p is None or c is None or not p:
+            yield key, p, c, None, "skipped"
+            continue
+        ratio = c / p
+        if higher_better:
+            regressed = ratio < 1.0 - threshold
+        else:
+            regressed = ratio > 1.0 + threshold
+        yield key, p, c, ratio, ("regressed" if regressed else "ok")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    default = os.path.join(REPO, "BENCH_FULL.json")
+    ap.add_argument("--current", default=default,
+                    help="report under test (default: the repo's BENCH_FULL.json)")
+    ap.add_argument("--prior", default=default,
+                    help="committed prior to compare against (default: the "
+                    "same file — a self-compare that must produce 0 flags)")
+    ap.add_argument("--threshold", type=float, default=0.10,
+                    help="relative worse-direction move that flags (default 0.10)")
+    ap.add_argument("--inject", metavar="METRIC=FACTOR", default=None,
+                    help="multiply one CURRENT headline by FACTOR before "
+                    "comparing (the gate's self-test)")
+    args = ap.parse_args(argv)
+
+    with open(args.prior) as fh:
+        prior = json.load(fh)
+    with open(args.current) as fh:
+        current = json.load(fh)
+
+    if args.inject:
+        key, _, factor = args.inject.partition("=")
+        if key not in bench._HEADLINE:
+            ap.error(f"--inject metric {key!r} is not a headline "
+                     f"(choose from {sorted(bench._HEADLINE)})")
+        val = headline_value(current, key)
+        if val is None:
+            ap.error(f"--inject target {key!r} is null in the current report")
+        injected = val * float(factor)
+        if current.get("metric") == key:
+            current["value"] = injected
+        else:
+            current[key] = injected
+        print(f"[inject] {key}: {val} -> {injected}")
+
+    smoke = bool(prior.get("smoke") or current.get("smoke"))
+    if smoke:
+        print("[note] one side is a SMOKE artifact — values document the "
+              "schema, not performance; flags below are schema exercise only")
+
+    regressions = []
+    for key, p, c, ratio, verdict in compare(prior, current, args.threshold):
+        arrow = "↑" if bench._HEADLINE[key] else "↓"
+        if verdict == "skipped":
+            print(f"  skip  {key} ({arrow} better): prior={p} current={c}")
+            continue
+        line = f"{key} ({arrow} better): {p:g} -> {c:g}  ({ratio:.3f}x)"
+        if verdict == "regressed":
+            regressions.append(key)
+            print(f"  FLAG  {line}")
+            disp = bench._FLAG_DISPOSITIONS.get(key)
+            if disp:
+                print(f"        disposition: {disp}")
+        else:
+            print(f"  ok    {line}")
+
+    print(f"\n{len(regressions)} headline(s) regressed beyond "
+          f"{args.threshold:.0%}" + (f": {', '.join(regressions)}" if regressions else ""))
+    return 1 if regressions else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

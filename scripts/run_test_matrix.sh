@@ -155,6 +155,79 @@ then
     echo "FAILED serve chaos scenario (reproduce with HEAT_CHAOS_SEED=${HEAT_CHAOS_SEED:-0})"
     fail=1
 fi
+# obs lane (docs/design.md §19): the request-scoped observability suite,
+# then a /metrics scrape of a LIVE ServeEngine (Prometheus text parsed
+# and byte-compared against telemetry.snapshot()), then the bench_diff
+# regression gate — self-compare must pass clean AND an injected
+# synthetic regression must flip the exit status (the gate's self-test)
+echo "=== obs lane (tracing, histograms, SLO burn, flight recorder, /metrics) ==="
+if ! HEAT_CHAOS_SEED="${HEAT_CHAOS_SEED:-0}" python -m pytest tests/test_obs.py -q; then
+    echo "FAILED obs suite (reproduce with HEAT_CHAOS_SEED=${HEAT_CHAOS_SEED:-0})"
+    fail=1
+fi
+if ! python - <<'PY'
+import json
+import tempfile
+import urllib.request
+
+import numpy as np
+
+import heat_tpu as ht
+from heat_tpu import telemetry
+from heat_tpu.serve import ModelRegistry, ServeEngine, loadgen
+
+telemetry.enable()
+telemetry.reset()
+rng = np.random.default_rng(0)
+km = ht.cluster.KMeans(n_clusters=3, max_iter=5, random_state=0)
+km.fit(ht.array(rng.normal(size=(64, 5)).astype(np.float32), split=0))
+reg = ModelRegistry(tempfile.mkdtemp(prefix="heat-obs-lane-"))
+reg.publish("ci", "km", km)
+eng = ServeEngine(reg, max_batch_rows=64, min_bucket=8)
+loadgen.run(eng, "ci", "km", n_requests=16, twin=False)
+srv = eng.start_metrics_server()  # 127.0.0.1, ephemeral port
+text = urllib.request.urlopen(srv.url + "/metrics").read().decode()
+assert urllib.request.urlopen(srv.url + "/healthz").read() == b"ok\n"
+varz = json.loads(urllib.request.urlopen(srv.url + "/varz").read())
+assert varz["serve"]["requests"] == 16, varz["serve"]
+
+# parse the Prometheus text exposition and byte-compare every counter
+# sample against the snapshot the registry reports directly
+samples = {}
+for line in text.splitlines():
+    if line.startswith("#") or not line.strip():
+        continue
+    name, _, value = line.partition(" ")
+    samples[name] = value
+snap = telemetry.snapshot()
+from heat_tpu.telemetry.httpz import _fmt, sanitize_metric_name
+checked = 0
+for cname, cval in snap["counters"].items():
+    m = sanitize_metric_name(cname) + "_total"
+    assert m in samples, f"counter {cname} missing from /metrics as {m}"
+    assert samples[m] == _fmt(cval), (m, samples[m], cval)
+    checked += 1
+assert checked > 0 and "heat_serve_requests_total" in samples
+eng.close()
+telemetry.disable()
+telemetry.reset()
+print(f"/metrics scrape: {checked} counters byte-identical to snapshot(), "
+      f"healthz ok, varz live ({len(samples)} samples total)")
+PY
+then
+    echo "FAILED /metrics scrape smoke"
+    fail=1
+fi
+if ! python scripts/bench_diff.py > /dev/null; then
+    echo "FAILED bench_diff self-compare (must be 0 flags)"
+    fail=1
+fi
+if python scripts/bench_diff.py --inject serve_p99_ms=2.0 > /dev/null; then
+    echo "FAILED bench_diff gate self-test (injected regression not caught)"
+    fail=1
+else
+    echo "bench_diff: self-compare clean; injected regression caught (exit nonzero)"
+fi
 # overlap lane: the latency-hiding policy (docs/design.md §18) — every
 # double-buffered ring against its same-run serial twin at byte
 # granularity, then the compressed + redistribution suites re-run with

@@ -1014,6 +1014,93 @@ def ring(x, size, name, perm):
 
 
 # --------------------------------------------------------------------- #
+# SPMD210: request-scoped observability inside traced functions          #
+# --------------------------------------------------------------------- #
+def test_spmd210_triggers_on_trace_ctx_in_jit():
+    src = """
+import jax
+from heat_tpu import telemetry
+
+@jax.jit
+def f(x):
+    with telemetry.trace_ctx("req-1"):
+        return x * 2
+"""
+    findings = lint(src, "SPMD210")
+    assert findings and "trace_ctx" in findings[0].message
+
+
+def test_spmd210_triggers_on_observe_and_flight_note_in_traced():
+    src = """
+from jax.experimental.shard_map import shard_map
+from heat_tpu import obs
+from heat_tpu.telemetry import flight
+
+def f(x, mesh, specs):
+    def kernel(s):
+        obs.observe("kernel.value", s.sum())
+        flight.note("kernel", site="k")
+        return s * 2
+    return shard_map(kernel, mesh=mesh, in_specs=specs, out_specs=specs)(x)
+"""
+    findings = lint(src, "SPMD210")
+    msgs = " | ".join(f.message for f in findings)
+    assert "telemetry.observe" in msgs and "flight-recorder note" in msgs
+
+
+def test_spmd210_triggers_inside_jitted_factory():
+    src = """
+from heat_tpu.core._compile import jitted
+from heat_tpu.telemetry import _core as _tel
+
+def op(x):
+    def make():
+        def fn(a):
+            _tel.observe("op.val", 1.0)
+            return a
+        return fn
+    return jitted(("op",), make)(x)
+"""
+    findings = lint(src, "SPMD210")
+    assert findings and "telemetry.observe" in findings[0].message
+
+
+def test_spmd210_clean_on_host_side_observability():
+    # the serve-engine pattern: context + observation around the traced
+    # call, never inside it
+    src = """
+import jax
+from heat_tpu import telemetry
+from heat_tpu.telemetry import flight
+
+@jax.jit
+def f(x):
+    return x * 2
+
+def serve_one(x, rid, lat_ms):
+    with telemetry.trace_ctx(rid):
+        y = f(x)
+    telemetry.observe("serve.latency_ms", lat_ms)
+    flight.note("served", site="serve", rid=rid)
+    return y
+"""
+    assert lint(src, "SPMD210") == []
+
+
+def test_spmd210_suppression_comment_silences():
+    src = """
+import jax
+from heat_tpu import telemetry
+
+@jax.jit
+def f(x):
+    telemetry.observe("trace.cost", 1.0)  # spmdlint: disable=SPMD210
+    return x * 2
+"""
+    assert lint(src, "SPMD210") == []
+
+
+# --------------------------------------------------------------------- #
 # SPMD301/302: Pallas tiling and grids                                   #
 # --------------------------------------------------------------------- #
 def test_spmd301_triggers_on_off_tile_blocks():
@@ -1176,7 +1263,7 @@ def test_every_rule_is_registered():
     assert [r.id for r in all_rules()] == [
         "SPMD001", "SPMD101", "SPMD102", "SPMD201", "SPMD202", "SPMD203",
         "SPMD204", "SPMD205", "SPMD206", "SPMD207", "SPMD208", "SPMD209",
-        "SPMD301", "SPMD302",
+        "SPMD210", "SPMD301", "SPMD302",
         "SPMD401", "SPMD501", "SPMD502", "SPMD503", "SPMD504",
     ]
 
