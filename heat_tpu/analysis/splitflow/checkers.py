@@ -102,6 +102,17 @@ def check_split_out_of_range(program: Program) -> Iterable[Finding]:
     def build(ev):
         f = ev.fact
         ndim = len(f.shape) if f.shape is not None else "?"
+        if isinstance(f.dst, tuple):
+            # splits-tuple spelling: the transfer function records WHICH
+            # mesh invariant broke (entry range / arity / duplicate axis)
+            return (
+                f"invalid splits tuple {_fmt_split(f.dst)} for the "
+                f"{ndim}-d value (shape {f.shape}): {f.note}; "
+                f"normalize_splits raises ValueError at runtime",
+                "each entry names a mesh axis of the target comm "
+                "(the default comm's mesh is 1-D — pass comm=grid_comm(...) "
+                "for 2-D layouts), at most once, one entry per array dim",
+            )
         return (
             f"split axis {_fmt_split(f.dst)} is out of range for the "
             f"{ndim}-d value (shape {f.shape}); sanitize_axis raises "

@@ -69,7 +69,13 @@ class CommEvent:
 
 
 def _fmt_split(s) -> str:
-    return "⊤" if s is TOP else ("None" if s is None else str(s))
+    if s is TOP:
+        return "⊤"
+    if s is None:
+        return "None"
+    if isinstance(s, tuple):
+        return "(" + ", ".join(_fmt_split(g) for g in s) + ")"
+    return str(s)
 
 
 class Program:
@@ -657,6 +663,9 @@ class _Interp:
         elif kind == "factory":
             params["shape"] = self._factory_shape(sem.name, node, kw_lits)
             params["split"] = kw_lits.get("split", MISSING)
+            params["splits"] = kw_lits.get("splits", MISSING)
+            params["has_comm"] = any(
+                kw.arg == "comm" for kw in node.keywords)
             params["dtype"] = self._dtype_of(node, sem.name)
         elif kind == "factory_like":
             params["split"] = kw_lits.get("split", MISSING)

@@ -65,6 +65,50 @@ def _first_array(operands: Sequence[Spec]) -> Spec:
     return NOT_ARRAY
 
 
+def _is_splits_tuple(v) -> bool:
+    return isinstance(v, (tuple, list)) and all(
+        g is None or isinstance(g, int) for g in v)
+
+
+def _promote_split(split, ndim):
+    """Canonical form for layout comparison: a 1-D int split promotes to
+    its one-hot splits tuple when the rank is known (mirrors
+    ``normalize_splits`` in the runtime)."""
+    if isinstance(split, int) and ndim is not None:
+        tup = [None] * ndim
+        tup[split % ndim] = 0
+        return tuple(tup)
+    if _is_splits_tuple(split):
+        return tuple(split)
+    return split
+
+
+def _splits_tuple_issues(tup, ndim, *, mesh_ndim=None) -> List[str]:
+    """Static validity problems of a literal splits tuple (SPMD503 fuel).
+
+    ``mesh_ndim`` is the mesh rank to validate entries against; ``None``
+    means the target mesh is unknown (a ``comm=`` argument is present)
+    and entry values are not checked.
+    """
+    issues: List[str] = []
+    if ndim is not None and len(tup) != ndim:
+        issues.append(
+            f"splits tuple has {len(tup)} entries for a {ndim}-d array")
+    seen = {}
+    for d, g in enumerate(tup):
+        if g is None:
+            continue
+        if mesh_ndim is not None and not (-mesh_ndim <= g < mesh_ndim):
+            issues.append(
+                f"splits[{d}]={g} out of range for a {mesh_ndim}-d mesh")
+        if g in seen:
+            issues.append(
+                f"mesh axis {g} shards both dims {seen[g]} and {d}")
+        else:
+            seen[g] = d
+    return issues
+
+
 def _shape_after_reduce(shape, axes, keepdims):
     if shape is None or axes is _MISSING:
         return None
@@ -142,6 +186,12 @@ def _matmul(a: Spec, b: Spec) -> Tuple[Spec, List[OpFact]]:
         shape = (a.shape[0], b.shape[1])
     dtype = a.dtype if a.dtype == b.dtype else None
     if a.split is TOP or b.split is TOP:
+        return Spec(split=TOP, shape=shape, dtype=dtype), []
+    if _is_splits_tuple(a.split) or _is_splits_tuple(b.split):
+        # grid SUMMA path: two fully 2-D-sharded operands keep the grid
+        # layout; anything else over a splits tuple is left unknown
+        if a.split == (0, 1) and b.split == (0, 1):
+            return Spec(split=(0, 1), shape=shape, dtype=dtype), []
         return Spec(split=TOP, shape=shape, dtype=dtype), []
     if a.split == 0:
         return Spec(split=0, shape=shape, dtype=dtype), []
@@ -269,6 +319,31 @@ def _resplit(x: Spec, dst) -> Tuple[Spec, List[OpFact]]:
     facts: List[OpFact] = []
     if dst is _MISSING or dst is NONLIT:
         return Spec(split=TOP, shape=x.shape, dtype=x.dtype), facts
+    if isinstance(dst, (tuple, list)):
+        if not _is_splits_tuple(dst):
+            return Spec(split=TOP, shape=x.shape, dtype=x.dtype), facts
+        dst = tuple(dst)
+        # the target mesh rank is the comm's, which is not statically
+        # known here — check only the mesh-independent invariants
+        issues = _splits_tuple_issues(dst, x.ndim, mesh_ndim=None)
+        if issues:
+            facts.append(OpFact(
+                "split_oob", src=x.split, dst=dst,
+                shape=x.shape, dtype=x.dtype, note="; ".join(issues),
+            ))
+            return Spec(split=TOP, shape=x.shape, dtype=x.dtype), facts
+        if x.split is not TOP and _promote_split(x.split, x.ndim) == \
+                _promote_split(dst, x.ndim):
+            facts.append(OpFact(
+                "noop_collective", src=x.split, dst=dst,
+                shape=x.shape, dtype=x.dtype,
+                note="resplit to the layout the value already has",
+            ))
+        elif x.split is not TOP:
+            facts.append(OpFact("resplit", src=x.split, dst=dst,
+                                shape=x.shape, dtype=x.dtype))
+        return Spec(split=dst, shape=x.shape, dtype=x.dtype,
+                    ragged=x.ragged), facts
     if isinstance(dst, int) and x.ndim is not None \
             and not (-x.ndim <= dst < x.ndim):
         facts.append(OpFact(
@@ -278,7 +353,8 @@ def _resplit(x: Spec, dst) -> Tuple[Spec, List[OpFact]]:
         return Spec(split=TOP, shape=x.shape, dtype=x.dtype), facts
     if isinstance(dst, int) and x.ndim is not None:
         dst = dst % x.ndim
-    if x.split is not TOP and x.split == dst:
+    if x.split is not TOP and _promote_split(x.split, x.ndim) == \
+            _promote_split(dst, x.ndim):
         facts.append(OpFact(
             "noop_collective", src=x.split, dst=dst,
             shape=x.shape, dtype=x.dtype,
@@ -291,7 +367,8 @@ def _resplit(x: Spec, dst) -> Tuple[Spec, List[OpFact]]:
                 ragged=x.ragged), facts
 
 
-def _factory(shape, split, dtype) -> Tuple[Spec, List[OpFact]]:
+def _factory(shape, split, dtype, splits=_MISSING,
+             has_comm=False) -> Tuple[Spec, List[OpFact]]:
     facts: List[OpFact] = []
     shp = None
     if isinstance(shape, int):
@@ -299,6 +376,23 @@ def _factory(shape, split, dtype) -> Tuple[Spec, List[OpFact]]:
     elif isinstance(shape, (tuple, list)) and all(
             isinstance(s, int) for s in shape):
         shp = tuple(shape)
+    if splits is not _MISSING:
+        # N-D mesh spelling.  Entries name MESH axes: without an explicit
+        # ``comm=`` the array lands on the default 1-D mesh, so any entry
+        # other than 0/None is statically out of range (SPMD503).
+        if splits is NONLIT or not _is_splits_tuple(splits):
+            return Spec(split=TOP, shape=shp, dtype=dtype), facts
+        tup = tuple(splits)
+        issues = _splits_tuple_issues(
+            tup, len(shp) if shp is not None else None,
+            mesh_ndim=None if has_comm else 1)
+        if issues:
+            facts.append(OpFact(
+                "split_oob", src=None, dst=tup, shape=shp, dtype=dtype,
+                note="; ".join(issues),
+            ))
+            return Spec(split=TOP, shape=shp, dtype=dtype), facts
+        return Spec(split=tup, shape=shp, dtype=dtype), facts
     if split is NONLIT:
         return Spec(split=TOP, shape=shp, dtype=dtype), facts
     sp = split if split is not _MISSING else None
@@ -348,6 +442,7 @@ def apply_kind(kind: str, operands: Sequence[Spec], *,
                axis=_MISSING, shape=_MISSING, split=_MISSING,
                dtype: Optional[str] = None, keepdims=_MISSING,
                compute_uv=_MISSING, arrays: Sequence[Spec] = (),
+               splits=_MISSING, has_comm=False,
                ) -> Tuple[object, List[OpFact]]:
     """Dispatch one op kind over evaluated operand specs.
 
@@ -417,7 +512,8 @@ def apply_kind(kind: str, operands: Sequence[Spec], *,
             axis if axis is not _MISSING else None))
     if kind == "factory":
         return _factory(shape if shape is not _MISSING else None,
-                        split, dtype or "float32")
+                        split, dtype or "float32",
+                        splits=splits, has_comm=has_comm)
     if kind == "factory_like":
         if not x.is_array:
             return UNKNOWN, []

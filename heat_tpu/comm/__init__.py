@@ -33,6 +33,7 @@ from .redistribute import (
     Plan,
     get_redistribution,
     get_redistribution_threshold,
+    grid_redistribute_or_none,
     monolithic_model,
     plan,
     redistribution,
@@ -69,6 +70,7 @@ __all__ = [
     "get_overlap",
     "get_redistribution",
     "get_redistribution_threshold",
+    "grid_redistribute_or_none",
     "monolithic_model",
     "overlap",
     "overlap_enabled",

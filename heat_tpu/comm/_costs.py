@@ -35,11 +35,13 @@ __all__ = [
     "DEFAULT_ICI_GBPS",
     "critical_path_ms",
     "encoded_bytes",
+    "grid_plan_cost",
     "itemsize",
     "monolithic_cost",
     "plan_cost",
     "resolve_mode",
     "ring_wire_model",
+    "summa_grid_model",
 ]
 
 #: Quantization block length: one f32 scale per this many payload values.
@@ -304,4 +306,217 @@ def plan_cost(
     return {
         "steps": tuple(steps), "mode": mode, "wire_bytes": wire,
         "exact_wire_bytes": exact, "peak_live_bytes": peak,
+    }
+
+
+def _dim_of(layout, g: int) -> Optional[int]:
+    """Array dim sharded by mesh axis ``g`` under ``layout`` (splits
+    tuple: ``layout[d]`` is the mesh axis sharding dim ``d``)."""
+    for d, x in enumerate(layout):
+        if x == g:
+            return d
+    return None
+
+
+def _check_splits(name: str, splits, ndim: int, mesh_ndim: int) -> Tuple:
+    splits = tuple(None if g is None else int(g) for g in splits)
+    if len(splits) != ndim:
+        raise ValueError(
+            f"{name} splits {splits} has arity {len(splits)} for a "
+            f"{ndim}-dimensional shape"
+        )
+    seen = set()
+    for g in splits:
+        if g is None:
+            continue
+        if not 0 <= g < mesh_ndim:
+            raise ValueError(
+                f"{name} splits {splits}: mesh axis {g} out of range for a "
+                f"{mesh_ndim}-axis mesh"
+            )
+        if g in seen:
+            raise ValueError(f"{name} splits {splits}: mesh axis {g} used twice")
+        seen.add(g)
+    return splits
+
+
+def grid_plan_cost(
+    shape: Tuple[int, ...],
+    dtype_name: str,
+    src_splits: Tuple[Optional[int], ...],
+    dst_splits: Tuple[Optional[int], ...],
+    mesh_shape: Tuple[int, ...],
+    *,
+    mode_for: Optional[Callable[[int], Optional[str]]] = None,
+    overlap: bool = False,
+) -> dict:
+    """Schedule + cost model of a planned N-D (grid) redistribution.
+
+    Factors the (``src_splits`` → ``dst_splits``) layout change into a
+    short sequence of per-mesh-axis 1-D **stages**, each priced by
+    :func:`plan_cost` over the sub-mesh of that axis.  The greedy
+    ordering moves each mesh axis directly (``src dim → dst dim``) when
+    its target dim is free; a cyclic layout transpose (e.g. ``(0, 1) →
+    (1, 0)`` on a 2-D mesh) is broken by routing one axis through
+    replicated, exactly like the 1-D planner's split→None→split escape
+    hatch.  Every stage's 1-D cost is evaluated on the stage-local
+    extents — dims held sharded by *other* mesh axes enter at their local
+    (padded) widths — so wire bytes are the sum of stage wires and the
+    modeled peak is the max of stage peaks.
+
+    Source-sharded dims must divide their mesh axis (the canonical
+    commit invariant; ragged arrays reach planners replicated, as in the
+    1-D contract).  Returns the :func:`plan_cost` dict extended with
+    ``stages`` (``(mesh_axis, src_dim, dst_dim)`` triples — the runtime
+    program builder replays exactly these) and ``out_shape`` (the true
+    shape with ragged destination dims padded).  Step tuples carry the
+    mesh axis as their second element: ``("rotate", g, k)``.
+    """
+    shape = tuple(int(s) for s in shape)
+    ndim = len(shape)
+    mesh_shape = tuple(max(int(p), 1) for p in mesh_shape)
+    mesh_ndim = len(mesh_shape)
+    src = _check_splits("source", src_splits, ndim, mesh_ndim)
+    dst = _check_splits("destination", dst_splits, ndim, mesh_ndim)
+    item = itemsize(dtype_name)
+    mode_for = mode_for or (lambda nbytes: None)
+    for d, g in enumerate(src):
+        if g is not None and shape[d] % mesh_shape[g]:
+            raise ValueError(
+                f"ragged source axis: shape {shape} dim {d} does not divide "
+                f"over {mesh_shape[g]} devices along mesh axis {g} (a "
+                "canonically committed input is divisible; ragged dims live "
+                "replicated and plan as src=None)"
+            )
+
+    # greedy stage factoring over the mesh axes whose dim assignment moves
+    state = list(src)
+    remaining = {g for g in range(mesh_ndim) if _dim_of(state, g) != _dim_of(dst, g)}
+    stages = []
+    while remaining:
+        progressed = False
+        for g in sorted(remaining):
+            sd, td = _dim_of(state, g), _dim_of(dst, g)
+            if td is not None and state[td] is not None and state[td] != g:
+                continue  # target dim held by another mesh axis: blocked
+            stages.append((g, sd, td))
+            if sd is not None:
+                state[sd] = None
+            if td is not None:
+                state[td] = g
+            remaining.discard(g)
+            progressed = True
+        if not progressed:
+            # cyclic layout transpose: break the lowest blocked axis's
+            # move through replicated; its None→dst leg runs once the
+            # axis holding its target dim has moved off
+            g = min(remaining)
+            sd = _dim_of(state, g)
+            stages.append((g, sd, None))
+            state[sd] = None
+
+    # price each stage on its stage-local extents
+    ext = list(shape)  # current padded global extents
+    state = list(src)
+    steps, stage_modes = [], []
+    wire = exact = 0
+    at_rest = _nelems(shape) * item
+    for g in (x for x in src if x is not None):
+        at_rest //= mesh_shape[g]
+    peak = at_rest
+    for g, sd, td in stages:
+        p = mesh_shape[g]
+        eff = []
+        for d in range(ndim):
+            h = state[d]
+            if d in (sd, td) or h is None or h == g:
+                eff.append(ext[d])
+            else:
+                eff.append(ext[d] // mesh_shape[h])  # local width elsewhere
+        sub = plan_cost(
+            tuple(eff), dtype_name, sd, td, p, mode_for=mode_for, overlap=overlap
+        )
+        steps.extend((s[0], g) + s[1:] for s in sub["steps"])
+        stage_modes.append(sub["mode"])
+        wire += sub["wire_bytes"]
+        exact += sub["exact_wire_bytes"]
+        peak = max(peak, sub["peak_live_bytes"])
+        if sd is not None:
+            state[sd] = None
+        if td is not None:
+            state[td] = g
+            ext[td] = p * (-(-ext[td] // p))
+    mode = next((m for m in stage_modes if m is not None), None)
+    out_shape = list(shape)
+    for d, g in enumerate(dst):
+        if g is not None:
+            p = mesh_shape[g]
+            out_shape[d] = p * (-(-out_shape[d] // p))
+    return {
+        "steps": tuple(steps), "mode": mode, "wire_bytes": int(wire),
+        "exact_wire_bytes": int(exact), "peak_live_bytes": int(peak),
+        "stages": tuple(stages), "stage_modes": tuple(stage_modes),
+        "out_shape": tuple(out_shape),
+    }
+
+
+def summa_grid_model(
+    m: int,
+    k: int,
+    n: int,
+    mesh_shape: Tuple[int, int],
+    *,
+    mode: Optional[str] = None,
+    overlap: bool = False,
+    compute_ms_per_step: float = 0.0,
+    gbps: float = DEFAULT_ICI_GBPS,
+) -> dict:
+    """Per-device wire/memory model of the grid SUMMA matmul.
+
+    ``A (m, k) @ B (k, n)`` on an ``r×c`` mesh with A splits ``(0, 1)``
+    and B splits ``(0, 1)``: the schedule runs ``L = r*c`` k-panels of
+    width ``w = ceil(k / L)``; each panel step broadcasts A's
+    ``(m/r, w)`` panel along the mesh columns (a masked psum over the
+    ``c``-ring) and B's ``(w, n/c)`` panel along the mesh rows (over the
+    ``r``-ring).  Figures assume f32 panels (:func:`ring_wire_model`'s
+    exact-byte convention); degenerate mesh axes contribute zero wire.
+    This function is the single source the runtime telemetry is credited
+    from (``core/linalg/basics.py``) and the bench headline prices —
+    delegation keeps accounted and modeled bytes identical.
+    """
+    r, c = (max(int(s), 1) for s in mesh_shape)
+    L = r * c
+    w = -(-int(k) // L) if k else 0
+    mloc = -(-int(m) // r)
+    nloc = -(-int(n) // c)
+    a_step = ring_wire_model(mloc * w, c, mode, op="allreduce")
+    b_step = ring_wire_model(w * nloc, r, mode, op="allreduce")
+    hops = L * (a_step["ring_hops_per_device"] + b_step["ring_hops_per_device"])
+    exact = L * (a_step["exact_wire_bytes"] + b_step["exact_wire_bytes"])
+    wire = L * (a_step["wire_bytes"] + b_step["wire_bytes"])
+    # at-rest operands + accumulator + in-flight panels (x2 double-buffered)
+    bufs = 2 if overlap else 1
+    peak = 4 * (
+        mloc * (r * w) + (c * w) * nloc + mloc * nloc
+        + bufs * (mloc * w + w * nloc)
+    )
+    return {
+        "mesh": (r, c),
+        "panels": L,
+        "panel_width": w,
+        "panel_a_elems": mloc * w,
+        "panel_b_elems": w * nloc,
+        "hops": hops,
+        "exact_wire_bytes": exact,
+        "wire_bytes": wire,
+        "bytes_ratio": round(wire / exact, 4) if exact else None,
+        "peak_live_bytes": peak,
+        "critical_path_ms": {
+            "serial": critical_path_ms(
+                wire, hops, compute_ms_per_step, gbps=gbps, overlap=False
+            ),
+            "overlap": critical_path_ms(
+                wire, hops, compute_ms_per_step, gbps=gbps, overlap=True
+            ),
+        },
     }

@@ -77,7 +77,7 @@ from __future__ import annotations
 import contextlib
 import math
 from dataclasses import dataclass
-from typing import Optional, Tuple
+from typing import Optional, Tuple, Union
 
 import numpy as np
 
@@ -97,6 +97,7 @@ __all__ = [
     "Plan",
     "get_redistribution",
     "get_redistribution_threshold",
+    "grid_redistribute_or_none",
     "monolithic_model",
     "plan",
     "plan_cache_size",
@@ -192,8 +193,10 @@ class Plan:
 
     global_shape: Tuple[int, ...]  # TRUE (unpadded) global shape
     dtype: str                     # jnp dtype name
-    src: Optional[int]
-    dst: Optional[int]
+    #: 1-D plans carry split ints; N-D (grid) plans carry splits tuples
+    #: (``splits[d]`` = mesh axis sharding array dim ``d``)
+    src: Union[int, Tuple[Optional[int], ...], None]
+    dst: Union[int, Tuple[Optional[int], ...], None]
     size: int
     mode: Optional[str]            # wire mode of compressible steps
     steps: Tuple[Tuple, ...]
@@ -204,19 +207,30 @@ class Plan:
     #: modeled peak live bytes per device while the program runs
     peak_live_bytes: int
     max_live_bytes: Optional[int] = None
+    #: set on grid plans: the mesh the splits tuples index into.  The
+    #: schedule is the per-mesh-axis 1-D factoring of
+    #: :func:`heat_tpu.comm._costs.grid_plan_cost` — wire bytes sum over
+    #: stages, the peak is the max stage peak, still ONE dispatch.
+    mesh_shape: Optional[Tuple[int, ...]] = None
 
     @property
     def key(self) -> Tuple:
         return (
             self.global_shape, self.dtype, self.src, self.dst,
-            self.size, self.mode, self.steps,
+            self.size, self.mode, self.steps, self.mesh_shape,
         )
 
     @property
     def out_shape(self) -> Tuple[int, ...]:
-        """Global shape of the result: the true shape with a ragged
-        destination axis padded to its canonical length."""
+        """Global shape of the result: the true shape with ragged
+        destination axes padded to their canonical lengths."""
         shape = list(self.global_shape)
+        if self.mesh_shape is not None:
+            for d, g in enumerate(self.dst):
+                if g is not None:
+                    p = self.mesh_shape[g]
+                    shape[d] = p * (-(-shape[d] // p))
+            return tuple(shape)
         if self.dst is not None:
             w = -(-shape[self.dst] // self.size)
             shape[self.dst] = self.size * w
@@ -310,13 +324,27 @@ def clear_plan_cache() -> None:
     _PLANS.clear()
 
 
+def _as_splits(spelling, ndim: int, mesh_ndim: int) -> Tuple[Optional[int], ...]:
+    """Normalize a split spelling (None / int / tuple) to the splits
+    tuple over an ``mesh_ndim``-axis mesh — the 1-D int form promotes to
+    its one-hot tuple on mesh axis 0 (the exact ``split`` compat view)."""
+    if spelling is None:
+        return (None,) * ndim
+    if isinstance(spelling, (tuple, list)):
+        return tuple(None if g is None else int(g) for g in spelling)
+    entries = [None] * ndim
+    entries[int(spelling) % ndim] = 0
+    return tuple(entries)
+
+
 def plan(
     global_shape,
     dtype,
-    src: Optional[int],
-    dst: Optional[int],
+    src,
+    dst,
     size: int,
     *,
+    mesh_shape: Optional[Tuple[int, ...]] = None,
     max_live_bytes: Optional[int] = None,
 ) -> Plan:
     """Plan the redistribution of a ``global_shape`` array committed at
@@ -328,16 +356,48 @@ def plan(
     is rejected — canonically committed inputs are divisible by
     construction, anything else reaches the planner as replicated.
 
+    On an N-D mesh (``mesh_shape`` with more than one axis), ``src`` and
+    ``dst`` are splits TUPLES (``splits[d]`` = mesh axis sharding array
+    dim ``d``; int/None spellings promote via the compat view) and the
+    schedule is the per-mesh-axis 1-D factoring of
+    :func:`heat_tpu.comm._costs.grid_plan_cost` — each stage reuses the
+    rotate/allgather/slice step algebra along one named mesh axis, and
+    the whole chain still executes as ONE compiled dispatch.
+
     ``max_live_bytes`` bounds the modeled per-device peak: a schedule
     that cannot fit raises ``ValueError`` (the split→split rotation
     schedule is already both minimal-traffic and minimal-memory, so the
     bound is a guarantee check, not a search knob — see design.md §14).
+    For grid plans the bound applies to the max over stages.
     """
     shape = tuple(int(s) for s in global_shape)
     ndim = len(shape)
     p = int(size)
     if p < 1:
         raise ValueError(f"mesh size must be >= 1, got {p}")
+    grid = mesh_shape is not None and len(tuple(mesh_shape)) > 1
+    if not grid and (isinstance(src, (tuple, list)) or isinstance(dst, (tuple, list))):
+        # tuple spellings over a 1-D mesh are exactly their compat ints
+        if isinstance(src, (tuple, list)):
+            src = next((d for d, g in enumerate(src) if g == 0), None)
+        if isinstance(dst, (tuple, list)):
+            dst = next((d for d, g in enumerate(dst) if g == 0), None)
+    if grid:
+        mesh_shape = tuple(int(s) for s in mesh_shape)
+        if math.prod(mesh_shape) != p:
+            raise ValueError(
+                f"mesh_shape {mesh_shape} does not tile {p} device(s)"
+            )
+        src = _as_splits(src, ndim, len(mesh_shape))
+        dst = _as_splits(dst, ndim, len(mesh_shape))
+        ckey = (shape, jnp.dtype(dtype).name, src, dst, p, mesh_shape,
+                max_live_bytes) + context_token()
+        cached = _PLANS.get(ckey)
+        if cached is not None:
+            return cached
+        p_obj = _build_grid_plan(shape, dtype, src, dst, mesh_shape, max_live_bytes)
+        _PLANS[ckey] = p_obj
+        return p_obj
     if src is not None:
         src = int(src) % ndim
     if dst is not None:
@@ -380,6 +440,33 @@ def _build_plan(shape, dtype, src, dst, p, max_live_bytes) -> Plan:
         exact_wire_bytes=int(cost["exact_wire_bytes"]),
         peak_live_bytes=int(cost["peak_live_bytes"]),
         max_live_bytes=max_live_bytes,
+    )
+
+
+def _build_grid_plan(shape, dtype, src, dst, mesh_shape, max_live_bytes) -> Plan:
+    # same delegation as _build_plan: the stage factoring AND its byte
+    # arithmetic live in the shared jax-free model
+    dt = jnp.dtype(dtype).name
+    cost = _costs.grid_plan_cost(
+        shape, dt, src, dst, mesh_shape,
+        mode_for=lambda nbytes: _cq.reduce_mode(dtype, nbytes),
+    )
+    if max_live_bytes is not None and cost["peak_live_bytes"] > max_live_bytes:
+        raise ValueError(
+            f"no schedule for {tuple(shape)} {dt} splits {src}->{dst} over "
+            f"mesh {tuple(mesh_shape)} fits max_live_bytes={max_live_bytes}: "
+            f"the minimal factored schedule needs {cost['peak_live_bytes']} "
+            "live bytes per device"
+        )
+    return Plan(
+        global_shape=tuple(shape), dtype=dt, src=src, dst=dst,
+        size=int(math.prod(mesh_shape)),
+        mode=cost["mode"], steps=cost["steps"],
+        wire_bytes=int(cost["wire_bytes"]),
+        exact_wire_bytes=int(cost["exact_wire_bytes"]),
+        peak_live_bytes=int(cost["peak_live_bytes"]),
+        max_live_bytes=max_live_bytes,
+        mesh_shape=tuple(mesh_shape),
     )
 
 
@@ -427,33 +514,28 @@ def _pad_axis(x, axis: int, pad: int):
     return jnp.pad(x, widths)
 
 
-def _make_program(p_obj: Plan, comm):
-    """Build the one compiled program executing ``p_obj`` — a single
-    ``shard_map`` whose body runs every step of the schedule."""
-    mesh, name = comm._mesh, comm.axis_name
-    p = p_obj.size
-    src, dst, mode = p_obj.src, p_obj.dst, p_obj.mode
-    shape = p_obj.global_shape
-    ndim = len(shape)
-    # pipelined rotation schedule under the overlap policy (in every
-    # compiled-program cache key via the registered token)
-    overlapped = overlap_enabled(p)
-
-    if not p_obj.steps:  # identity: let apply_sharding's no-op path handle it
-        return None
+def _axis_kernel(name: str, p: int, src, dst, src_len: int, dst_len: int,
+                 mode: Optional[str], overlapped: bool):
+    """The local body of ONE 1-D redistribution stage along the named
+    mesh axis ``name`` (ring size ``p``) — the rotate/allgather/slice
+    step algebra, parameterized so the 1-D program uses it directly and
+    the grid program chains one stage per mesh axis.  ``src_len`` /
+    ``dst_len`` are the stage-global extents of the moving dims (the
+    whole-array extents for a 1-D plan; the current padded extents of a
+    grid stage, whose other sharded dims are already local inside the
+    grid ``shard_map``)."""
+    if dst is not None:
+        w_d = -(-dst_len // p)
+        pad_d = p * w_d - dst_len
 
     if src is None:
         # replicated -> split: pad (maybe) + dynamic-slice discard
-        w_d = -(-shape[dst] // p)
-        pad_d = p * w_d - shape[dst]
-
         def kernel(x):
             if pad_d:
                 x = _pad_axis(x, dst, pad_d)
             i = jax.lax.axis_index(name)
             return jax.lax.dynamic_slice_in_dim(x, i * w_d, w_d, axis=dst)
 
-        in_spec, out_spec = PartitionSpec(), comm.spec(ndim, dst)
     elif dst is None:
         # split -> replicated: all-gather fraction (compressed ring when
         # the precision policy says so — quantize once, forward bytes)
@@ -465,13 +547,10 @@ def _make_program(p_obj: Plan, comm):
             full = stacked.reshape((p * moved.shape[0],) + moved.shape[1:])
             return jnp.moveaxis(full, 0, src)
 
-        in_spec, out_spec = comm.spec(ndim, src), PartitionSpec()
     else:
         # split -> split: view the local slab as p destination pieces,
         # keep our own, rotate the other p-1 to their owners
-        w_s = shape[src] // p
-        w_d = -(-shape[dst] // p)
-        pad_d = p * w_d - shape[dst]
+        w_s = src_len // p
 
         def kernel(x):
             if pad_d:
@@ -521,13 +600,89 @@ def _make_program(p_obj: Plan, comm):
                     )
             return out
 
-        in_spec, out_spec = comm.spec(ndim, src), comm.spec(ndim, dst)
+    return kernel
+
+
+def _make_program(p_obj: Plan, comm):
+    """Build the one compiled program executing ``p_obj`` — a single
+    ``shard_map`` whose body runs every step of the schedule (a chain of
+    per-mesh-axis ``shard_map`` stages inside the one program for grid
+    plans)."""
+    if not p_obj.steps:  # identity: let apply_sharding's no-op path handle it
+        return None
+    if p_obj.mesh_shape is not None:
+        return _make_grid_program(p_obj, comm)
+    mesh, name = comm._mesh, comm.axis_name
+    p = p_obj.size
+    src, dst, mode = p_obj.src, p_obj.dst, p_obj.mode
+    shape = p_obj.global_shape
+    ndim = len(shape)
+    # pipelined rotation schedule under the overlap policy (in every
+    # compiled-program cache key via the registered token)
+    overlapped = overlap_enabled(p)
+
+    kernel = _axis_kernel(
+        name, p, src, dst,
+        shape[src] if src is not None else 0,
+        shape[dst] if dst is not None else 0,
+        mode, overlapped,
+    )
+    in_spec = PartitionSpec() if src is None else comm.spec(ndim, src)
+    out_spec = PartitionSpec() if dst is None else comm.spec(ndim, dst)
 
     def _f(x):
         return shard_map(
             kernel, mesh=mesh, in_specs=in_spec, out_specs=out_spec,
             check_vma=False,
         )(x)
+
+    return _f
+
+
+def _make_grid_program(p_obj: Plan, comm):
+    """The one compiled program of a grid plan: a chain of per-mesh-axis
+    1-D stages (each a ``shard_map`` over the full grid mesh whose body
+    moves data along ONE named axis via :func:`_axis_kernel`), executed
+    inside a single ``jitted`` program — one dispatch for the whole
+    factored schedule.  Stage order, extents, and wire modes are replayed
+    from :func:`heat_tpu.comm._costs.grid_plan_cost`, the same arithmetic
+    the plan's byte figures came from."""
+    mesh = comm._mesh
+    names = comm.axis_names
+    mesh_shape = p_obj.mesh_shape
+    shape = p_obj.global_shape
+    ndim = len(shape)
+    cost = _costs.grid_plan_cost(
+        shape, p_obj.dtype, p_obj.src, p_obj.dst, mesh_shape,
+        mode_for=lambda nbytes: _cq.reduce_mode(p_obj.dtype, nbytes),
+    )
+    state = list(p_obj.src)
+    ext = list(shape)
+    stage_fns = []
+    for (g, sd, td), mode in zip(cost["stages"], cost["stage_modes"]):
+        p = mesh_shape[g]
+        kernel = _axis_kernel(
+            names[g], p, sd, td,
+            ext[sd] if sd is not None else 0,
+            ext[td] if td is not None else 0,
+            mode, overlap_enabled(p),
+        )
+        in_spec = comm.spec(ndim, tuple(state))
+        if sd is not None:
+            state[sd] = None
+        if td is not None:
+            state[td] = g
+            ext[td] = p * (-(-ext[td] // p))
+        out_spec = comm.spec(ndim, tuple(state))
+        stage_fns.append((kernel, in_spec, out_spec))
+
+    def _f(x):
+        for kernel, in_spec, out_spec in stage_fns:
+            x = shard_map(
+                kernel, mesh=mesh, in_specs=in_spec, out_specs=out_spec,
+                check_vma=False,
+            )(x)
+        return x
 
     return _f
 
@@ -553,6 +708,15 @@ def redistribute(
     from ..core.communication import sanitize_comm
 
     comm = sanitize_comm(comm)
+    if comm.mesh_ndim > 1:
+        if src is None:
+            src = comm._splits_of(array)
+        p_obj = plan(
+            tuple(int(s) for s in array.shape), array.dtype, src, split,
+            comm.size, mesh_shape=comm.mesh_shape,
+            max_live_bytes=max_live_bytes,
+        )
+        return execute(array, p_obj, comm)
     if src is None:
         src = comm._split_axis_of(array)
     p_obj = plan(
@@ -560,6 +724,68 @@ def redistribute(
         max_live_bytes=max_live_bytes,
     )
     return execute(array, p_obj, comm)
+
+
+def grid_redistribute_or_none(array, dst_splits, comm, allow_pad: bool):
+    """The N-D-mesh redistribution-policy seam behind
+    :meth:`XlaCommunication.resplit` / ``commit_split``: the planned grid
+    result, or None when the change stays on the monolithic path.
+
+    Fallback mirrors the 1-D ``_planned_resplit`` contract: policy
+    "monolithic"; tracers and fuse traces; host values and empty arrays;
+    multi-process meshes; sources committed on a foreign mesh, ragged, or
+    non-canonical; ragged destinations when the caller's contract forbids
+    padding.  Policy "auto" additionally demands a sharded→sharded change
+    of at least :func:`get_redistribution_threshold` bytes.
+    """
+    from ..core._tracing import in_trace
+
+    policy = get_redistribution()
+    if policy == "monolithic" or comm.size == 1:
+        return None
+    if isinstance(array, jax.core.Tracer) or in_trace():
+        return None
+    if not isinstance(array, jax.Array) or not getattr(array, "ndim", 0):
+        return None
+    if any(int(s) == 0 for s in array.shape) or jax.process_count() > 1:
+        return None
+    mesh_shape = comm.mesh_shape
+    dst = tuple(dst_splits)
+    src = comm._splits_of(array)
+    if any(g is not None for g in src):
+        if getattr(array.sharding, "mesh", None) != comm._mesh:
+            return None
+        if any(
+            g is not None and int(array.shape[d]) % mesh_shape[g]
+            for d, g in enumerate(src)
+        ):
+            return None  # ragged source: monolithic handles it replicated
+    if src == dst:
+        return None  # no-op: apply_sharding's early-outs are cheaper
+    if not allow_pad and any(
+        g is not None and int(array.shape[d]) % mesh_shape[g]
+        for d, g in enumerate(dst)
+    ):
+        return None
+    if policy == "auto" and (
+        all(g is None for g in src)
+        or all(g is None for g in dst)
+        or _nelems(array.shape) * jnp.dtype(array.dtype).itemsize
+        < get_redistribution_threshold()
+    ):
+        return None
+    p_obj = plan(
+        tuple(int(s) for s in array.shape), array.dtype, src, dst, comm.size,
+        mesh_shape=mesh_shape,
+    )
+    return execute(array, p_obj, comm)
+
+
+def _nelems(shape) -> int:
+    n = 1
+    for s in shape:
+        n *= int(s)
+    return n
 
 
 def execute(array, p_obj: Plan, comm):

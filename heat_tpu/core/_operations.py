@@ -257,7 +257,9 @@ def __local_op(
         result = operation(arr.astype(cast) if cast else arr, **kwargs)
     result = _canonical_result(result)
     dtype = types.canonical_heat_type(result.dtype)
-    result = x.comm.apply_sharding(result, x.split if result.ndim else None)
+    # _layout keeps grid splits tuples intact (the compat int would drop
+    # every mesh axis past the first and mis-unpad the result)
+    result = x.comm.apply_sharding(result, x._layout if result.ndim else None)
     if padded:
         if tuple(result.shape) == tuple(arr.shape):
             # elementwise on the padded buffer: result IS the at-rest buffer
@@ -270,11 +272,11 @@ def __local_op(
                 operation(arr.astype(cast) if cast else arr, **kwargs)
             )
             dtype = types.canonical_heat_type(result.dtype)
-            result = x.comm.apply_sharding(result, x.split if result.ndim else None)
+            result = x.comm.apply_sharding(result, x._layout if result.ndim else None)
             gshape = tuple(result.shape)
     else:
         gshape = tuple(result.shape)
-    wrapped = DNDarray(result, gshape, dtype, x.split, x.device, x.comm, x.balanced)
+    wrapped = DNDarray(result, gshape, dtype, x._layout, x.device, x.comm, x.balanced)
     if out is not None:
         sanitation.sanitize_out(out, wrapped.shape, wrapped.split, x.device)
         out.larray = wrapped.larray.astype(out.dtype.jax_type())

@@ -45,8 +45,14 @@ Kinds (the transfer-function families; ``params`` refine them):
 ``flatten``        any split → 0, replicated stays replicated
 ``resplit``        explicit layout change to the ``axis`` argument —
                    the one declared COMM op (costed by the
-                   redistribution plan model)
-``factory``        new array, split from the ``split=`` keyword
+                   redistribution plan model).  ``axis`` may also be a
+                   splits TUPLE (the N-D mesh spelling): facts stay
+                   tuple-valued and the 1-D int form promotes to its
+                   one-hot tuple automatically
+``factory``        new array, split from the ``split=`` keyword, or a
+                   splits tuple from ``splits=`` — tuple entries name
+                   MESH axes and validate against the target comm's
+                   mesh rank (the default comm's mesh is 1-D)
 ``factory_like``   new array mirroring the input's layout
 ``entry_fit``      estimator entry point returning the estimator itself
 ``entry_split0``   library entry point whose result is row-split iff

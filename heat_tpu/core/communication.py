@@ -69,6 +69,7 @@ __all__ = [
     "use_comm",
     "sanitize_comm",
     "comm_for_device",
+    "grid_comm",
     "init_multihost",
 ]
 
@@ -159,7 +160,7 @@ class Communication:
 
 
 class XlaCommunication(Communication):
-    """A communicator backed by a 1-D JAX device mesh.
+    """A communicator backed by a (1-D or N-D) JAX device mesh.
 
     Parameters
     ----------
@@ -168,15 +169,45 @@ class XlaCommunication(Communication):
         the default platform (the analog of ``MPI_WORLD``,
         reference communication.py:1123).
     axis_name : str
-        Mesh axis name used for collectives inside ``shard_map``.
+        Base mesh axis name used for collectives inside ``shard_map``.  A
+        1-D mesh uses it verbatim (``"heat"``); an N-D mesh derives one
+        name per mesh axis (``"heat0"``, ``"heat1"``, ...).
+    mesh_shape : tuple of int, optional
+        Logical mesh shape.  Defaults to ``(len(devices),)`` — the 1-D
+        communicator every existing call site gets.  A 2-D shape ``(r, c)``
+        arranges the same devices on an r×c grid; array layouts over it are
+        *splits tuples* (``splits[d]`` = the mesh axis sharding array dim
+        ``d``, or None), with the legacy single ``split`` int an exact view
+        of the tuple layouts that shard only mesh axis 0.
     """
 
-    def __init__(self, devices: Optional[Sequence] = None, axis_name: str = MESH_AXIS):
+    def __init__(
+        self,
+        devices: Optional[Sequence] = None,
+        axis_name: str = MESH_AXIS,
+        mesh_shape: Optional[Tuple[int, ...]] = None,
+    ):
         if devices is None:
             devices = jax.devices()
         self._devices = list(devices)
-        self.axis_name = axis_name
-        self._mesh = Mesh(np.asarray(self._devices), (axis_name,))
+        if mesh_shape is None:
+            mesh_shape = (len(self._devices),)
+        mesh_shape = tuple(int(s) for s in mesh_shape)
+        if any(s < 1 for s in mesh_shape) or math.prod(mesh_shape) != len(self._devices):
+            raise ValueError(
+                f"mesh_shape {mesh_shape} does not tile {len(self._devices)} device(s)"
+            )
+        self._mesh_shape = mesh_shape
+        if len(mesh_shape) == 1:
+            # the 1-D axis name stays exactly `axis_name` ("heat") so every
+            # existing kernel, cache key, and committed sharding is unchanged
+            self._axis_names: Tuple[str, ...] = (axis_name,)
+        else:
+            self._axis_names = tuple(f"{axis_name}{i}" for i in range(len(mesh_shape)))
+        self.axis_name = self._axis_names[0]
+        self._mesh = Mesh(
+            np.asarray(self._devices).reshape(mesh_shape), self._axis_names
+        )
 
     # ------------------------------------------------------------------ #
     # identity / geometry                                                #
@@ -188,8 +219,23 @@ class XlaCommunication(Communication):
 
     @property
     def mesh(self) -> Mesh:
-        """The 1-D :class:`jax.sharding.Mesh` backing this communicator."""
+        """The :class:`jax.sharding.Mesh` backing this communicator."""
         return self._mesh
+
+    @property
+    def mesh_shape(self) -> Tuple[int, ...]:
+        """Logical mesh shape; ``(size,)`` for the default 1-D communicator."""
+        return self._mesh_shape
+
+    @property
+    def mesh_ndim(self) -> int:
+        """Number of mesh axes (1 for every legacy communicator)."""
+        return len(self._mesh_shape)
+
+    @property
+    def axis_names(self) -> Tuple[str, ...]:
+        """Mesh axis names; ``("heat",)`` 1-D, ``("heat0", "heat1")`` 2-D."""
+        return self._axis_names
 
     @property
     def size(self) -> int:
@@ -227,17 +273,76 @@ class XlaCommunication(Communication):
 
     def __repr__(self) -> str:
         plat = self._devices[0].platform if self._devices else "?"
-        return f"XlaCommunication({self.size} {plat} device(s), axis='{self.axis_name}')"
+        grid = "x".join(str(s) for s in self._mesh_shape)
+        return f"XlaCommunication({self.size} {plat} device(s), mesh={grid}, axis='{self.axis_name}')"
 
     def __eq__(self, other) -> bool:
         return (
             isinstance(other, XlaCommunication)
             and self._devices == other._devices
             and self.axis_name == other.axis_name
+            and self._mesh_shape == other._mesh_shape
         )
 
     def __hash__(self) -> int:
-        return hash((tuple(id(d) for d in self._devices), self.axis_name))
+        return hash((tuple(id(d) for d in self._devices), self.axis_name, self._mesh_shape))
+
+    # ------------------------------------------------------------------ #
+    # splits tuples (the N-D layout vocabulary)                           #
+    # ------------------------------------------------------------------ #
+    def normalize_splits(
+        self, ndim: int, split: Union[None, int, Sequence[Optional[int]]]
+    ) -> Tuple[Optional[int], ...]:
+        """Canonicalize any layout spelling to a splits tuple.
+
+        ``splits[d]`` names the mesh axis sharding array dimension ``d``
+        (or None).  The three accepted spellings:
+
+        * ``None`` — fully replicated, ``(None,) * ndim``;
+        * an int ``s`` — the legacy 1-axis layout: dim ``s`` sharded over
+          mesh axis 0 (negative ``s`` counts from the end, as before);
+        * a sequence of length ``ndim`` of mesh-axis indices / Nones.
+
+        A mesh axis may shard at most one array dimension (a
+        :class:`~jax.sharding.PartitionSpec` invariant).
+        """
+        ndim = int(ndim)
+        if split is None:
+            return (None,) * ndim
+        if isinstance(split, (tuple, list)):
+            splits = tuple(None if g is None else int(g) for g in split)
+            if len(splits) != ndim:
+                raise ValueError(
+                    f"splits {splits} has arity {len(splits)}, array has ndim {ndim}"
+                )
+            used = [g for g in splits if g is not None]
+            for g in used:
+                if not 0 <= g < self.mesh_ndim:
+                    raise ValueError(
+                        f"splits {splits}: mesh axis {g} out of range for a "
+                        f"{self.mesh_ndim}-D mesh of shape {self._mesh_shape}"
+                    )
+            if len(set(used)) != len(used):
+                raise ValueError(f"splits {splits} uses a mesh axis more than once")
+            return splits
+        entries: List[Optional[int]] = [None] * ndim
+        entries[int(split)] = 0  # negative ints index from the end, as before
+        return tuple(entries)
+
+    @staticmethod
+    def split_view(splits: Tuple[Optional[int], ...]) -> Optional[int]:
+        """The legacy ``split`` int of a splits tuple: the array dimension
+        sharded by mesh axis 0 (None when axis 0 shards nothing).  Exact
+        and lossless on a 1-D mesh — the only mesh legacy layouts live on."""
+        for d, g in enumerate(splits):
+            if g == 0:
+                return d
+        return None
+
+    def _axis_size(self, mesh_axis: Optional[int] = None) -> int:
+        """Devices along one mesh axis; the whole mesh when ``None`` (the
+        legacy 1-D reading, where axis 0 *is* the mesh)."""
+        return self.size if mesh_axis is None else int(self._mesh_shape[mesh_axis])
 
     # ------------------------------------------------------------------ #
     # shard geometry (reference: chunk, communication.py:82-169)          #
@@ -268,6 +373,8 @@ class XlaCommunication(Communication):
         shape = tuple(int(s) for s in shape)
         if split is None:
             return 0, shape, tuple(slice(0, s) for s in shape)
+        if isinstance(split, (tuple, list)):
+            return self._chunk_grid(shape, tuple(split), rank)
         split = int(split) % max(len(shape), 1)
         n = shape[split]
         c = -(-n // self.size) if n else 0  # ceil division
@@ -278,6 +385,30 @@ class XlaCommunication(Communication):
             slice(start, stop) if dim == split else slice(0, s) for dim, s in enumerate(shape)
         )
         return start, lshape, slices
+
+    def _chunk_grid(
+        self, shape: Tuple[int, ...], splits: Tuple[Optional[int], ...], rank: int
+    ) -> Tuple[int, Tuple[int, ...], Tuple[slice, ...]]:
+        """Splits-tuple shard geometry: ``rank`` is a flat row-major mesh
+        position; each sharded dim divides ceil-wise over its own mesh axis.
+        The returned scalar offset is the one along the mesh-axis-0 dim (the
+        ``split`` compat view's axis; 0 when axis 0 shards nothing)."""
+        splits = self.normalize_splits(len(shape), splits)
+        pos = np.unravel_index(int(rank) % max(self.size, 1), self._mesh_shape)
+        lshape, slices, offset0 = [], [], 0
+        for dim, (n, g) in enumerate(zip(shape, splits)):
+            if g is None:
+                lshape.append(n)
+                slices.append(slice(0, n))
+                continue
+            c = self.shard_width(n, mesh_axis=g)
+            start = min(int(pos[g]) * c, n)
+            stop = min((int(pos[g]) + 1) * c, n)
+            lshape.append(stop - start)
+            slices.append(slice(start, stop))
+            if g == 0:
+                offset0 = start
+        return offset0, tuple(lshape), tuple(slices)
 
     def counts_displs_shape(
         self, shape: Sequence[int], split: int
@@ -309,38 +440,51 @@ class XlaCommunication(Communication):
     # thereby defined for *any* axis length, including prime-mesh ragged
     # cases; results are sliced back with :meth:`unpad`.
 
-    def shard_width(self, n: int) -> int:
+    def shard_width(self, n: int, mesh_axis: Optional[int] = None) -> int:
         """Width of every (padded) shard of an axis of length ``n``:
-        ``ceil(n / size)`` — the GSPMD layout rule."""
+        ``ceil(n / p)`` — the GSPMD layout rule.  ``p`` is the whole mesh
+        (legacy 1-D reading) unless ``mesh_axis`` selects one grid axis."""
         n = int(n)
-        return -(-n // self.size) if n else 0
+        return -(-n // self._axis_size(mesh_axis)) if n else 0
 
-    def padded_size(self, n: int) -> int:
-        """Padded axis length ``size * shard_width(n)`` (≥ n)."""
-        return self.size * self.shard_width(n)
+    def padded_size(self, n: int, mesh_axis: Optional[int] = None) -> int:
+        """Padded axis length ``p * shard_width(n)`` (≥ n)."""
+        return self._axis_size(mesh_axis) * self.shard_width(n, mesh_axis)
 
-    def valid_counts(self, n: int) -> Tuple[int, ...]:
+    def valid_counts(self, n: int, mesh_axis: Optional[int] = None) -> Tuple[int, ...]:
         """Per-position count of real (un-padded) rows along an axis of
         length ``n``: position r holds global rows
         ``[r*c, min((r+1)*c, n))`` of the padded layout.  The analog of the
         reference's Allgatherv/Scatterv counts vector
         (communication.py:138-169)."""
-        c = self.shard_width(n)
+        c = self.shard_width(n, mesh_axis)
         n = int(n)
-        return tuple(min(c, max(0, n - r * c)) for r in range(self.size))
+        return tuple(min(c, max(0, n - r * c)) for r in range(self._axis_size(mesh_axis)))
 
-    def pad_to_shards(self, array: jax.Array, axis: int = 0) -> jax.Array:
-        """Zero-pad ``axis`` to the canonical padded length and shard it.
+    def pad_to_shards(self, array: jax.Array, axis: int = 0, splits=None) -> jax.Array:
+        """Zero-pad the sharded axes to their canonical padded lengths and
+        commit the layout.
 
-        After this, ``array.shape[axis] % size == 0`` and every explicit
-        shard_map algorithm applies; the invalid tail rows of each shard are
-        zeros.  No-op (bar the sharding) for already-divisible axes.
+        Legacy form (``axis``): pad ``axis`` so ``shape[axis] % size == 0``.
+        Splits form (``splits``): pad every dim a mesh axis shards to that
+        *axis's* width — dim ``d`` with ``splits[d] = g`` pads to
+        ``padded_size(n_d, mesh_axis=g)``.  On a 1-D mesh the two forms
+        coincide exactly.  After this every explicit shard_map algorithm
+        applies; the invalid tail rows of each shard are zeros.  No-op (bar
+        the sharding) for already-divisible axes.
         """
-        n = int(array.shape[axis])
-        pad = self.padded_size(n) - n
-        if pad:
-            widths = [(0, 0)] * array.ndim
-            widths[axis] = (0, pad)
+        if splits is None:
+            splits = self.normalize_splits(array.ndim, axis)
+        else:
+            splits = self.normalize_splits(array.ndim, splits)
+        widths = []
+        for d, g in enumerate(splits):
+            if g is None:
+                widths.append((0, 0))
+                continue
+            n = int(array.shape[d])
+            widths.append((0, self.padded_size(n, mesh_axis=g) - n))
+        if any(w for _, w in widths):
 
             def make():
                 def _pad(x):
@@ -349,7 +493,7 @@ class XlaCommunication(Communication):
                 return _pad
 
             array = jitted(("comm.pad", self, tuple(widths), array.ndim), make)(array)
-        return self.apply_sharding(array, axis)
+        return self.apply_sharding(array, splits)
 
     def unpad(self, array: jax.Array, n: int, axis: int = 0) -> jax.Array:
         """Slice a padded axis back to its true length ``n``."""
@@ -362,19 +506,26 @@ class XlaCommunication(Communication):
     # ------------------------------------------------------------------ #
     # shardings                                                          #
     # ------------------------------------------------------------------ #
-    def spec(self, ndim: int, split: Optional[int]) -> PartitionSpec:
-        """PartitionSpec placing the mesh axis at dimension ``split``."""
+    def spec(self, ndim: int, split) -> PartitionSpec:
+        """PartitionSpec for a layout — ``split`` in any of the spellings
+        :meth:`normalize_splits` accepts (None / int / splits tuple)."""
         if split is None:
             return PartitionSpec()
-        entries = [None] * ndim
-        entries[split] = self.axis_name
+        splits = self.normalize_splits(ndim, split)
+        if all(g is None for g in splits):
+            # canonical replicated spec: callers compare shardings for
+            # their no-op early-outs, and PartitionSpec(None, None) !=
+            # PartitionSpec() even though the layouts are identical
+            return PartitionSpec()
+        entries = [None if g is None else self._axis_names[g] for g in splits]
         return PartitionSpec(*entries)
 
-    def sharding(self, ndim: int, split: Optional[int]) -> NamedSharding:
-        """NamedSharding for an ``ndim``-dimensional array split at ``split``."""
+    def sharding(self, ndim: int, split) -> NamedSharding:
+        """NamedSharding for an ``ndim``-dimensional array laid out at
+        ``split`` (int, None, or splits tuple)."""
         return NamedSharding(self._mesh, self.spec(ndim, split))
 
-    def apply_sharding(self, array: jax.Array, split: Optional[int]) -> jax.Array:
+    def apply_sharding(self, array: jax.Array, split) -> jax.Array:
         """Lay out a global array according to ``split``.
 
         Exact :func:`jax.device_put` when the split axis is divisible by the
@@ -402,7 +553,12 @@ class XlaCommunication(Communication):
                 return array
             split = None
         sh = self.sharding(array.ndim, split)
-        if split is None or array.shape[split] % self.size == 0:
+        splits = self.normalize_splits(array.ndim, split)
+        divisible = all(
+            g is None or array.shape[d] % self._axis_size(g) == 0
+            for d, g in enumerate(splits)
+        )
+        if divisible:
             return _reshard(array, sh)
         if os.environ.get("HEAT_DEBUG_RAGGED_COMMIT") == "1":
             # the memory-hazard tripwire: THIS branch (and only this
@@ -506,6 +662,9 @@ class XlaCommunication(Communication):
         (:mod:`heat_tpu.comm.redistribute`) — same values, bounded peak
         memory, one dispatch; everything else takes the monolithic GSPMD
         reshard."""
+        split = self._collapse_layout(getattr(array, "ndim", 0), split)
+        if self.mesh_ndim > 1:
+            return self._grid_resplit(array, split, allow_pad=False)
         out = self._planned_resplit(array, split, allow_pad=False)
         if out is not None:
             return out
@@ -520,12 +679,44 @@ class XlaCommunication(Communication):
         the redistribution planner like :meth:`resplit` (the planner's
         schedules pad ragged target axes themselves, preserving this
         method's padded at-rest contract)."""
+        split = self._collapse_layout(getattr(array, "ndim", 0), split)
+        if self.mesh_ndim > 1:
+            return self._grid_resplit(array, split, allow_pad=True)
         out = self._planned_resplit(array, split, allow_pad=True)
         if out is not None:
             return out
         if split is not None and array.ndim and array.shape[split] % max(self.size, 1):
             return self.pad_to_shards(array, axis=split)
         return self.apply_sharding(array, split)
+
+    def _collapse_layout(self, ndim: int, split):
+        """On a 1-D mesh a splits tuple is exactly its ``split`` compat int
+        — collapse it so the legacy planner/reshard paths apply verbatim.
+        N-D meshes keep the tuple."""
+        if self.mesh_ndim == 1 and isinstance(split, (tuple, list)):
+            return self.split_view(self.normalize_splits(ndim, split))
+        return split
+
+    def _grid_resplit(self, array: jax.Array, split, allow_pad: bool) -> jax.Array:
+        """Layout change on an N-D mesh: the 2-D redistribution planner
+        when eligible (one compiled dispatch, bounded peak memory,
+        per-mesh-axis factored schedule), else the monolithic GSPMD
+        reshard — padding ragged target dims first when the caller's
+        contract allows (``commit_split``)."""
+        from ..comm import redistribute as _rd
+
+        splits = self.normalize_splits(getattr(array, "ndim", 0) or 0, split)
+        out = _rd.grid_redistribute_or_none(array, splits, comm=self, allow_pad=allow_pad)
+        if out is not None:
+            return out
+        if allow_pad and getattr(array, "ndim", 0):
+            ragged = any(
+                g is not None and int(array.shape[d]) % self._axis_size(g)
+                for d, g in enumerate(splits)
+            )
+            if ragged:
+                return self.pad_to_shards(array, splits=splits)
+        return self.apply_sharding(array, splits)
 
     def _planned_resplit(
         self, array: jax.Array, split: Optional[int], allow_pad: bool
@@ -724,6 +915,28 @@ class XlaCommunication(Communication):
             if entry is not None:
                 return ax
         return None
+
+    def _splits_of(self, array: jax.Array) -> Tuple[Optional[int], ...]:
+        """Committed splits tuple of a global array — ``splits[d]`` is the
+        index of this mesh's axis named in the array's PartitionSpec at dim
+        ``d``.  All-None for replicated arrays, tracers (no committed
+        sharding), and arrays committed on a foreign mesh's axis names."""
+        ndim = int(getattr(array, "ndim", 0) or 0)
+        blank = (None,) * ndim
+        if isinstance(array, jax.core.Tracer):
+            return blank
+        spec = getattr(getattr(array, "sharding", None), "spec", None)
+        if spec is None:
+            return blank
+        name_to_axis = {nm: i for i, nm in enumerate(self._axis_names)}
+        splits = [None] * ndim
+        for d, entry in enumerate(spec):
+            if entry is None or d >= ndim:
+                continue
+            for nm in entry if isinstance(entry, tuple) else (entry,):
+                if nm in name_to_axis:
+                    splits[d] = name_to_axis[nm]
+        return tuple(splits)
 
     def bcast(self, array: jax.Array, root: int = 0) -> jax.Array:
         """Replicate mesh position ``root``'s shard everywhere: the
@@ -933,6 +1146,28 @@ def sanitize_comm(comm: Optional[Communication]) -> XlaCommunication:
     if not isinstance(comm, XlaCommunication):
         raise TypeError(f"expected an XlaCommunication or None, got {type(comm)}")
     return comm
+
+
+_grid_comms: dict = {}
+
+
+def grid_comm(mesh_shape: Sequence[int], devices: Optional[Sequence] = None) -> XlaCommunication:
+    """Communicator arranging devices on an N-D grid (cached per shape).
+
+    ``grid_comm((2, 4))`` reshapes the default platform's devices onto a
+    2×4 mesh with axis names ``("heat0", "heat1")``; arrays created with
+    ``splits`` tuples over it shard both dimensions at once.  The default
+    1-D communicator is untouched — grid communicators are always explicit
+    objects, so every legacy layout keeps its exact mesh and cache keys.
+    """
+    mesh_shape = tuple(int(s) for s in mesh_shape)
+    if devices is not None:
+        return XlaCommunication(devices, mesh_shape=mesh_shape)
+    if mesh_shape not in _grid_comms:
+        _grid_comms[mesh_shape] = XlaCommunication(
+            jax.devices()[: math.prod(mesh_shape)], mesh_shape=mesh_shape
+        )
+    return _grid_comms[mesh_shape]
 
 
 def comm_for_device(platform: str) -> XlaCommunication:

@@ -170,17 +170,30 @@ class DNDarray:
     ):
         self.__gshape = tuple(int(s) for s in gshape)
         self.__dtype = dtype
-        if split is not None and self.__gshape:
-            ndim = len(self.__gshape)
-            if not -ndim <= split < ndim:
-                raise ValueError(
-                    f"split axis {split} out of range for {ndim}-dimensional "
-                    f"shape {self.__gshape}"
-                )
-            split = int(split) % ndim  # normalize negatives only
-        self.__split = split
         self.__device = device
         self.__comm = comm
+        ndim = len(self.__gshape)
+        if isinstance(split, (tuple, list)):
+            # splits-tuple spelling: splits[d] = mesh axis sharding dim d.
+            # The legacy `split` int becomes the exact compat view (the dim
+            # mesh axis 0 shards — lossless on a 1-D mesh).
+            splits = comm.normalize_splits(ndim, split)
+            split = comm.split_view(splits)
+        else:
+            if split is not None and self.__gshape:
+                if not -ndim <= split < ndim:
+                    raise ValueError(
+                        f"split axis {split} out of range for {ndim}-dimensional "
+                        f"shape {self.__gshape}"
+                    )
+                split = int(split) % ndim  # normalize negatives only
+            splits = (
+                comm.normalize_splits(ndim, split)
+                if (self.__gshape or split is None)
+                else (None,) * ndim
+            )
+        self.__split = split
+        self.__splits = splits
         self.__balanced = True if balanced is None else bool(balanced)
         self.__true_view = None
         self.__halo_prev = None
@@ -189,30 +202,37 @@ class DNDarray:
         self.__array = self.__commit(array)
 
     def __commit(self, array) -> jax.Array:
-        """Normalize ``array`` to the at-rest invariant: a ragged split axis
-        (gshape[split] not divisible by the mesh) is zero-padded to the
-        canonical length and committed sharded.  Accepts either the
-        true-shape array or an already-padded buffer; divisible/replicated
-        arrays pass through untouched (sharding them stays the caller's
-        job, as before)."""
-        split = self.__split
-        if split is None or not self.__gshape:
+        """Normalize ``array`` to the at-rest invariant: every ragged
+        sharded dim (gshape[d] not divisible by its mesh axis) is
+        zero-padded to the canonical length and committed sharded.  Accepts
+        either the true-shape array or an already-padded buffer, per dim;
+        divisible/replicated arrays pass through untouched (sharding them
+        stays the caller's job, as before)."""
+        splits = self.__splits
+        if not self.__gshape or all(g is None for g in splits):
             return array
         comm = self.__comm
-        n = self.__gshape[split]
-        pn = comm.padded_size(n)
-        if pn == n:
+        needs_pad = False
+        for d, g in enumerate(splits):
+            if g is None:
+                continue
+            n = self.__gshape[d]
+            pn = comm.padded_size(n, mesh_axis=g)
+            if pn == n:
+                continue
+            have = int(array.shape[d])
+            if have == pn:
+                continue  # this dim is already at rest
+            if have != n:
+                raise ValueError(
+                    f"backing array axis {d} has length {have}; expected the "
+                    f"true length {n} or the padded length {pn} for gshape "
+                    f"{self.__gshape} over mesh {comm.mesh_shape}"
+                )
+            needs_pad = True
+        if not needs_pad:
             return array
-        have = int(array.shape[split])
-        if have == pn:
-            return array  # already the at-rest buffer
-        if have != n:
-            raise ValueError(
-                f"backing array axis {split} has length {have}; expected the "
-                f"true length {n} or the padded length {pn} for gshape "
-                f"{self.__gshape} over {comm.size} devices"
-            )
-        return comm.pad_to_shards(array, axis=split)
+        return comm.pad_to_shards(array, splits=splits)
 
     # ------------------------------------------------------------------ #
     # metadata properties (reference dndarray.py:95-360)                  #
@@ -262,14 +282,21 @@ class DNDarray:
         replicates those), so scale pipelines consume :attr:`_buffer`.
         """
         arr = self.__array
-        split = self.__split
-        if split is None or not self.__gshape:
+        splits = self.__splits
+        if not self.__gshape or all(g is None for g in splits):
             return arr
-        n = self.__gshape[split]
-        if int(arr.shape[split]) == n:
+        padded_dims = tuple(
+            d
+            for d, g in enumerate(splits)
+            if g is not None and int(arr.shape[d]) != self.__gshape[d]
+        )
+        if not padded_dims:
             return arr
         if self.__true_view is None:
-            self.__true_view = self.__comm.unpad(arr, n, split)
+            view = arr
+            for d in padded_dims:
+                view = self.__comm.unpad(view, self.__gshape[d], d)
+            self.__true_view = view
         return self.__true_view
 
     @larray.setter
@@ -298,25 +325,34 @@ class DNDarray:
     def _zeroed_buffer(self) -> jax.Array:
         """The at-rest buffer with pad rows forced to zero — still padded
         and sharded (no boundary crossing).  For consumers that assume the
-        canonical zero fill (halo exchange)."""
+        canonical zero fill (halo exchange, SUMMA's contraction-axis
+        operands).  Zeroes every padded sharded dim, so grid layouts with
+        two ragged dims come back fully masked."""
         arr = self.__array
-        split = self.__split
-        if split is None or not self.__gshape:
+        splits = self.__splits
+        if not self.__gshape or all(g is None for g in splits):
             return arr
-        n = self.__gshape[split]
-        pn = int(arr.shape[split])
-        if pn == n:
+        dims = tuple(
+            (d, self.__gshape[d])
+            for d, g in enumerate(splits)
+            if g is not None and int(arr.shape[d]) != self.__gshape[d]
+        )
+        if not dims:
             return arr
         comm = self.__comm
 
         def make():
             def _z(x):
-                idx = jax.lax.broadcasted_iota(jnp.int32, x.shape, split)
-                return jnp.where(idx < n, x, jnp.zeros((), x.dtype))
+                mask = None
+                for d, n in dims:
+                    m = jax.lax.broadcasted_iota(jnp.int32, x.shape, d) < n
+                    mask = m if mask is None else mask & m
+                return jnp.where(mask, x, jnp.zeros((), x.dtype))
 
             return _z
 
-        return jitted(("dnd.zeropad", comm, split, n, pn, arr.ndim), make)(arr)
+        key = ("dnd.zeropad", comm, splits, dims, tuple(int(s) for s in arr.shape))
+        return jitted(key, make)(arr)
 
     @property
     def lloc(self) -> LocalIndex:
@@ -330,7 +366,7 @@ class DNDarray:
         mesh position 0; on multihost (init_multihost) it is the first
         position owned by THIS process."""
         _, lshape, _ = self.__comm.chunk(
-            self.__gshape, self.__split, rank=self.__comm.local_position()
+            self.__gshape, self._layout, rank=self.__comm.local_position()
         )
         return lshape
 
@@ -379,7 +415,28 @@ class DNDarray:
 
     @property
     def split(self) -> Optional[int]:
-        """The sharded axis, or None when replicated (reference dndarray.py:321)."""
+        """The sharded axis, or None when replicated (reference dndarray.py:321).
+
+        On an N-D grid comm this is the exact *compat view* of
+        :attr:`splits`: the array dim mesh axis 0 shards.  Every layout a
+        1-D mesh can express round-trips through it losslessly."""
+        return self.__split
+
+    @property
+    def splits(self) -> Tuple[Optional[int], ...]:
+        """Mesh-axis layout tuple: ``splits[d]`` is the mesh axis sharding
+        array dim ``d`` (None = unsharded).  ``(0, 1)`` on a 2-D grid comm
+        is the SUMMA block layout — dim 0 over mesh rows, dim 1 over mesh
+        columns.  On the default 1-D mesh this is the one-hot spelling of
+        :attr:`split`."""
+        return self.__splits
+
+    @property
+    def _layout(self):
+        """The layout in the spelling comm methods historically expect:
+        the legacy int on a 1-D mesh (exact), the splits tuple on a grid."""
+        if getattr(self.__comm, "mesh_ndim", 1) > 1:
+            return self.__splits
         return self.__split
 
     @property
@@ -423,7 +480,7 @@ class DNDarray:
         SingleDeviceSharding (the apply_sharding fast path skips the
         device_put), but the NamedSharding contract — ``.spec`` access,
         mesh introspection — holds either way."""
-        return self.__comm.sharding(self.ndim, self.__split)
+        return self.__comm.sharding(self.ndim, self._layout)
 
     # ------------------------------------------------------------------ #
     # conversion / export                                                #
@@ -434,7 +491,7 @@ class DNDarray:
         casted = self.__array.astype(dtype.jax_type())
         if copy:
             return DNDarray(
-                casted, self.shape, dtype, self.split, self.device, self.comm, self.balanced
+                casted, self.shape, dtype, self._layout, self.device, self.comm, self.balanced
             )
         self.__array = casted
         self.__dtype = dtype
@@ -557,7 +614,7 @@ class DNDarray:
         ndim = max(self.ndim, 1)
         out = np.zeros((size, ndim), dtype=np.int64)
         for r in range(size):
-            _, lshape, _ = self.__comm.chunk(self.__gshape, self.__split, rank=r)
+            _, lshape, _ = self.__comm.chunk(self.__gshape, self._layout, rank=r)
             out[r, : len(lshape)] = lshape
         return out
 
@@ -606,12 +663,36 @@ class DNDarray:
     def resplit_(self, axis: Optional[int] = None) -> "DNDarray":
         """In-place re-shard along ``axis`` (reference dndarray.py:2801-2921:
         split→None = Allgatherv, None→split = local slicing, split→split =
-        tile shuffle; here one XLA reshard covers all three)."""
+        tile shuffle; here one XLA reshard covers all three).
+
+        ``axis`` also accepts a splits tuple: on a grid comm this is the
+        native spelling (e.g. ``(0, 1)`` = block layout), routed through
+        the 2-D redistribution planner; on a 1-D mesh it collapses to its
+        exact ``split`` compat int first."""
+        comm = self.__comm
+        grid = getattr(comm, "mesh_ndim", 1) > 1
+        if isinstance(axis, (tuple, list)) or grid:
+            if not isinstance(axis, (tuple, list)):
+                axis = sanitize_axis(self.shape, axis)
+            splits = comm.normalize_splits(self.ndim, axis)
+            if not grid:
+                axis = comm.split_view(splits)  # exact on 1-D: legacy path below
+            else:
+                if splits == self.__splits:
+                    return self
+                true = self.larray
+                self.__splits = splits
+                self.__split = comm.split_view(splits)
+                self.__array = comm.commit_split(true, splits)
+                self.__balanced = True
+                self._invalidate_halos()
+                return self
         axis = sanitize_axis(self.shape, axis)
         if axis == self.__split:
             return self
         true = self.larray
         self.__split = axis
+        self.__splits = comm.normalize_splits(self.ndim, axis)
         # commit_split pads+shards ragged target axes in one step
         self.__array = self.__comm.commit_split(true, axis)
         self.__balanced = True
@@ -1086,6 +1167,7 @@ class DNDarray:
                 f"doesn't match the broadcast shape {tuple(res.shape)}"
             )
         self.__array, self.__dtype, self.__split = res._buffer, res.dtype, res.split
+        self.__splits = res.splits
         self._invalidate_halos()
         return self
 
@@ -1559,15 +1641,15 @@ class DNDarray:
 
         return basics.triu(self, k)
 
-    def dot(self, other):
+    def dot(self, other, out=None):
         from .linalg import basics
 
-        return basics.dot(self, other)
+        return basics.dot(self, other, out=out)
 
-    def matmul(self, other):
+    def matmul(self, other, out=None, precision=None):
         from .linalg import basics
 
-        return basics.matmul(self, other)
+        return basics.matmul(self, other, out=out, precision=precision)
 
     def qr(self, tiles_per_proc=1, calc_q=True, overwrite_a=False):
         from .linalg.qr import qr as _qr

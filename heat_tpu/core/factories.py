@@ -60,14 +60,30 @@ def _setup(device, comm):
 
 
 def _wrap(garr: jax.Array, dtype, split, device, comm) -> DNDarray:
-    """Lay out a freshly built global array and wrap it."""
+    """Lay out a freshly built global array and wrap it.  ``split`` may be
+    the legacy int or a splits tuple over the comm's mesh."""
     split = split if garr.ndim else None
     gshape = tuple(garr.shape)
-    if split is None or gshape[split] % max(comm.size, 1) == 0:
+    splits = comm.normalize_splits(garr.ndim, split)
+    if all(g is None or gshape[d] % comm._axis_size(g) == 0 for d, g in enumerate(splits)):
         garr = comm.apply_sharding(garr, split)
     # ragged split: skip the (replicated) boundary commit — the DNDarray
-    # constructor pads the axis and commits it sharded in one step
+    # constructor pads the axes and commits them sharded in one step
     return DNDarray(garr, gshape, dtype, split, device, comm, True)
+
+
+def _resolve_layout(shape, split, splits, comm):
+    """One layout from the two spellings: ``splits`` (a mesh-axis tuple,
+    validated against the comm's mesh rank) wins when given; the legacy
+    ``split`` int passes through :func:`sanitize_axis` as before.  The two
+    are mutually exclusive, like ``split``/``is_split``."""
+    if splits is not None:
+        if split is not None:
+            raise ValueError("split and splits are mutually exclusive parameters")
+        return comm.normalize_splits(len(tuple(shape)), splits)
+    if isinstance(split, (tuple, list)):
+        return comm.normalize_splits(len(tuple(shape)), split)
+    return sanitize_axis(tuple(shape), split)
 
 
 def array(
@@ -80,6 +96,7 @@ def array(
     is_split: Optional[int] = None,
     device=None,
     comm=None,
+    splits=None,
 ) -> DNDarray:
     """The master constructor (reference factories.py:138-443).
 
@@ -87,10 +104,14 @@ def array(
     array along an axis; ``is_split`` declares that ``obj`` is a sequence of
     per-position local pieces to be concatenated along that axis (the
     single-controller reading of the reference's "each rank passes its local
-    chunk", factories.py:387-430).
+    chunk", factories.py:387-430).  ``splits`` is the N-D mesh spelling —
+    a tuple assigning a mesh axis of ``comm`` to each array dim (e.g.
+    ``splits=(0, 1)`` on a :func:`heat_tpu.grid_comm` blocks both dims).
     """
     if split is not None and is_split is not None:
         raise ValueError("split and is_split are mutually exclusive parameters")
+    if splits is not None and (split is not None or is_split is not None):
+        raise ValueError("splits is mutually exclusive with split/is_split")
     device, comm = _setup(device, comm)
     sanitize_memory_layout(None, order)
 
@@ -105,8 +126,11 @@ def array(
     # unpack existing containers
     if isinstance(obj, DNDarray):
         garr = obj.larray
-        if split is None and is_split is None:
-            split = obj.split
+        if split is None and is_split is None and splits is None:
+            # keep the source's full grid layout when it lives on this comm;
+            # a foreign comm's mesh axes mean nothing here, so fall back to
+            # the compat int (the pre-grid behavior)
+            split = obj._layout if obj.comm == comm else obj.split
     elif isinstance(obj, (jnp.ndarray, jax.Array)):
         garr = obj
     else:
@@ -155,8 +179,8 @@ def array(
     if ndmin_abs > 0:
         garr = garr.reshape((1,) * ndmin_abs + tuple(garr.shape))
 
-    split = sanitize_axis(garr.shape, split)
-    return _wrap(garr, dtype, split, device, comm)
+    layout = _resolve_layout(garr.shape, split, splits, comm)
+    return _wrap(garr, dtype, layout, device, comm)
 
 
 def asarray(obj, dtype=None, order="C", is_split=None, device=None) -> DNDarray:
@@ -194,37 +218,37 @@ def arange(*args, dtype=None, split=None, device=None, comm=None) -> DNDarray:
     return _wrap(garr, dtype, split, device, comm)
 
 
-def __factory(shape, dtype, split, builder, device, comm, order="C") -> DNDarray:
+def __factory(shape, dtype, split, builder, device, comm, order="C", splits=None) -> DNDarray:
     """Shared constructor core (reference __factory, factories.py:644-684)."""
     shape = sanitize_shape(shape)
     dtype = types.canonical_heat_type(dtype)
-    split = sanitize_axis(shape, split)
     device, comm = _setup(device, comm)
+    layout = _resolve_layout(shape, split, splits, comm)
     sanitize_memory_layout(None, order)
     garr = builder(shape, dtype.jax_type())
-    return _wrap(garr, dtype, split, device, comm)
+    return _wrap(garr, dtype, layout, device, comm)
 
 
-def empty(shape, dtype=types.float32, split=None, device=None, comm=None, order="C") -> DNDarray:
+def empty(shape, dtype=types.float32, split=None, device=None, comm=None, order="C", splits=None) -> DNDarray:
     """Uninitialized array (reference factories.py:444-507).  XLA has no
     uninitialized allocation; zeros are used (same observable contract)."""
-    return __factory(shape, dtype, split, lambda s, d: jnp.zeros(s, d), device, comm, order)
+    return __factory(shape, dtype, split, lambda s, d: jnp.zeros(s, d), device, comm, order, splits)
 
 
-def zeros(shape, dtype=types.float32, split=None, device=None, comm=None, order="C") -> DNDarray:
+def zeros(shape, dtype=types.float32, split=None, device=None, comm=None, order="C", splits=None) -> DNDarray:
     """Array of zeros (reference factories.py:1060-1112)."""
-    return __factory(shape, dtype, split, lambda s, d: jnp.zeros(s, d), device, comm, order)
+    return __factory(shape, dtype, split, lambda s, d: jnp.zeros(s, d), device, comm, order, splits)
 
 
-def ones(shape, dtype=types.float32, split=None, device=None, comm=None, order="C") -> DNDarray:
+def ones(shape, dtype=types.float32, split=None, device=None, comm=None, order="C", splits=None) -> DNDarray:
     """Array of ones (reference factories.py:955-1007)."""
-    return __factory(shape, dtype, split, lambda s, d: jnp.ones(s, d), device, comm, order)
+    return __factory(shape, dtype, split, lambda s, d: jnp.ones(s, d), device, comm, order, splits)
 
 
-def full(shape, fill_value, dtype=types.float32, split=None, device=None, comm=None, order="C") -> DNDarray:
+def full(shape, fill_value, dtype=types.float32, split=None, device=None, comm=None, order="C", splits=None) -> DNDarray:
     """Constant-filled array (reference factories.py:721-772)."""
     return __factory(
-        shape, dtype, split, lambda s, d: jnp.full(s, fill_value, d), device, comm, order
+        shape, dtype, split, lambda s, d: jnp.full(s, fill_value, d), device, comm, order, splits
     )
 
 
@@ -260,7 +284,7 @@ def full_like(a, fill_value, dtype=types.float32, split=None, device=None, comm=
     return __factory_like(a, dtype, split, full, device, comm, order, fill_value=fill_value)
 
 
-def eye(shape, dtype=types.float32, split=None, device=None, comm=None, order="C") -> DNDarray:
+def eye(shape, dtype=types.float32, split=None, device=None, comm=None, order="C", splits=None) -> DNDarray:
     """Identity-like matrix (reference factories.py:572-643 — there each rank
     computes its diagonal offset; here one global jnp.eye)."""
     sanitize_memory_layout(None, order)
@@ -270,10 +294,10 @@ def eye(shape, dtype=types.float32, split=None, device=None, comm=None, order="C
         shape = sanitize_shape(shape)
         gshape = (shape[0], shape[1] if len(shape) > 1 else shape[0])
     dtype = types.canonical_heat_type(dtype)
-    split = sanitize_axis(gshape, split)
     device, comm = _setup(device, comm)
+    layout = _resolve_layout(gshape, split, splits, comm)
     garr = jnp.eye(gshape[0], gshape[1], dtype=dtype.jax_type())
-    return _wrap(garr, dtype, split, device, comm)
+    return _wrap(garr, dtype, layout, device, comm)
 
 
 def linspace(

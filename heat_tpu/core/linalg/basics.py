@@ -27,10 +27,12 @@ import jax.numpy as jnp
 
 from .. import factories, types
 from .._jax_compat import pcast, shard_map
+from .._tracing import record_dispatch
 from ..communication import sanitize_comm
 from ..dndarray import DNDarray
 from ..sanitation import sanitize_in
 from ..stride_tricks import sanitize_axis
+from ...telemetry import _core as _tel
 
 __all__ = [
     "dot",
@@ -230,18 +232,156 @@ def _summa(aa, ba, sa: int, sb: int, comm, precision):
     return out, out_split
 
 
-def matmul(a: DNDarray, b: DNDarray, allow_resplit: bool = False) -> DNDarray:
+def _summa_grid_fn(comm, precision, w: int, overlapped: bool):
+    """The jitted grid-SUMMA program for an r×c mesh — cached per
+    (comm, precision, panel width, overlap arm) like :func:`_summa_fn`.
+
+    Both operands carry splits ``(0, 1)``: local A is ``(Mp/r, Kp/c)``
+    and local B ``(Kp/r, Np/c)`` with ``Kp = r*c*w``.  Panel ``t`` of the
+    k axis lives on mesh column ``t // r`` of A (local offset
+    ``(t % r) * w``) and on mesh row ``t // c`` of B (offset
+    ``(t % c) * w``); each of the ``L = r*c`` steps broadcasts the two
+    panels with a masked psum (exact: one owner's values plus zeros) and
+    accumulates one ``(Mp/r, w) @ (w, Np/c)`` block product — per-device
+    memory O(mn/rc) plus two panels.  The overlap arm issues panel
+    ``t+1``'s broadcasts before consuming panel ``t`` (the
+    double-buffering discipline of docs/design.md §18); the accumulation
+    order is identical, so the two arms are bitwise-equal."""
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    key = ("2d", comm, precision, w, overlapped)
+    cached = _SUMMA_CACHE.get(key)
+    if cached is not None:
+        return cached
+
+    r, c = comm.mesh_shape
+    ax0, ax1 = comm.axis_names
+    L = r * c
+
+    def panels(a_loc, b_loc, t):
+        a_cand = jax.lax.dynamic_slice_in_dim(a_loc, (t % r) * w, w, 1)
+        a_pan = jax.lax.psum(
+            jnp.where(t // r == jax.lax.axis_index(ax1), a_cand,
+                      jnp.zeros((), a_cand.dtype)),
+            ax1,
+        )
+        b_cand = jax.lax.dynamic_slice_in_dim(b_loc, (t % c) * w, w, 0)
+        b_pan = jax.lax.psum(
+            jnp.where(t // c == jax.lax.axis_index(ax0), b_cand,
+                      jnp.zeros((), b_cand.dtype)),
+            ax0,
+        )
+        return a_pan, b_pan
+
+    def kern(a_loc, b_loc):
+        acc0 = pcast(
+            jnp.zeros((a_loc.shape[0], b_loc.shape[1]), a_loc.dtype),
+            (ax0, ax1), to="varying",
+        )
+        if overlapped:
+
+            def body(t, carry):
+                a_pan, b_pan, acc = carry
+                nxt = panels(a_loc, b_loc, jnp.minimum(t + 1, L - 1))
+                acc = acc + jnp.matmul(a_pan, b_pan, precision=precision)
+                return nxt + (acc,)
+
+            first = panels(a_loc, b_loc, 0)
+            _, _, acc = jax.lax.fori_loop(0, L, body, first + (acc0,))
+        else:
+
+            def body(t, acc):
+                a_pan, b_pan = panels(a_loc, b_loc, t)
+                return acc + jnp.matmul(a_pan, b_pan, precision=precision)
+
+            acc = jax.lax.fori_loop(0, L, body, acc0)
+        return acc
+
+    fn = jax.jit(
+        shard_map(
+            kern, mesh=comm.mesh,
+            in_specs=(P(ax0, ax1), P(ax0, ax1)),
+            out_specs=P(ax0, ax1),
+            check_vma=False,
+        )
+    )
+    _SUMMA_CACHE[key] = fn
+    return fn
+
+
+def _summa_grid(aa, ba, dims, comm, precision):
+    """Dispatch wrapper of the grid SUMMA: pads both operands' k axes to
+    the panel grid ``Kp = r*c*w`` (``w = ceil(k / (r*c))``; ``Kp`` is >=
+    both at-rest padded k extents, so the pad only grows and stays
+    divisible), commits splits ``(0, 1)``, and launches the ONE compiled
+    program — explicitly counted via :func:`record_dispatch`, credited to
+    the telemetry ledger with figures straight from
+    :func:`heat_tpu.comm._costs.summa_grid_model` (delegation keeps the
+    accounted and modeled bytes byte-identical), and timed under the
+    overlap policy."""
+    import jax
+
+    from ...comm import _costs
+    from ...comm.overlap import overlap_enabled, timed_dispatch
+
+    m, k, n = dims
+    r, c = comm.mesh_shape
+    L = r * c
+    w = -(-k // L)
+    Kp = L * w
+    if aa.shape[1] != Kp:
+        aa = jnp.pad(aa, ((0, 0), (0, Kp - aa.shape[1])))
+    if ba.shape[0] != Kp:
+        ba = jnp.pad(ba, ((0, Kp - ba.shape[0]), (0, 0)))
+    aa = comm.apply_sharding(aa, (0, 1))
+    ba = comm.apply_sharding(ba, (0, 1))
+    ov = overlap_enabled(L)
+    fn = _summa_grid_fn(comm, precision, w, ov)
+    if isinstance(aa, jax.core.Tracer) or isinstance(ba, jax.core.Tracer):
+        return fn(aa, ba)
+    record_dispatch()
+    if _tel.enabled:
+        model = _costs.summa_grid_model(m, k, n, (r, c), overlap=ov)
+        _tel.account_bytes(
+            "summa2d", "f32", model["exact_wire_bytes"], model["wire_bytes"]
+        )
+        with _tel.span("comm:summa2d", mesh=f"{r}x{c}", panels=L):
+            return timed_dispatch("summa2d", ov, lambda: fn(aa, ba))
+    return timed_dispatch("summa2d", ov, lambda: fn(aa, ba))
+
+
+def matmul(
+    a: DNDarray,
+    b: DNDarray,
+    out: Optional[DNDarray] = None,
+    precision: Optional[str] = None,
+) -> DNDarray:
     """Matrix product of two DNDarrays (reference basics.py:71-787).
 
     All four split combinations are supported.  For 2-D operands with
-    splits 00/01/11 a ring SUMMA (shard_map + ppermute) keeps per-device
-    memory at O(1/p) — GSPMD's plan for those combos all-gathers a full
-    operand (see _summa).  Split 10 contracts the shared axis: GSPMD's
-    single result all-reduce IS the right schedule there, and the other
-    cases (vectors, batched) keep the compiler plan too.
+    splits 00/01/11 on a 1-D mesh a ring SUMMA (shard_map + ppermute)
+    keeps per-device memory at O(1/p) — GSPMD's plan for those combos
+    all-gathers a full operand (see _summa).  Split 10 contracts the
+    shared axis: GSPMD's single result all-reduce IS the right schedule
+    there, and the other cases (vectors, batched) keep the compiler plan
+    too.  On a 2-D (grid) mesh, operands both laid out splits ``(0, 1)``
+    run the grid SUMMA (:func:`_summa_grid_fn`): k-panel broadcasts on
+    the row/column sub-rings, one compiled dispatch, per-device memory
+    O(mn/rc + panels) — the payoff workload of arXiv 2112.09017.
+
+    ``out`` receives the result values in place.  ``precision`` overrides
+    the process-wide matmul precision for this call (``'default'`` |
+    ``'float32'`` | ``'highest'``, see :func:`set_matmul_precision`).
     """
     sanitize_in(a)
     sanitize_in(b)
+    if precision is None:
+        prec = _precision()
+    elif precision in ("default", "float32", "highest"):
+        prec = None if precision == "default" else precision
+    else:
+        raise ValueError(f"invalid precision {precision!r}")
     if a.ndim == 0 or b.ndim == 0:
         raise ValueError("matmul does not accept 0-d operands (use mul)")
     # numpy contraction rule: last axis of a against b's second-to-last
@@ -265,10 +405,35 @@ def matmul(a: DNDarray, b: DNDarray, allow_resplit: bool = False) -> DNDarray:
                     f"{a.shape} @ {b.shape} ({da} vs {db})"
                 )
     promoted = types.promote_types(a.dtype, b.dtype)
+    jt = promoted.jax_type()
     comm = a.comm
     if (
         a.ndim == 2
         and b.ndim == 2
+        and comm.mesh_ndim == 2
+        and comm.size > 1
+        and a.splits == (0, 1)
+        and b.splits == (0, 1)
+    ):
+        # grid SUMMA on the r×c mesh.  BOTH operands carry k-axis padding
+        # here (A's dim 1 and B's dim 0 are each sharded), so both ship
+        # the ZEROED buffer — at-rest pad values are unspecified and can
+        # be non-finite, and 0 * inf = NaN would poison the k-sum (the
+        # same discipline as the 1-D combos below)
+        aa = a._zeroed_buffer()
+        ba = b._zeroed_buffer()
+        aa = aa.astype(jt) if aa.dtype != jt else aa
+        ba = ba.astype(jt) if ba.dtype != jt else ba
+        garr = _summa_grid(
+            aa, ba, (a.shape[0], a.shape[1], b.shape[1]), comm, prec
+        )
+        result = DNDarray(
+            garr, (a.shape[0], b.shape[1]), promoted, (0, 1), a.device, comm, True
+        )
+    elif (
+        a.ndim == 2
+        and b.ndim == 2
+        and comm.mesh_ndim == 1
         and comm.size > 1
         and (a.split, b.split) in ((0, 0), (0, 1), (1, 1))
     ):
@@ -280,22 +445,28 @@ def matmul(a: DNDarray, b: DNDarray, allow_resplit: bool = False) -> DNDarray:
         # would poison every real output element through the k-sum
         zero_a = (a.split, b.split) == (1, 1)  # a's axis 1 == k
         zero_b = (a.split, b.split) == (0, 0)  # b's axis 0 == k
-        aa = (a._zeroed_buffer() if zero_a else a._buffer).astype(promoted.jax_type())
-        ba = (b._zeroed_buffer() if zero_b else b._buffer).astype(promoted.jax_type())
-        out, split = _summa(aa, ba, a.split, b.split, comm, _precision())
+        aa = (a._zeroed_buffer() if zero_a else a._buffer).astype(jt)
+        ba = (b._zeroed_buffer() if zero_b else b._buffer).astype(jt)
+        garr, split = _summa(aa, ba, a.split, b.split, comm, prec)
         if (a.split, b.split) == (0, 1):
-            out = out[:, : b.shape[1]]  # drop B's column padding
-        return DNDarray(
-            out, (a.shape[0], b.shape[1]), promoted, split, a.device, comm, True
+            garr = garr[:, : b.shape[1]]  # drop B's column padding
+        result = DNDarray(
+            garr, (a.shape[0], b.shape[1]), promoted, split, a.device, comm, True
         )
-    aa = a.larray.astype(promoted.jax_type())
-    ba = b.larray.astype(promoted.jax_type())
-    garr = jnp.matmul(aa, ba, precision=_precision())
-    split = _result_split_matmul(a, b, garr.ndim)
-    garr = comm.apply_sharding(garr, split)
-    return DNDarray(
-        garr, tuple(garr.shape), promoted, split, a.device, comm, True
-    )
+    else:
+        aa = a.larray.astype(jt)
+        ba = b.larray.astype(jt)
+        garr = jnp.matmul(aa, ba, precision=prec)
+        split = _result_split_matmul(a, b, garr.ndim)
+        garr = comm.apply_sharding(garr, split)
+        result = DNDarray(
+            garr, tuple(garr.shape), promoted, split, a.device, comm, True
+        )
+    if out is not None:
+        sanitize_in(out)
+        out.larray = result.larray
+        return out
+    return result
 
 
 def dot(a: DNDarray, b: DNDarray, out: Optional[DNDarray] = None):
@@ -315,11 +486,7 @@ def dot(a: DNDarray, b: DNDarray, out: Optional[DNDarray] = None):
                 out.larray = result.larray
                 return out
             return result
-        ret = matmul(a, b)
-        if out is not None:
-            out.larray = ret.larray
-            return out
-        return ret
+        return matmul(a, b, out=out)
     from .. import arithmetics
 
     return arithmetics.mul(a, b)

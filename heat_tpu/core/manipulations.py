@@ -265,8 +265,30 @@ def reshape(a: DNDarray, shape, new_split: Optional[int] = None, **kwargs) -> DN
 def resplit(arr: DNDarray, axis: Optional[int] = None) -> DNDarray:
     """Out-of-place reshard along ``axis``
     (reference manipulations.py:2969-3060: split→None = Allgatherv path
-    :3023; here a single XLA reshard)."""
+    :3023; here a single XLA reshard).
+
+    ``axis`` also accepts a splits tuple — the native spelling on a grid
+    comm (routed through the 2-D planner via ``commit_split``), the exact
+    one-hot compat spelling on a 1-D mesh."""
     sanitize_in(arr)
+    comm = arr.comm
+    grid = getattr(comm, "mesh_ndim", 1) > 1
+    if isinstance(axis, (tuple, list)) or grid:
+        if not isinstance(axis, (tuple, list)):
+            axis = sanitize_axis(arr.shape, axis)
+        splits = comm.normalize_splits(arr.ndim, axis)
+        if not grid:
+            axis = comm.split_view(splits)  # exact on 1-D: legacy path below
+        else:
+            if splits == arr.splits:
+                return DNDarray(
+                    arr._buffer, arr.shape, arr.dtype, splits,
+                    arr.device, comm, arr.balanced,
+                )
+            garr = comm.commit_split(arr.larray, splits)
+            return DNDarray(
+                garr, arr.shape, arr.dtype, splits, arr.device, comm, True
+            )
     axis = sanitize_axis(arr.shape, axis)
     if axis == arr.split:
         # same layout: share the at-rest buffer (re-wrapping the true view

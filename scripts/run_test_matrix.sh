@@ -289,6 +289,32 @@ then
     echo "FAILED overlap headline (bench smoke / twin parity)"
     fail=1
 fi
+# mesh2d lane (docs/design.md §20): the 2-D grid suite — splits-tuple
+# layouts and the split compat view, grid SUMMA against its
+# panel-ordered replicated twin (bitwise), the one-dispatch and
+# telemetry-matches-wire-model gates, and the factored per-mesh-axis
+# redistribution plans — on BOTH grid shapes: 4 devices exercises the
+# 2x2 mesh (2x4 tests self-skip), 8 devices exercises 2x2 AND 2x4.
+# Then the 1-D matmul + redistribute parity suites re-run on the
+# default mesh to prove the splits-tuple refactor left every legacy
+# 1-D layout bit-identical, and the spmdlint baseline gate re-runs so
+# the splits-tuple transfer rules (SPMD503 on tuple layouts) hold a
+# zero-findings tree.
+echo "=== mesh2d lane (2x2 + 2x4 grids: SUMMA twins, 2-D plans, compat view) ==="
+for n in 4 8; do
+    if ! HEAT_TEST_DEVICES="$n" python -m pytest tests/test_mesh2d.py -q; then
+        echo "FAILED mesh2d suite at $n devices"
+        fail=1
+    fi
+done
+if ! python -m pytest tests/test_matmul_matrix.py tests/test_redistribute.py -q; then
+    echo "FAILED 1-D parity suites under the splits-tuple refactor"
+    fail=1
+fi
+if ! python scripts/spmdlint.py --baseline -q; then
+    echo "FAILED spmdlint baseline with splits-tuple rules"
+    fail=1
+fi
 for n in "${sizes[@]}"; do
     echo "=== mesh size $n ==="
     if ! HEAT_TEST_DEVICES="$n" python -m pytest tests/ -q -x; then

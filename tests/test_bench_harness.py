@@ -155,6 +155,10 @@ def _fake_full_result():
         "resplit_gbps": 310.4,
         "resplit_monolithic_gb_per_sec": 96.7,
         "resplit_vs_monolithic": 3.21,
+        "summa2d_tflops": 41.2,
+        "summa1d_tflops": 37.8,
+        "matmul_replicated_tflops": 44.1,
+        "summa2d_vs_replicated": 0.934,
         "kmedians_iter_per_sec": 1063.5,
         "kmedians_churn_iter_per_sec": 143.21,
         "kmedoids_iter_per_sec": 10466.7,

@@ -94,3 +94,79 @@ def test_matmul_chain_resplit_roundtrip():
     h = ht.resplit(h, 1)
     out = ht.matmul(h, ht.array(w2, split=1))
     np.testing.assert_allclose(out.numpy(), a @ w1 @ w2, rtol=1e-4, atol=1e-4)
+
+
+# --------------------------------------------------------------------- #
+# pad poisoning: at-rest pad values are unspecified — the contraction    #
+# must never read them                                                   #
+# --------------------------------------------------------------------- #
+RAGGED_POISON_SHAPES = [
+    ((7, 13), (13, 9)),   # ragged everywhere vs any mesh size
+    ((7, 16), (16, 9)),   # ragged m/n, divisible k
+    ((8, 13), (13, 8)),   # ragged k only
+]
+
+
+def _poison_cases():
+    for sa_shape, sb_shape in RAGGED_POISON_SHAPES:
+        for sa in all_splits(sa_shape):
+            for sb in all_splits(sb_shape):
+                yield sa_shape, sb_shape, sa, sb
+
+
+@pytest.mark.parametrize("sa_shape,sb_shape,sa,sb", list(_poison_cases()))
+def test_matmul_pad_poisoning_split_sweep(sa_shape, sb_shape, sa, sb):
+    """ht.log of a padded operand leaves -inf in the pad slots (log(0)).
+    Every split combination's matmul path must mask them — one leaked pad
+    element turns into 0 * inf = NaN across a whole output row/column."""
+    a = (np.abs(RNG.normal(size=sa_shape)) + 0.5).astype(np.float32)
+    b = (np.abs(RNG.normal(size=sb_shape)) + 0.5).astype(np.float32)
+    x = ht.log(ht.array(a, split=sa))
+    y = ht.log(ht.array(b, split=sb))
+    got = ht.matmul(x, y).numpy()
+    assert np.isfinite(got).all(), (
+        f"pad poisoning leaked through splits ({sa}, {sb})"
+    )
+    want = np.log(a) @ np.log(b)
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+
+# --------------------------------------------------------------------- #
+# signature regression: matmul/dot passthrough                           #
+# --------------------------------------------------------------------- #
+def test_matmul_drops_allow_resplit():
+    a = ht.array(RNG.normal(size=(8, 8)).astype(np.float32), split=0)
+    b = ht.array(RNG.normal(size=(8, 8)).astype(np.float32), split=0)
+    with pytest.raises(TypeError):
+        ht.matmul(a, b, allow_resplit=True)
+    with pytest.raises(TypeError):
+        a.matmul(b, allow_resplit=True)
+
+
+@pytest.mark.parametrize("sa", [None, 0, 1])
+def test_matmul_method_forwards_out_and_precision(sa):
+    a = RNG.normal(size=(8, 12)).astype(np.float32)
+    b = RNG.normal(size=(12, 8)).astype(np.float32)
+    x = ht.array(a, split=sa)
+    y = ht.array(b, split=sa)
+    want = x.matmul(y)
+    hi = x.matmul(y, precision="highest")
+    np.testing.assert_allclose(hi.numpy(), want.numpy(), rtol=1e-5, atol=1e-5)
+    out = ht.zeros(want.shape, split=want.split)
+    res = x.matmul(y, out=out)
+    assert res is out
+    np.testing.assert_array_equal(out.numpy(), want.numpy())
+    with pytest.raises(ValueError):
+        x.matmul(y, precision="bogus")
+
+
+def test_dot_forwards_out_for_2d():
+    a = RNG.normal(size=(8, 8)).astype(np.float32)
+    b = RNG.normal(size=(8, 8)).astype(np.float32)
+    x = ht.array(a, split=0)
+    y = ht.array(b, split=0)
+    want = ht.dot(x, y)
+    out = ht.zeros(want.shape, split=want.split)
+    res = x.dot(y, out=out)
+    assert res is out
+    np.testing.assert_array_equal(out.numpy(), want.numpy())

@@ -1,0 +1,352 @@
+"""2-D mesh layouts: splits-tuple metadata, the grid SUMMA matmul, and
+planned 2-D redistribution.
+
+The ISSUE acceptance contracts pinned here:
+
+- grid SUMMA on 2x2 and 2x4 meshes is BITWISE equal to the replicated
+  ``jnp.matmul`` twin (divisible and ragged shapes, serial and overlap
+  arms) and launches exactly ONE compiled dispatch;
+- its telemetry wire bytes equal :func:`heat_tpu.comm._costs.summa_grid_model`
+  byte-for-byte (accounting delegates to the model, so a drift in either
+  breaks this test);
+- ``plan()`` over a grid factors a (src-splits -> dst-splits) change into
+  per-mesh-axis 1-D stages, prices it, honors ``max_live_bytes`` at plan
+  time, and the executed schedule is value-exact vs the monolithic
+  reshard as one dispatch;
+- ``split`` stays the exact compat view of ``splits`` — every 1-D layout
+  round-trips losslessly.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import heat_tpu as ht
+from heat_tpu import telemetry
+from heat_tpu.comm import _costs
+from heat_tpu.comm import redistribute as rd
+from heat_tpu.comm.overlap import overlap
+from heat_tpu.core import _tracing
+from heat_tpu.core.communication import grid_comm
+
+RNG = np.random.default_rng(29)
+
+MESHES = [(2, 2), (2, 4)]
+
+
+def _grid(mesh_shape):
+    if len(jax.devices()) < mesh_shape[0] * mesh_shape[1]:
+        pytest.skip(f"needs {mesh_shape[0] * mesh_shape[1]} devices")
+    return grid_comm(mesh_shape)
+
+
+def _pair(comm, m, k, n):
+    a = RNG.normal(size=(m, k)).astype(np.float32)
+    b = RNG.normal(size=(k, n)).astype(np.float32)
+    A = ht.array(a, splits=(0, 1), comm=comm)
+    B = ht.array(b, splits=(0, 1), comm=comm)
+    return a, b, A, B
+
+
+def _replicated_twin(a, b, mesh_shape):
+    """The replicated twin of the grid SUMMA: the SAME panel schedule
+    (k padded to L*w, L partial products accumulated in panel order) on
+    unsharded operands.  Bitwise comparability needs the same summation
+    order — a monolithic ``jnp.matmul`` reduces k in one dot and differs
+    in the last ulp."""
+    r, c = mesh_shape
+    L = r * c
+    k = a.shape[1]
+    w = -(-k // L)
+    aj = jnp.pad(jnp.asarray(a), ((0, 0), (0, L * w - k)))
+    bj = jnp.pad(jnp.asarray(b), ((0, L * w - k), (0, 0)))
+    acc = jnp.zeros((a.shape[0], b.shape[1]), aj.dtype)
+    for t in range(L):
+        acc = acc + jnp.matmul(aj[:, t * w:(t + 1) * w],
+                               bj[t * w:(t + 1) * w, :])
+    return np.asarray(acc)
+
+
+# --------------------------------------------------------------------- #
+# splits metadata and the split compat view                              #
+# --------------------------------------------------------------------- #
+@pytest.mark.parametrize("split", [None, 0, 1])
+def test_split_compat_view_roundtrips_on_1d_mesh(split):
+    x = ht.ones((8, 8), split=split)
+    assert x.split == split
+    if split is None:
+        assert x.splits == (None, None)
+    else:
+        expect = [None, None]
+        expect[split] = 0
+        assert x.splits == tuple(expect)
+    # the one-hot splits spelling commits the IDENTICAL layout
+    y = ht.ones((8, 8), splits=x.splits)
+    assert y.split == split
+    assert y.larray.sharding == x.larray.sharding
+
+
+@pytest.mark.parametrize("mesh_shape", MESHES)
+def test_grid_splits_metadata(mesh_shape):
+    comm = _grid(mesh_shape)
+    A = ht.ones((8, 16), splits=(0, 1), comm=comm)
+    assert A.splits == (0, 1)
+    # compat view: the array dim mesh axis 0 shards
+    assert A.split == 0
+    assert ht.ones((8, 16), splits=(None, 0), comm=comm).split == 1
+    assert ht.ones((8, 16), splits=(None, None), comm=comm).split is None
+
+
+def test_split_and_splits_are_mutually_exclusive():
+    with pytest.raises(ValueError):
+        ht.ones((8, 8), split=0, splits=(0, None))
+
+
+def test_splits_validates_against_mesh_rank():
+    # entry 1 names a second mesh axis the default 1-D comm doesn't have
+    with pytest.raises(ValueError):
+        ht.ones((8, 8), splits=(0, 1))
+    with pytest.raises(ValueError):
+        ht.ones((8, 8), splits=(0,))  # arity mismatch
+    comm = _grid((2, 2))
+    with pytest.raises(ValueError):
+        ht.ones((8, 8), splits=(0, 0), comm=comm)  # duplicate mesh axis
+
+
+# --------------------------------------------------------------------- #
+# grid SUMMA: bitwise parity, one dispatch, telemetry == model           #
+# --------------------------------------------------------------------- #
+@pytest.mark.parametrize("mesh_shape", MESHES)
+@pytest.mark.parametrize("m,k,n", [(8, 16, 8), (7, 13, 9), (8, 12, 10)])
+def test_grid_summa_bitwise_vs_replicated_twin(mesh_shape, m, k, n):
+    comm = _grid(mesh_shape)
+    a, b, A, B = _pair(comm, m, k, n)
+    got = A @ B
+    assert got.splits == (0, 1)
+    assert got.shape == (m, n)
+    np.testing.assert_array_equal(got.numpy(), _replicated_twin(a, b, mesh_shape))
+    np.testing.assert_allclose(got.numpy(), a @ b, rtol=1e-5, atol=1e-5)
+
+
+@pytest.mark.parametrize("mesh_shape", MESHES)
+def test_grid_summa_is_one_dispatch(mesh_shape):
+    comm = _grid(mesh_shape)
+    L = mesh_shape[0] * mesh_shape[1]
+    # k divisible by r*c and m/n divisible by r/c: no pads anywhere, so
+    # the count is the SUMMA program alone
+    a, b, A, B = _pair(comm, 4 * mesh_shape[0], 2 * L, 4 * mesh_shape[1])
+    jax.block_until_ready((A @ B).larray)  # warm the compile cache
+    with _tracing.counting_dispatches() as d:
+        jax.block_until_ready((A @ B).larray)
+    assert d.count == 1, f"grid SUMMA must be ONE dispatch, saw {d.count}"
+
+
+@pytest.mark.parametrize("mesh_shape", MESHES)
+def test_grid_summa_overlap_arm_bitwise_equal(mesh_shape):
+    comm = _grid(mesh_shape)
+    a, b, A, B = _pair(comm, 7, 13, 9)
+    serial = (A @ B).numpy()
+    with overlap("on"):
+        overlapped = (A @ B).numpy()
+    np.testing.assert_array_equal(overlapped, serial)
+
+
+@pytest.mark.parametrize("mesh_shape", MESHES)
+def test_grid_summa_telemetry_matches_wire_model(mesh_shape):
+    comm = _grid(mesh_shape)
+    m, k, n = 8, 12, 10
+    a, b, A, B = _pair(comm, m, k, n)
+    model = _costs.summa_grid_model(m, k, n, mesh_shape)
+    telemetry.enable()
+    telemetry.reset()
+    try:
+        jax.block_until_ready((A @ B).larray)
+        snap = telemetry.snapshot()
+        assert snap["counters"]["comm.collectives.summa2d"] == 1
+        assert snap["counters"]["comm.wire_bytes"] == model["wire_bytes"]
+        assert snap["counters"]["comm.exact_bytes"] == model["exact_wire_bytes"]
+        assert "comm:summa2d" in snap["spans"]
+    finally:
+        telemetry.reset()
+        telemetry.disable()
+
+
+def test_grid_summa_model_shape():
+    model = _costs.summa_grid_model(64, 64, 64, (2, 4))
+    assert model["panels"] == 8
+    assert model["panel_width"] == 8
+    assert model["exact_wire_bytes"] > 0
+    assert model["wire_bytes"] == model["exact_wire_bytes"]  # f32 wire
+    assert model["peak_live_bytes"] > 0
+    assert set(model["critical_path_ms"]) == {"serial", "overlap"}
+    # with per-step compute to hide behind, overlap wins the modeled path
+    busy = _costs.summa_grid_model(64, 64, 64, (2, 4),
+                                   compute_ms_per_step=1.0)
+    assert busy["critical_path_ms"]["overlap"] < \
+        busy["critical_path_ms"]["serial"]
+
+
+@pytest.mark.parametrize("mesh_shape", MESHES)
+def test_grid_summa_pad_poisoning(mesh_shape):
+    """Ragged k over the panel grid: BOTH operands carry k-axis pads, and
+    ht.log leaves -inf there.  The SUMMA must mask them (0 * inf = NaN
+    would poison every output element through the k-sum)."""
+    comm = _grid(mesh_shape)
+    m, k, n = 7, 13, 9
+    a = (np.abs(RNG.normal(size=(m, k))) + 0.5).astype(np.float32)
+    b = (np.abs(RNG.normal(size=(k, n))) + 0.5).astype(np.float32)
+    A = ht.log(ht.array(a, splits=(0, 1), comm=comm))
+    B = ht.log(ht.array(b, splits=(0, 1), comm=comm))
+    got = (A @ B).numpy()
+    assert np.isfinite(got).all()
+    # twin inputs through the SAME XLA log (numpy's differs in the ulp)
+    la = np.asarray(jnp.log(jnp.asarray(a)))
+    lb = np.asarray(jnp.log(jnp.asarray(b)))
+    np.testing.assert_array_equal(got, _replicated_twin(la, lb, mesh_shape))
+
+
+def test_matmul_precision_and_out_forwarding_on_grid():
+    comm = _grid((2, 2))
+    a, b, A, B = _pair(comm, 8, 8, 8)
+    want = (A @ B).numpy()
+    hi = ht.matmul(A, B, precision="highest")
+    np.testing.assert_allclose(hi.numpy(), want, rtol=1e-5, atol=1e-5)
+    out = ht.zeros((8, 8), splits=(0, 1), comm=comm)
+    res = ht.matmul(A, B, out=out)
+    assert res is out
+    np.testing.assert_array_equal(out.numpy(), want)
+
+
+# --------------------------------------------------------------------- #
+# planned 2-D redistribution                                             #
+# --------------------------------------------------------------------- #
+GRID_TRANSITIONS = [
+    ((0, 1), (1, 0)),        # full transpose of the mesh assignment
+    ((0, 1), (None, None)),  # gather everything
+    ((None, None), (0, 1)),  # scatter everything
+    ((0, None), (0, 1)),     # add a second sharded dim
+    ((0, 1), (0, None)),     # drop one
+    ((0, None), (None, 0)),  # 1-D move along one mesh axis
+]
+
+
+def _grid_committed(comm, data, splits):
+    with rd.redistribution("monolithic"):
+        return comm.commit_split(jnp.asarray(data), splits)
+
+
+@pytest.mark.parametrize("mesh_shape", MESHES)
+@pytest.mark.parametrize("src,dst", GRID_TRANSITIONS)
+def test_grid_plan_parity_vs_monolithic(mesh_shape, src, dst):
+    comm = _grid(mesh_shape)
+    data = RNG.normal(size=(16, 16)).astype(np.float32)
+    x = _grid_committed(comm, data, src)
+    with rd.redistribution("monolithic"):
+        ref = comm.resplit(x, dst)
+    with rd.redistribution("planned"):
+        got = comm.resplit(x, dst)
+    assert got.sharding == ref.sharding
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(ref))
+
+
+@pytest.mark.parametrize("mesh_shape", MESHES)
+def test_grid_plan_executes_as_one_dispatch(mesh_shape):
+    comm = _grid(mesh_shape)
+    data = RNG.normal(size=(16, 16)).astype(np.float32)
+    x = _grid_committed(comm, data, (0, 1))
+    with rd.redistribution("planned"):
+        jax.block_until_ready(comm.resplit(x, (1, 0)))  # warm the cache
+        with _tracing.counting_dispatches() as d:
+            jax.block_until_ready(comm.resplit(x, (1, 0)))
+    assert d.count == 1, (
+        f"the factored multi-stage schedule must still be ONE compiled "
+        f"dispatch, saw {d.count}"
+    )
+
+
+def test_grid_plan_factors_cyclic_transpose():
+    # (0,1)->(1,0) is a cyclic mesh-axis swap: no direct per-axis move is
+    # possible, so the planner routes one axis through replicated
+    p_obj = rd.plan((64, 64), "float32", (0, 1), (1, 0), 8, mesh_shape=(2, 4))
+    assert p_obj.mesh_shape == (2, 4)
+    assert len(p_obj.steps) >= 3
+    assert p_obj.wire_bytes > 0
+    assert p_obj.peak_live_bytes > 0
+
+
+def test_grid_plan_max_live_bytes_raises_at_plan_time():
+    with pytest.raises(ValueError, match="max_live_bytes"):
+        rd.plan((64, 64), "float32", (0, 1), (1, 0), 8,
+                mesh_shape=(2, 4), max_live_bytes=10)
+
+
+@pytest.mark.parametrize("mesh_shape", MESHES)
+def test_grid_plan_peak_model_holds_end_to_end(mesh_shape):
+    """The modeled peak is a usable bound: planning WITH it succeeds and
+    the executed schedule stays value-exact; one byte less refuses at
+    plan time."""
+    comm = _grid(mesh_shape)
+    size = comm.size
+    p_obj = rd.plan((16, 16), "float32", (0, 1), (1, 0), size,
+                    mesh_shape=mesh_shape)
+    bounded = rd.plan((16, 16), "float32", (0, 1), (1, 0), size,
+                      mesh_shape=mesh_shape,
+                      max_live_bytes=p_obj.peak_live_bytes)
+    assert bounded.peak_live_bytes <= p_obj.peak_live_bytes
+    with pytest.raises(ValueError):
+        rd.plan((16, 16), "float32", (0, 1), (1, 0), size,
+                mesh_shape=mesh_shape,
+                max_live_bytes=p_obj.peak_live_bytes - 1)
+    data = RNG.normal(size=(16, 16)).astype(np.float32)
+    x = _grid_committed(comm, data, (0, 1))
+    got = rd.redistribute(x, (1, 0), comm,
+                          max_live_bytes=p_obj.peak_live_bytes)
+    with rd.redistribution("monolithic"):
+        ref = comm.resplit(x, (1, 0))
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(ref))
+
+
+def test_grid_plan_rejects_ragged_source():
+    # the per-axis kernels assume canonical equal chunks on the SOURCE
+    # (same contract as the 1-D planner); ragged sources stay monolithic
+    with pytest.raises(ValueError, match="ragged"):
+        rd.plan((7, 16), "float32", (0, 1), (None, None), 8,
+                mesh_shape=(2, 4))
+
+
+@pytest.mark.parametrize("mesh_shape", MESHES)
+def test_grid_resplit_ragged_source_falls_back_monolithic(mesh_shape):
+    # end-to-end: comm.resplit under "planned" must still be correct for
+    # ragged sources — via the monolithic fallback, not a broken plan
+    comm = _grid(mesh_shape)
+    data = RNG.normal(size=(7, 9)).astype(np.float32)
+    x = _grid_committed(comm, data, (0, 1))
+    with rd.redistribution("planned"):
+        got = comm.resplit(x, (None, None))
+    with rd.redistribution("monolithic"):
+        ref = comm.resplit(x, (None, None))
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(ref))
+
+
+def test_dndarray_resplit_tuple_roundtrip():
+    comm = _grid((2, 2))
+    data = RNG.normal(size=(8, 8)).astype(np.float32)
+    x = ht.array(data, splits=(0, 1), comm=comm)
+    y = x.resplit((1, 0))
+    assert y.splits == (1, 0)
+    np.testing.assert_array_equal(y.numpy(), data)
+    z = y.resplit((None, None))
+    assert z.splits == (None, None)
+    np.testing.assert_array_equal(z.numpy(), data)
+
+
+def test_grid_plan_cache_is_keyed_by_mesh_shape():
+    p22 = rd.plan((16, 16), "float32", (0, 1), (None, None), 4,
+                  mesh_shape=(2, 2))
+    p14 = rd.plan((16, 16), "float32", (0, 1), (None, None), 4,
+                  mesh_shape=(4, 1))
+    assert p22.mesh_shape == (2, 2)
+    assert p14.mesh_shape == (4, 1)
+    assert p22 is not p14

@@ -498,6 +498,102 @@ def f():
 """, "SPMD503") == []
 
 
+# ------------------------- splits-tuple layouts ----------------------- #
+def test_spmd503_triggers_on_grid_splits_without_comm():
+    # splits entries name MESH axes; the default comm's mesh is 1-D, so
+    # splits=(0, 1) without an explicit comm is statically out of range
+    findings = lint("""
+import heat_tpu as ht
+
+def f():
+    return ht.ones((8, 8), splits=(0, 1))
+""", "SPMD503")
+    assert findings, "splits=(0, 1) on the default 1-D mesh must fire"
+    assert "mesh" in findings[0].message
+
+
+def test_spmd503_triggers_on_splits_arity_mismatch():
+    findings = lint("""
+import heat_tpu as ht
+
+def f():
+    return ht.ones((8, 8), splits=(0, None, None))
+""", "SPMD503")
+    assert findings, "a 3-entry splits tuple on a rank-2 shape must fire"
+
+
+def test_spmd503_triggers_on_duplicate_mesh_axis():
+    findings = lint("""
+import heat_tpu as ht
+
+def f():
+    g = ht.grid_comm((2, 2))
+    return ht.ones((8, 8), splits=(0, 0), comm=g)
+""", "SPMD503")
+    assert findings, "mesh axis 0 sharding two dims must fire"
+
+
+def test_spmd503_clean_on_grid_splits_with_comm():
+    # with an explicit comm the mesh rank is not statically known — the
+    # entry values must not be second-guessed
+    assert lint("""
+import heat_tpu as ht
+
+def f():
+    g = ht.grid_comm((2, 2))
+    return ht.ones((8, 8), splits=(0, 1), comm=g)
+""", "SPMD503") == []
+
+
+def test_spmd503_clean_on_one_hot_splits_tuple():
+    assert lint("""
+import heat_tpu as ht
+
+def f():
+    return ht.ones((8, 8), splits=(0, None))
+""", "SPMD503") == []
+
+
+def test_spmd503_triggers_on_resplit_tuple_arity_mismatch():
+    findings = lint("""
+import heat_tpu as ht
+
+def f():
+    a = ht.ones((8, 8), split=0)
+    return a.resplit((0, 1, None))
+""", "SPMD503")
+    assert findings, "3-entry splits tuple on a rank-2 value must fire"
+
+
+def test_spmd504_triggers_on_noop_tuple_resplit():
+    # one-hot tuple == its 1-D int promotion: resplit((0, None)) of a
+    # split-0 value is a no-op (SPMD504), not a layout change
+    findings = lint("""
+import heat_tpu as ht
+
+def f():
+    a = ht.ones((8, 8), split=0)
+    return a.resplit((0, None))
+""", "SPMD504")
+    assert findings, "one-hot tuple matching the int layout must fire"
+
+
+def test_tuple_splits_flow_through_matmul():
+    prog = program_of("""
+import heat_tpu as ht
+
+def f():
+    g = ht.grid_comm((2, 2))
+    a = ht.ones((8, 8), splits=(0, 1), comm=g)
+    b = ht.ones((8, 8), splits=(0, 1), comm=g)
+    c = a @ b
+    return c
+""")
+    env = env_of(prog, "f")
+    assert env["a"].split == (0, 1)
+    assert env["c"].split == (0, 1)
+
+
 def test_spmd504_triggers_on_noop_resplit():
     findings = lint("""
 import heat_tpu as ht
