@@ -23,9 +23,10 @@ SPMD501    implicit resplit: binary operand splits disagree (hidden wire)
 SPMD502    redundant resplit chain: intermediate layout is never used
 SPMD503    split axis statically out of range (guaranteed runtime error)
 SPMD504    layout collective on a value inferred replicated (no-op)
+SPMD505    hand-placed resplit inside an autoshard-wrapped function
 =========  ===============================================================
 
-SPMD501–504 are **program-scope** rules (``Rule.scope == "program"``):
+SPMD501–505 are **program-scope** rules (``Rule.scope == "program"``):
 they run once over the whole analyzed tree on the splitflow
 interprocedural sharding-dataflow engine
 (:mod:`heat_tpu.analysis.splitflow`) instead of per file.

@@ -13,10 +13,11 @@ from .domain import NOT_ARRAY, Spec, TOP, UNKNOWN, join
 from .engine import CommEvent, Program, build_program
 from .registry import package_registry, static_registry
 from .report import cost_report, render_table
+from .summary import layout_summary
 from .transfer import OpFact, apply_kind
 
 __all__ = [
     "CommEvent", "NOT_ARRAY", "OpFact", "Program", "Spec", "TOP", "UNKNOWN",
-    "apply_kind", "build_program", "cost_report", "join",
+    "apply_kind", "build_program", "cost_report", "join", "layout_summary",
     "package_registry", "render_table", "static_registry",
 ]

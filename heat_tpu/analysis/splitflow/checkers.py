@@ -27,10 +27,19 @@ SPMD504 layout collective on a replicated/identical layout
     ``resplit(None)`` of a value inferred replicated) is a no-op
     layout-wise, but still walks the full plan/dispatch path every call.
     Delete it, or gate it on ``x.split != target``.
+
+SPMD505 hand-placed resplit inside an autoshard-wrapped function
+    Under ``ht.autoshard`` the solver owns interior layout: every
+    non-final placement is searched and may be rerouted or elided, so a
+    hand resplit there is at best a request the plan overrides and at
+    worst forces an incomplete summary back onto the hand layout.  Keep
+    layout out of solved pipelines (or suppress where a pinned hop is
+    genuinely intended).
 """
 
 from __future__ import annotations
 
+import ast
 from typing import Iterable, List
 
 from ..rules import Finding, rule
@@ -39,6 +48,7 @@ from .engine import Program, _fmt_split
 __all__ = [
     "check_implicit_resplit", "check_resplit_chain",
     "check_split_out_of_range", "check_noop_collective",
+    "check_autoshard_hand_layout",
 ]
 
 
@@ -139,3 +149,61 @@ def check_noop_collective(program: Program) -> Iterable[Finding]:
         )
 
     return _findings_for(program, "noop_collective", build)
+
+
+def _autoshard_wrapped_defs(ctx) -> List[ast.AST]:
+    """Defs the file statically hands to ``ht.autoshard`` — decorated
+    (``@ht.autoshard`` / ``@autoshard(donate=True)``) or wrapped inline
+    (``solved = ht.autoshard(pipeline)``)."""
+    wrapped: List[ast.AST] = []
+    seen: set = set()
+
+    def _mark(fn_node):
+        if fn_node is not None and id(fn_node) not in seen:
+            seen.add(id(fn_node))
+            wrapped.append(fn_node)
+
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.FunctionDef):
+            for dec in node.decorator_list:
+                target = dec.func if isinstance(dec, ast.Call) else dec
+                if ctx.resolves_to(target, "autoshard"):
+                    _mark(node)
+        elif isinstance(node, ast.Call) and ctx.resolves_to(node.func, "autoshard"):
+            if node.args and isinstance(node.args[0], ast.Name):
+                _mark(ctx.local_function(node.args[0].id, node))
+    return wrapped
+
+
+@rule("SPMD505", "hand-placed resplit inside an autoshard-wrapped function",
+      scope="program")
+def check_autoshard_hand_layout(program: Program) -> Iterable[Finding]:
+    out: List[Finding] = []
+    seen: set = set()
+    for ctx in program.contexts:
+        wrapped = _autoshard_wrapped_defs(ctx)
+        if not wrapped:
+            continue
+        wrapped_ids = {id(fn) for fn in wrapped}
+        for ev in program.events:
+            if ev.ctx is not ctx or ev.fact.op not in ("resplit", "noop_collective"):
+                continue
+            if not any(id(fn) in wrapped_ids for fn in ctx.enclosing_functions(ev.node)):
+                continue
+            f = ctx.finding(
+                "SPMD505", ev.node,
+                f"hand-placed resplit to {_fmt_split(ev.fact.dst)} inside an "
+                "autoshard-wrapped function; the solver owns interior layout "
+                "here and may reroute or elide this hop",
+                "let ht.autoshard place the layout (drop the call), or "
+                "suppress if this hop is a deliberately pinned placement",
+            )
+            if f is None:
+                continue
+            fp = f.fingerprint()
+            if fp in seen:
+                continue
+            seen.add(fp)
+            out.append(f)
+    out.sort(key=lambda f: (f.path, f.line))
+    return out

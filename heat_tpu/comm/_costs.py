@@ -10,7 +10,14 @@ This module is the ONE place the byte models live:
 - :func:`monolithic_cost` — the one-shot GSPMD reshard envelope
   (:func:`heat_tpu.comm.redistribute.monolithic_model` delegates here),
 - :func:`resolve_mode` — the collective-precision policy arithmetic
-  (which payloads compress, given an explicit policy + threshold).
+  (which payloads compress, given an explicit policy + threshold),
+- :class:`LayoutSolver` — the cost-driven auto-layout search behind
+  ``ht.autoshard`` (docs/design.md §21): dynamic programming with an
+  optional beam bound over a splitflow layout-transfer summary, pricing
+  every candidate seam placement with the SAME :func:`plan_cost` /
+  :func:`grid_plan_cost` / :func:`critical_path_ms` arithmetic the
+  runtime is credited with, plus :func:`summa_grid_model` for locked
+  matmul panels riding along in the objective.
 
 It deliberately imports NOTHING from jax or the rest of the package
 (stdlib only), so the static analyzer in
@@ -33,10 +40,12 @@ from typing import Callable, Optional, Tuple
 __all__ = [
     "BLOCK",
     "DEFAULT_ICI_GBPS",
+    "LayoutSolver",
     "critical_path_ms",
     "encoded_bytes",
     "grid_plan_cost",
     "itemsize",
+    "layout_rank",
     "monolithic_cost",
     "plan_cost",
     "resolve_mode",
@@ -458,6 +467,332 @@ def grid_plan_cost(
         "stages": tuple(stages), "stage_modes": tuple(stage_modes),
         "out_shape": tuple(out_shape),
     }
+
+
+def layout_rank(layout) -> Tuple:
+    """Deterministic total order over layout spellings — the solver's
+    tie-break.  Replicated sorts first, then int splits by axis, then
+    splits tuples entrywise (``None`` entries below mesh axes), so equal
+    argmin costs always resolve to the same plan on every run."""
+    if layout is None:
+        return (0, ())
+    if isinstance(layout, tuple):
+        return (2, tuple(-1 if g is None else int(g) for g in layout))
+    return (1, (int(layout),))
+
+
+def _one_hot(layout, ndim: int, mesh_ndim: int):
+    """Promote the 1-D compat spelling to a splits tuple on mesh axis 0
+    (the ``normalize_splits`` convention); tuples pass through."""
+    if isinstance(layout, tuple):
+        return tuple(None if g is None else int(g) for g in layout)
+    out = [None] * int(ndim)
+    if layout is not None:
+        out[int(layout)] = 0
+    return tuple(out)
+
+
+class LayoutSolver:
+    """Cost-driven auto-layout search over a splitflow call summary.
+
+    The solver behind ``ht.autoshard`` (docs/design.md §21).  Input is a
+    *layout-transfer summary* — plain data exported by
+    :mod:`heat_tpu.analysis.splitflow.summary` — whose ``seams`` are the
+    pipeline's layout-change events in program order, each carrying a
+    literal shape/dtype, the hand-placed ``src``/``dst`` layouts, chain
+    provenance (``prev``: the seam producing this seam's operand, when
+    that intermediate is dead), and the op layer's declared layout
+    ``alternatives`` (``core/_split_semantics.layout_alternatives``).
+
+    Search space: for every chain of seams over one value, each
+    non-pinned intermediate placement ranges over the declared
+    alternatives (1-D splits and splits tuples); the chain's final
+    placement stays pinned to the hand layout, so a solved pipeline is a
+    drop-in — identical output metadata, bitwise-identical values.
+    Choosing the incoming layout again elides the seam entirely.  Each
+    seam additionally prices its collective-precision arm
+    (``choose_precision=True``: the ambient-policy mode vs exact f32 —
+    block padding and scale rows make compression a *loss* on small
+    payloads, which ``resolve_mode``'s threshold alone cannot see).
+
+    Objective (lexicographic): total ``wire_bytes``, then total
+    :func:`critical_path_ms` under the solver's overlap arm (so the
+    PR 11 double-buffered schedule is priced, not just byte counts),
+    then :func:`layout_rank` of the placement path — a deterministic
+    tie-break, identical plan on every run.  Exact dynamic programming
+    per chain; ``beam_width`` bounds the per-position frontier for large
+    alternative sets (pruning is by the same objective, so it stays
+    deterministic).  Locked ``matmul`` seams ride along in both totals
+    via :func:`summa_grid_model` — priced, never re-placed (v1).
+
+    Stdlib-only on purpose: the static analyzer loads this file by path,
+    and the runtime delegates to the same arithmetic, so the plan a
+    pipeline executes and the bytes its ledger is credited with cannot
+    drift from the numbers solved here.
+    """
+
+    def __init__(
+        self,
+        size: Optional[int] = None,
+        *,
+        mesh_shape: Optional[Tuple[int, ...]] = None,
+        precision: Optional[str] = "f32",
+        threshold: int = 1 << 16,
+        overlap: bool = False,
+        compute_ms_per_step: float = 0.0,
+        gbps: float = DEFAULT_ICI_GBPS,
+        beam_width: int = 64,
+        choose_precision: bool = False,
+    ):
+        if mesh_shape is not None:
+            self.mesh_shape = tuple(max(int(p), 1) for p in mesh_shape)
+            self.size = 1
+            for p in self.mesh_shape:
+                self.size *= p
+        else:
+            self.size = max(int(size if size is not None else 1), 1)
+            self.mesh_shape = None
+        self.precision = precision
+        self.threshold = int(threshold)
+        self.overlap = bool(overlap)
+        self.compute_ms_per_step = float(compute_ms_per_step)
+        self.gbps = float(gbps)
+        self.beam_width = max(int(beam_width), 1)
+        self.choose_precision = bool(choose_precision)
+
+    # ------------------------------------------------------------------ #
+    # pricing                                                             #
+    # ------------------------------------------------------------------ #
+    def price(self, shape, dtype_name, src, dst, *, choose=None) -> dict:
+        """Price one layout change with the runtime's own arithmetic.
+
+        Tuple spellings (or any solver built with ``mesh_shape``) route
+        through :func:`grid_plan_cost`; the 1-D compat spelling through
+        :func:`plan_cost`.  With ``choose`` (default: the solver's
+        ``choose_precision``) the cheaper of the ambient-policy mode and
+        exact transmission wins, ties to exact.
+        """
+        shape = tuple(int(s) for s in shape)
+        choose = self.choose_precision if choose is None else bool(choose)
+        grid = self.mesh_shape is not None and (
+            len(self.mesh_shape) > 1
+            or isinstance(src, tuple) or isinstance(dst, tuple)
+        )
+
+        def ambient(nbytes):
+            return resolve_mode(dtype_name, nbytes, self.precision, self.threshold)
+
+        arms = [ambient]
+        if choose:
+            arms.append(lambda nbytes: None)
+        best = None
+        for mode_for in arms:
+            if grid:
+                plan = grid_plan_cost(
+                    shape, dtype_name,
+                    _one_hot(src, len(shape), len(self.mesh_shape)),
+                    _one_hot(dst, len(shape), len(self.mesh_shape)),
+                    self.mesh_shape, mode_for=mode_for, overlap=self.overlap,
+                )
+            else:
+                plan = plan_cost(
+                    shape, dtype_name, src, dst, self.size,
+                    mode_for=mode_for, overlap=self.overlap,
+                )
+            hops = sum(1 for s in plan["steps"] if s[0] == "rotate")
+            arm = {
+                "wire_bytes": plan["wire_bytes"],
+                "exact_wire_bytes": plan["exact_wire_bytes"],
+                "peak_live_bytes": plan["peak_live_bytes"],
+                "mode": plan["mode"],
+                "hops": hops,
+                "critical_path_ms": {
+                    "serial": critical_path_ms(
+                        plan["wire_bytes"], hops, self.compute_ms_per_step,
+                        gbps=self.gbps, overlap=False,
+                    ),
+                    "overlap": critical_path_ms(
+                        plan["wire_bytes"], hops, self.compute_ms_per_step,
+                        gbps=self.gbps, overlap=True,
+                    ),
+                },
+            }
+            key = (arm["wire_bytes"], 0 if arm["mode"] is None else 1)
+            if best is None or key < best[0]:
+                best = (key, arm)
+        return best[1]
+
+    def matmul_cost(self, m: int, k: int, n: int, *, mode=None) -> dict:
+        """Locked-rider pricing of a matmul seam: the grid SUMMA model on
+        this solver's mesh (1-D meshes price as a degenerate ``(p, 1)``
+        grid — the row-ring panel schedule)."""
+        mesh = self.mesh_shape if (
+            self.mesh_shape is not None and len(self.mesh_shape) == 2
+        ) else (self.size, 1)
+        return summa_grid_model(
+            m, k, n, mesh, mode=mode, overlap=self.overlap,
+            compute_ms_per_step=self.compute_ms_per_step, gbps=self.gbps,
+        )
+
+    # ------------------------------------------------------------------ #
+    # search                                                              #
+    # ------------------------------------------------------------------ #
+    def _cp(self, priced: dict) -> float:
+        return priced["critical_path_ms"]["overlap" if self.overlap else "serial"]
+
+    def _candidates(self, seam: dict, locked: bool):
+        hand = seam["dst"]
+        if locked:
+            return [hand]
+        alts = seam.get("alternatives") or ()
+        cands = list(alts)
+        if hand not in cands:
+            cands.append(hand)
+        cands.sort(key=layout_rank)
+        return cands
+
+    def solve(self, summary: dict) -> dict:
+        """Search the summary's layout space; return the argmin plan.
+
+        The plan is plain data: per-seam ``decisions`` keyed by the
+        runtime signature ``(shape, dtype, solved-incoming layout,
+        hand-requested layout)`` — what ``manipulations.resplit`` sees at
+        the call site under the solved plan — plus solved and hand
+        totals and a stable ``fingerprint`` (part of the fuse cache key).
+        """
+        import hashlib
+
+        seams = [dict(s) for s in summary.get("seams", ())]
+        by_index = {s["index"]: s for s in seams}
+        next_of = {}
+        for s in seams:
+            prev = s.get("prev")
+            if prev is not None and prev in by_index:
+                next_of[prev] = s["index"]
+        heads = [
+            s["index"] for s in seams
+            if s["op"] in ("resplit", "noop_collective")
+            and (s.get("prev") is None or s["prev"] not in by_index)
+        ]
+
+        decisions = []
+        totals = {"wire": 0, "exact": 0, "cp_serial": 0.0, "cp_overlap": 0.0}
+        hand = {"wire": 0, "exact": 0, "cp_serial": 0.0, "cp_overlap": 0.0}
+
+        def _tally(bucket, priced):
+            bucket["wire"] += priced["wire_bytes"]
+            bucket["exact"] += priced["exact_wire_bytes"]
+            bucket["cp_serial"] += priced["critical_path_ms"]["serial"]
+            bucket["cp_overlap"] += priced["critical_path_ms"]["overlap"]
+
+        for s in seams:
+            if s["op"] == "matmul":
+                if s.get("shape") is not None and len(s["shape"]) == 3:
+                    m, k, n = (int(x) for x in s["shape"])
+                    rider = self.matmul_cost(m, k, n)
+                    for bucket in (totals, hand):
+                        bucket["wire"] += rider["wire_bytes"]
+                        bucket["exact"] += rider["exact_wire_bytes"]
+                        bucket["cp_serial"] += rider["critical_path_ms"]["serial"]
+                        bucket["cp_overlap"] += rider["critical_path_ms"]["overlap"]
+                continue
+            _tally(hand, self.price(
+                s["shape"], s["dtype"], s["src"], s["dst"], choose=False
+            ))
+            if s["op"] == "implicit_resplit":
+                # locked v1: the binary-op anchor stays; priced, not moved
+                priced = self.price(
+                    s["shape"], s["dtype"], s["src"], s["dst"], choose=False
+                )
+                _tally(totals, priced)
+                decisions.append(self._decision(s, s["src"], s["dst"], priced))
+
+        for head in sorted(heads):
+            chain = [by_index[head]]
+            while chain[-1]["index"] in next_of:
+                chain.append(by_index[next_of[chain[-1]["index"]]])
+            entry = chain[0]["src"]
+            # frontier: layout -> (wire, cp, rank-path, placements)
+            frontier = {entry: (0, 0.0, (), ())}
+            priced_edges = []
+            for pos, seam in enumerate(chain):
+                last = pos == len(chain) - 1
+                locked = last or bool(seam.get("pinned"))
+                cands = self._candidates(seam, locked)
+                nxt = {}
+                edge_prices = {}
+                for lay in sorted(frontier, key=layout_rank):
+                    w, cp, rp, path = frontier[lay]
+                    for cand in cands:
+                        p = self.price(seam["shape"], seam["dtype"], lay, cand)
+                        edge_prices[(lay, cand)] = p
+                        tup = (
+                            w + p["wire_bytes"], cp + self._cp(p),
+                            rp + (layout_rank(cand),), path + ((lay, cand),),
+                        )
+                        cur = nxt.get(cand)
+                        if cur is None or tup[:3] < cur[:3]:
+                            nxt[cand] = tup
+                if len(nxt) > self.beam_width:
+                    keep = sorted(nxt, key=lambda c: nxt[c][:3])[: self.beam_width]
+                    nxt = {c: nxt[c] for c in keep}
+                frontier = nxt
+                priced_edges.append(edge_prices)
+            final = min(frontier, key=lambda c: frontier[c][:3])
+            _, _, _, path = frontier[final]
+            for pos, (seam, (incoming, chosen)) in enumerate(zip(chain, path)):
+                p = priced_edges[pos][(incoming, chosen)]
+                _tally(totals, p)
+                decisions.append(self._decision(seam, incoming, chosen, p))
+
+        decisions.sort(key=lambda d: d["seam"])
+        canonical = (
+            "autoshard-plan", summary.get("function"),
+            self.mesh_shape or self.size, self.precision, self.threshold,
+            self.overlap, self.choose_precision,
+            tuple(
+                (d["seam"], d["shape"], d["dtype"],
+                 layout_rank(d["src"]), layout_rank(d["requested"]),
+                 layout_rank(d["apply"]), d["mode"], d["wire_bytes"])
+                for d in decisions
+            ),
+        )
+        fingerprint = hashlib.sha256(repr(canonical).encode()).hexdigest()[:16]
+        return {
+            "function": summary.get("function"),
+            "fingerprint": fingerprint,
+            "mesh": self.mesh_shape or self.size,
+            "precision": self.precision,
+            "overlap": self.overlap,
+            "decisions": decisions,
+            "modeled_wire_bytes": totals["wire"],
+            "modeled_exact_bytes": totals["exact"],
+            "modeled_critical_path_ms": {
+                "serial": totals["cp_serial"], "overlap": totals["cp_overlap"],
+            },
+            "hand_wire_bytes": hand["wire"],
+            "hand_exact_bytes": hand["exact"],
+            "hand_critical_path_ms": {
+                "serial": hand["cp_serial"], "overlap": hand["cp_overlap"],
+            },
+        }
+
+    def _decision(self, seam, incoming, chosen, priced) -> dict:
+        return {
+            "seam": seam["index"],
+            "op": seam["op"],
+            "line": seam.get("line"),
+            "shape": tuple(int(x) for x in seam["shape"]),
+            "dtype": seam["dtype"],
+            "src": incoming,
+            "requested": seam["dst"],
+            "apply": chosen,
+            "elide": layout_rank(chosen) == layout_rank(incoming),
+            "mode": priced["mode"],
+            "wire_bytes": priced["wire_bytes"],
+            "exact_bytes": priced["exact_wire_bytes"],
+            "critical_path_ms": dict(priced["critical_path_ms"]),
+        }
 
 
 def summa_grid_model(

@@ -13,6 +13,8 @@ from .sanitation import *
 from .dndarray import *
 from . import fuse as _fuse_module
 from .fuse import *
+from . import autoshard as _autoshard_module
+from .autoshard import *
 from . import factories
 from .factories import *
 from . import arithmetics

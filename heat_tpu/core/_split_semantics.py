@@ -75,10 +75,12 @@ from typing import Dict, Optional, Tuple
 
 __all__ = [
     "KINDS",
+    "KIND_LAYOUT_FREEDOM",
     "REGISTRY",
     "Semantics",
     "declare_split_semantics",
     "declare_split_semantics_table",
+    "layout_alternatives",
     "split_semantics",
 ]
 
@@ -133,6 +135,80 @@ class Semantics:
 #: API is flat (``ht.*`` mirrors the reference) and method names shadow
 #: their module functions.
 REGISTRY: Dict[str, Semantics] = {}
+
+
+#: Layout freedom of each kind's RESULT — the op layer's declaration of
+#: which placements the auto-layout solver (``ht.autoshard``) may choose,
+#: sitting next to the transfer facts exactly like the kinds table above:
+#:
+#: ``free``
+#:     the result may legally rest at ANY split (``resplit``: the target
+#:     layout is the op's entire purpose, so the solver owns it);
+#: ``declared``
+#:     the layout comes from an explicit keyword (``split=``/``splits=``)
+#:     and any value is legal — the solver may re-place it, but v1 keeps
+#:     user-declared factory layouts (they are inputs to the search, not
+#:     seams in it);
+#: ``follows``
+#:     the result layout is a function of the operand layouts (the
+#:     transfer function above); the solver influences it only through
+#:     the operands;
+#: ``fixed``
+#:     the entry point pins its own contract (e.g. ``entry_svd``'s S and
+#:     V are replicated by construction) — never a search dimension.
+KIND_LAYOUT_FREEDOM: Dict[str, str] = {
+    "elementwise": "follows",
+    "binary": "follows",
+    "reduction": "follows",
+    "cumulative": "follows",
+    "matmul": "follows",
+    "transpose": "follows",
+    "reshape": "follows",
+    "concat": "follows",
+    "stack": "follows",
+    "expand_dims": "follows",
+    "squeeze": "follows",
+    "flatten": "follows",
+    "resplit": "free",
+    "factory": "declared",
+    "factory_like": "follows",
+    "entry_fit": "fixed",
+    "entry_split0": "fixed",
+    "entry_svd": "fixed",
+}
+
+
+def layout_alternatives(kind: str, ndim: int, mesh_ndim: int = 1) -> Tuple:
+    """Legal layout placements for the result of an op of ``kind`` on an
+    ``ndim``-dimensional value over a ``mesh_ndim``-axis mesh.
+
+    The enumeration the auto-layout solver searches: on a 1-D mesh the
+    compat int spelling (``None`` first, then each array axis); on an N-D
+    mesh the splits-tuple spelling (every assignment of mesh axes to
+    array dims, each mesh axis at most once, fully-replicated first).
+    Deterministic canonical order — the solver's tie-break depends on it.
+    Kinds whose layout is not a search dimension return ``()``.
+    """
+    if KIND_LAYOUT_FREEDOM.get(kind, "fixed") not in ("free", "declared"):
+        return ()
+    ndim = int(ndim)
+    if mesh_ndim <= 1:
+        return (None,) + tuple(range(ndim))
+    out = []
+
+    def _extend(prefix, used):
+        if len(prefix) == ndim:
+            out.append(tuple(prefix))
+            return
+        for g in (None,) + tuple(range(mesh_ndim)):
+            if g is not None and g in used:
+                continue
+            _extend(prefix + [g], used | ({g} if g is not None else set()))
+
+    _extend([], set())
+    # replicated-first canonical order: rank None below every mesh axis
+    out.sort(key=lambda t: tuple(-1 if g is None else g for g in t))
+    return tuple(out)
 
 
 def declare_split_semantics(name: str, kind: str, *, module: str = "", **params) -> Semantics:

@@ -38,8 +38,12 @@ from ..telemetry import _core as _telemetry
 
 __all__ = [
     "FuseTraceError",
+    "NO_OVERRIDE",
+    "applying_layout_plan",
+    "consume_layout_override",
     "trace_mode",
     "in_trace",
+    "layout_plan_active",
     "require_concrete",
     "record_dispatch",
     "dispatch_count",
@@ -96,6 +100,59 @@ def require_concrete(what: str) -> None:
             "on-device (jnp.where / lax.cond) or move this step outside the "
             "fused function."
         )
+
+
+# ---------------------------------------------------------------------- #
+# layout-plan overrides (ht.autoshard → manipulations.resplit)            #
+# ---------------------------------------------------------------------- #
+#: sentinel distinguishing "no override recorded" from "override to None"
+NO_OVERRIDE = object()
+
+_layout_plan = None  # {signature: [apply, ...]} FIFO while a plan is active
+
+
+def layout_plan_active() -> bool:
+    """True while an ``ht.autoshard`` plan is being applied on this call."""
+    return _layout_plan is not None
+
+
+@contextlib.contextmanager
+def applying_layout_plan(decisions):
+    """Expose a solved layout plan to ``manipulations.resplit`` for the
+    dynamic extent of one pipeline call.
+
+    ``decisions`` is the solver's list (see
+    :meth:`heat_tpu.comm._costs.LayoutSolver.solve`); each is keyed by the
+    *signature* of the hand-written resplit it replaces — ``(shape,
+    dtype, src split, requested dst)`` — NOT by call position, so library
+    resplits the plan never saw (e.g. ``__binary_op``'s implicit reshard)
+    pass through untouched.  Same-signature calls consume their overrides
+    in FIFO order, matching the solver's program-order chain walk.  The
+    table is rebuilt per call: a plan application never leaks into the
+    next call, and nesting restores the outer plan.
+    """
+    global _layout_plan
+    table = {}
+    for d in decisions:
+        key = (tuple(d["shape"]), d["dtype"], d["src"], d["requested"])
+        table.setdefault(key, []).append(d["apply"])
+    prev = _layout_plan
+    _layout_plan = table
+    try:
+        yield
+    finally:
+        _layout_plan = prev
+
+
+def consume_layout_override(shape, dtype_name, src, requested):
+    """Pop the next planned placement for a resplit with this signature,
+    or :data:`NO_OVERRIDE` when the active plan has nothing for it."""
+    if _layout_plan is None:
+        return NO_OVERRIDE
+    queue = _layout_plan.get((tuple(shape), dtype_name, src, requested))
+    if not queue:
+        return NO_OVERRIDE
+    return queue.pop(0)
 
 
 # ---------------------------------------------------------------------- #

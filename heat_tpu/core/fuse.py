@@ -50,6 +50,7 @@ compile-and-cache machinery — for embedding DNDarray code inside a wider
 
 from __future__ import annotations
 
+import contextlib
 import functools
 from typing import Any, Callable, Dict, Optional, Tuple
 
@@ -62,6 +63,7 @@ from . import _compile
 from ._compile import cache_stable
 from ._tracing import (
     FuseTraceError,
+    applying_layout_plan,
     in_trace,
     record_dispatch,
     trace_mode,
@@ -71,6 +73,11 @@ from .dndarray import DNDarray
 __all__ = ["fuse", "FuseTraceError"]
 
 _FUSE_CACHE: Dict[Tuple, Any] = {}
+
+
+@contextlib.contextmanager
+def _null_ctx():
+    yield
 
 
 def _is_dnd(x: Any) -> bool:
@@ -166,10 +173,15 @@ def _build(fn: Callable, slots: Tuple, treedef, donate: bool) -> _Program:
 class _FusedFunction:
     """The callable returned by :func:`fuse`."""
 
-    def __init__(self, fn: Callable, donate: bool = False):
+    def __init__(self, fn: Callable, donate: bool = False, layout_plan=None):
         self._fn = fn
         self._donate = bool(donate)
         self._stable = cache_stable(fn)
+        # a solved ht.autoshard plan: its decisions steer every resplit
+        # inside the trace, and its fingerprint joins the cache key so a
+        # planned and an unplanned trace of the same fn never collide
+        self._layout_plan = layout_plan
+        self._plan_token = layout_plan["fingerprint"] if layout_plan else None
         functools.update_wrapper(self, fn)
 
     def __call__(self, *args, **kwargs):
@@ -208,8 +220,8 @@ class _FusedFunction:
             # context_token(): process-wide state (collective-compression
             # policy) that changes what the traced program computes —
             # fused programs re-trace under a new policy, never replay
-            key = (self._fn, self._donate, treedef, tuple(keyparts), comm,
-                   _compile.context_token())
+            key = (self._fn, self._donate, self._plan_token, treedef,
+                   tuple(keyparts), comm, _compile.context_token())
             try:
                 program = _FUSE_CACHE.get(key)
             except TypeError:  # unhashable static leaf slipped through
@@ -225,15 +237,23 @@ class _FusedFunction:
         elif _tel.enabled:
             _tel.inc("fuse.cache.hits")
 
-        if _tel.enabled:
-            # jax.jit is lazy: a program whose out_treedef is still unset
-            # runs its DNDarray trace + XLA compile inside this first
-            # call, so that is the "build" span; later calls replay
-            site = "fuse:build" if program.out_treedef is None else "fuse:replay"
-            with _tel.span(site, name=getattr(self._fn, "__name__", "<pipeline>")):
+        # jax.jit is lazy, so the plan context must cover EVERY launch:
+        # the first call runs the DNDarray trace (where resplits consult
+        # the plan) inside jfn, and jit may silently retrace later
+        plan_ctx = (
+            applying_layout_plan(self._layout_plan["decisions"])
+            if self._layout_plan is not None else _null_ctx()
+        )
+        with plan_ctx:
+            if _tel.enabled:
+                # a program whose out_treedef is still unset runs its
+                # DNDarray trace + XLA compile inside this first call, so
+                # that is the "build" span; later calls replay
+                site = "fuse:build" if program.out_treedef is None else "fuse:replay"
+                with _tel.span(site, name=getattr(self._fn, "__name__", "<pipeline>")):
+                    raws = program.jfn(tuple(operands))
+            else:
                 raws = program.jfn(tuple(operands))
-        else:
-            raws = program.jfn(tuple(operands))
         record_dispatch()
 
         flag = None
@@ -289,16 +309,22 @@ class _FusedFunction:
         return True
 
 
-def fuse(fn: Optional[Callable] = None, *, donate: bool = False):
+def fuse(fn: Optional[Callable] = None, *, donate: bool = False,
+         layout_plan=None):
     """Compile a DNDarray pipeline into one XLA program (one dispatch).
 
     Use as a decorator (``@ht.fuse`` / ``@ht.fuse(donate=True)``) or
     inline (``fused = ht.fuse(my_pipeline)``).  See the module docstring
     for caching, static-argument, and donation semantics.
+
+    ``layout_plan`` is the :func:`heat_tpu.autoshard` seam: a solved plan
+    dict (:meth:`heat_tpu.comm._costs.LayoutSolver.solve`) whose decisions
+    override the hand-placed resplits during tracing and whose fingerprint
+    becomes part of the compile-cache key.
     """
     if fn is None:
-        return functools.partial(fuse, donate=donate)
-    return _FusedFunction(fn, donate=donate)
+        return functools.partial(fuse, donate=donate, layout_plan=layout_plan)
+    return _FusedFunction(fn, donate=donate, layout_plan=layout_plan)
 
 
 #: context-manager variant: bare tracing mode without compile-and-cache

@@ -24,6 +24,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from . import factories, types
+from ._tracing import NO_OVERRIDE, consume_layout_override, layout_plan_active
 from .dndarray import DNDarray
 from .sanitation import sanitize_in
 from .stride_tricks import sanitize_axis, sanitize_shape
@@ -273,6 +274,20 @@ def resplit(arr: DNDarray, axis: Optional[int] = None) -> DNDarray:
     sanitize_in(arr)
     comm = arr.comm
     grid = getattr(comm, "mesh_ndim", 1) > 1
+    if layout_plan_active() and not grid and not isinstance(axis, (tuple, list)):
+        # ht.autoshard plan application: this resplit's signature (shape,
+        # dtype, src, requested dst) may carry a solver override for the
+        # placement to actually commit.  Resplits the plan never priced
+        # (e.g. __binary_op's implicit reshard) get NO_OVERRIDE and run
+        # as written; an override equal to arr.split elides via the
+        # same-layout early-out below.
+        requested = sanitize_axis(arr.shape, axis) if axis is not None else None
+        override = consume_layout_override(
+            arr.shape, getattr(arr.dtype, "__name__", str(arr.dtype)),
+            arr.split, requested,
+        )
+        if override is not NO_OVERRIDE:
+            axis = override
     if isinstance(axis, (tuple, list)) or grid:
         if not isinstance(axis, (tuple, list)):
             axis = sanitize_axis(arr.shape, axis)

@@ -315,6 +315,26 @@ if ! python scripts/spmdlint.py --baseline -q; then
     echo "FAILED spmdlint baseline with splits-tuple rules"
     fail=1
 fi
+# autoshard lane (docs/design.md §21): cost-driven auto-layout — every
+# splitflow fixture pipeline bitwise-equal to its hand-layout twin, one
+# dispatch at steady state, the modeled-cost-never-exceeds-hand bound,
+# and the wire-ledger oracle (telemetry bytes for a solved call ==
+# plan's modeled bytes BYTE-FOR-BYTE, both directions, at every mesh
+# size) — at 4 and 8 devices.  Then the spmdlint baseline gate re-runs
+# so SPMD505 (hand-placed resplit inside an autoshard-wrapped function)
+# holds a zero-findings tree.
+echo "=== autoshard lane (solver twins, one-dispatch gate, ledger oracle) ==="
+for n in 4 8; do
+    if ! HEAT_TEST_DEVICES="$n" python -m pytest tests/test_autoshard.py \
+            tests/test_cost_properties.py -q; then
+        echo "FAILED autoshard suite at $n devices"
+        fail=1
+    fi
+done
+if ! python scripts/spmdlint.py --baseline -q; then
+    echo "FAILED spmdlint baseline with SPMD505 (autoshard hand-layout rule)"
+    fail=1
+fi
 for n in "${sizes[@]}"; do
     echo "=== mesh size $n ==="
     if ! HEAT_TEST_DEVICES="$n" python -m pytest tests/ -q -x; then

@@ -15,7 +15,7 @@ import heat_tpu as ht
 
 __all__ = [
     "svd_pipeline", "kmeans_pipeline", "lasso_pipeline", "gnb_pipeline",
-    "fused_pipeline", "resplit_pipeline",
+    "fused_pipeline", "resplit_pipeline", "staged_resplit_pipeline",
 ]
 
 
@@ -86,3 +86,17 @@ def resplit_pipeline(comm=None):
     z = ht.zeros((32, 64), dtype=ht.float32, split=1, comm=comm)
     w = z.resplit(0)
     return x, y, z, w
+
+
+def staged_resplit_pipeline(comm=None):
+    """Hand layout with a DEAD intermediate hop — the autoshard win case.
+
+    ``t`` exists only to feed the second resplit, so the hand plan pays
+    0→1 plus 1→None while one 0→None all-gather suffices.  The solver
+    must find that (tests/test_autoshard.py prices both); the dead hop
+    is deliberate, hence the SPMD502 suppression.
+    """
+    x = _features(comm)
+    t = x.resplit(1)
+    w = t.resplit(None)  # spmdlint: disable=SPMD502
+    return x, w

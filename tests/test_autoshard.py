@@ -1,0 +1,237 @@
+"""The ht.autoshard acceptance lane (docs/design.md §21).
+
+Four contracts, each against the running system:
+
+1. **Drop-in** — on every splitflow fixture pipeline the solved program
+   returns bitwise-identical values and identical split metadata to the
+   hand-layout twin executed in the same run.
+2. **One dispatch** — at steady state a solved traceable pipeline
+   launches exactly one device program per call, like ``ht.fuse``.
+3. **Cheaper or equal** — the plan's modeled wire bytes never exceed the
+   hand layout's; on the staged fixture (dead intermediate hop) they are
+   strictly lower.
+4. **Ledger oracle** — the bytes the telemetry wire ledger records for a
+   solved call equal the plan's modeled bytes byte-for-byte, at every
+   mesh size.  The model is the runtime's own arithmetic; drift in
+   either direction fails here.
+"""
+
+import jax
+import numpy as np
+import pytest
+
+import heat_tpu as ht
+from heat_tpu import telemetry
+from heat_tpu.core._tracing import counting_dispatches
+from heat_tpu.core.communication import XlaCommunication
+
+import tests.splitflow_pipelines as pipelines
+
+PIPELINES = sorted(pipelines.__all__)
+
+MESHES = [1, 2, 4, 8]
+
+
+def _sub_comm(k):
+    devs = jax.devices()
+    if len(devs) < k:
+        pytest.skip(f"mesh size {k} needs {k} devices, have {len(devs)}")
+    return XlaCommunication(devs[:k])
+
+
+def _assert_twin(hand, solved):
+    assert len(hand) == len(solved)
+    for h, s in zip(hand, solved):
+        assert h.split == s.split
+        assert h.gshape == s.gshape
+        assert h.dtype == s.dtype
+        assert np.array_equal(np.asarray(h.larray), np.asarray(s.larray)), (
+            "solved pipeline output differs from the hand-layout twin"
+        )
+
+
+# --------------------------------------------------------------------- #
+# 1. drop-in: bitwise twin on every fixture pipeline                     #
+# --------------------------------------------------------------------- #
+@pytest.mark.parametrize("mesh", MESHES)
+@pytest.mark.parametrize("name", PIPELINES)
+def test_bitwise_equal_to_hand_twin(name, mesh):
+    comm = _sub_comm(mesh)
+    fn = getattr(pipelines, name)
+    auto = ht.autoshard(fn)
+    hand = fn(comm)
+    _assert_twin(hand, auto(comm))
+    # steady state replays the cached program — still the same values
+    _assert_twin(hand, auto(comm))
+
+
+# --------------------------------------------------------------------- #
+# 2. one dispatch at steady state                                        #
+# --------------------------------------------------------------------- #
+@pytest.mark.parametrize("name", ["resplit_pipeline", "staged_resplit_pipeline",
+                                  "fused_pipeline"])
+def test_one_dispatch_at_steady_state(name):
+    comm = _sub_comm(min(4, len(jax.devices())))
+    auto = ht.autoshard(getattr(pipelines, name))
+    auto(comm)  # build call: trace + compile
+    with counting_dispatches() as d:
+        auto(comm)
+    assert d.count == 1, f"{name}: {d.count} dispatches at steady state"
+
+
+# --------------------------------------------------------------------- #
+# 3. solved cost never exceeds the hand layout                           #
+# --------------------------------------------------------------------- #
+@pytest.mark.parametrize("mesh", [2, 4, 8])
+@pytest.mark.parametrize("name", PIPELINES)
+def test_modeled_bytes_never_exceed_hand(name, mesh):
+    comm = _sub_comm(mesh)
+    auto = ht.autoshard(getattr(pipelines, name))
+    plan = auto.plan(comm)
+    if plan is None:
+        return  # plain-fuse fallback: nothing was re-planned
+    assert plan["modeled_wire_bytes"] <= plan["hand_wire_bytes"]
+    assert plan["modeled_critical_path_ms"]["serial"] >= 0.0
+
+
+@pytest.mark.parametrize("mesh", [2, 4, 8])
+def test_staged_fixture_is_strictly_cheaper(mesh):
+    """The dead-hop chain (0→1→None) must collapse to one all-gather."""
+    comm = _sub_comm(mesh)
+    auto = ht.autoshard(pipelines.staged_resplit_pipeline)
+    plan = auto.plan(comm)
+    assert plan is not None
+    assert plan["modeled_wire_bytes"] < plan["hand_wire_bytes"]
+    elided = [d for d in plan["decisions"] if d["elide"]]
+    assert len(elided) == 1, plan["decisions"]
+
+
+# --------------------------------------------------------------------- #
+# 4. ledger oracle: modeled == measured, byte-for-byte                   #
+# --------------------------------------------------------------------- #
+@pytest.mark.parametrize("mesh", MESHES)
+@pytest.mark.parametrize("name", ["resplit_pipeline", "staged_resplit_pipeline"])
+def test_ledger_matches_model_byte_for_byte(name, mesh):
+    comm = _sub_comm(mesh)
+    auto = ht.autoshard(getattr(pipelines, name))
+    plan = auto.plan(comm)
+    assert plan is not None
+    auto(comm)  # build call (its credit lands before the reset below)
+    telemetry.enable()
+    telemetry.reset()
+    try:
+        auto(comm)
+        snap = telemetry.snapshot()
+    finally:
+        telemetry.reset()
+        telemetry.disable()
+    counters = snap["counters"]
+    assert counters.get("comm.wire_bytes", 0) == plan["modeled_wire_bytes"]
+    assert counters.get("comm.exact_bytes", 0) == plan["modeled_exact_bytes"]
+    if mesh == 1:
+        assert plan["modeled_wire_bytes"] == 0
+
+
+# --------------------------------------------------------------------- #
+# determinism and cache-key semantics                                    #
+# --------------------------------------------------------------------- #
+def test_plan_is_deterministic():
+    comm = _sub_comm(min(4, len(jax.devices())))
+    a = ht.autoshard(pipelines.staged_resplit_pipeline).plan(comm)
+    b = ht.autoshard(pipelines.staged_resplit_pipeline).plan(comm)
+    assert a["fingerprint"] == b["fingerprint"]
+    assert a["decisions"] == b["decisions"]
+
+
+def test_policy_change_resolves_a_new_plan():
+    """The plan cache is policy-keyed: flipping the collective-precision
+    policy re-solves instead of replaying a plan priced elsewhere."""
+    from heat_tpu.comm import collective_precision
+
+    comm = _sub_comm(min(2, len(jax.devices())))
+    auto = ht.autoshard(pipelines.staged_resplit_pipeline)
+    auto(comm)
+    with collective_precision("int8_block"):
+        auto(comm)
+        n_inside = len(auto._programs)
+    assert n_inside == 2
+    auto(comm)
+    assert len(auto._programs) == 2  # ambient-policy entry replays
+
+
+def test_incomplete_summary_falls_back_to_hand_layout():
+    """Control flow around a seam makes the summary unsound; autoshard
+    must run the hand layout (plain fuse rung), not guess."""
+    comm = _sub_comm(min(2, len(jax.devices())))
+    auto = ht.autoshard(_loopy_pipeline)
+    hand = _loopy_pipeline(comm)
+    _assert_twin(hand, auto(comm))
+    assert auto.plan(comm) is None
+
+
+def _loopy_pipeline(comm=None):
+    x = ht.ones((64, 32), dtype=ht.float32, split=0, comm=comm)
+    for axis in (1, 0):
+        # deliberately summary-hostile: layout traffic under control flow
+        x = x.resplit(axis)  # spmdlint: disable=SPMD206
+    return (x,)
+
+
+# --------------------------------------------------------------------- #
+# satellite: symmetric policy getters round-trip                         #
+# --------------------------------------------------------------------- #
+def test_policy_getters_round_trip():
+    """Every set_* has a get_* that reports exactly what was set — the
+    snapshot/restore seam autoshard's policy key is built on."""
+    from heat_tpu import comm as htc
+
+    snapshot = (
+        htc.get_collective_precision(),
+        htc.get_collective_threshold(),
+        htc.get_redistribution(),
+        htc.get_redistribution_threshold(),
+        htc.get_overlap(),
+    )
+    try:
+        htc.set_collective_precision("int8_block")
+        assert htc.get_collective_precision() == "int8_block"
+        htc.set_collective_threshold(1 << 10)
+        assert htc.get_collective_threshold() == 1 << 10
+        htc.set_redistribution("planned")
+        assert htc.get_redistribution() == "planned"
+        htc.set_redistribution_threshold(1 << 12)
+        assert htc.get_redistribution_threshold() == 1 << 12
+        htc.set_overlap("on")
+        assert htc.get_overlap() == "on"
+    finally:
+        htc.set_collective_precision(snapshot[0])
+        htc.set_collective_threshold(snapshot[1])
+        htc.set_redistribution(snapshot[2])
+        htc.set_redistribution_threshold(snapshot[3])
+        htc.set_overlap(snapshot[4])
+    assert (
+        htc.get_collective_precision(),
+        htc.get_collective_threshold(),
+        htc.get_redistribution(),
+        htc.get_redistribution_threshold(),
+        htc.get_overlap(),
+    ) == snapshot
+
+
+def test_context_managers_report_through_getters():
+    from heat_tpu import comm as htc
+
+    before = htc.get_collective_precision()
+    with htc.collective_precision("bf16"):
+        assert htc.get_collective_precision() == "bf16"
+    assert htc.get_collective_precision() == before
+
+    before = htc.get_redistribution()
+    with htc.redistribution("planned"):
+        assert htc.get_redistribution() == "planned"
+    assert htc.get_redistribution() == before
+
+    before = htc.get_overlap()
+    with htc.overlap("on"):
+        assert htc.get_overlap() == "on"
+    assert htc.get_overlap() == before

@@ -165,6 +165,7 @@ def _fake_full_result():
         "eager_ops_per_sec": 3021.9,
         "fused_pipeline_ms": 0.42,
         "eager_pipeline_ms": 2.31,
+        "autoshard_speedup": 1.29,
         "lasso_sweeps_per_sec": 1318.6,
         "serve_predictions_per_sec": 9919.9,
         "serve_p99_ms": 27.32,

@@ -1,0 +1,164 @@
+"""Property tests for the pricing the layout solver trusts.
+
+``ht.autoshard`` minimizes over :func:`plan_cost` / :func:`grid_plan_cost`
+sums, so a pricing bug does not crash — it silently corrupts the argmin.
+These sweeps pin the three properties the search relies on, across
+src × dst × mesh for 1-D meshes and the 2×2 / 2×4 grids:
+
+non-negativity
+    every figure (wire, exact, peak) is ≥ 0 on every edge;
+zero exactly where nothing crosses the wire
+    ``wire_bytes == 0`` iff no device ships data: the identity layout,
+    a single-device mesh, an empty array, or a replicated source
+    (replicated → split is a local slice — free on the wire by
+    construction, and the solver is allowed to exploit exactly that);
+monotonicity in payload bytes
+    growing the array (same layouts, same mesh) never shrinks the bill.
+"""
+
+import itertools
+
+import pytest
+
+from heat_tpu.comm._costs import (
+    LayoutSolver,
+    grid_plan_cost,
+    layout_rank,
+    plan_cost,
+)
+
+SHAPES = [(32, 16), (64, 32), (128, 64)]  # strictly growing payloads
+LAYOUTS_1D = [None, 0, 1]
+MESHES_1D = [1, 2, 4, 8]
+
+GRID_MESHES = [(2, 2), (2, 4)]
+#: all legal splits tuples for a 2-d array on a 2-axis mesh
+LAYOUTS_GRID = [
+    s for s in itertools.product((None, 0, 1), repeat=2)
+    if len([g for g in s if g is not None]) == len({g for g in s if g is not None})
+]
+
+
+def _wire_free_1d(src, dst, size):
+    return size == 1 or src == dst or src is None
+
+
+def _wire_free_grid(src, dst):
+    """No mesh axis moves OFF a sharded dim (moving onto one is local)."""
+    def dim_of(layout, g):
+        for d, x in enumerate(layout):
+            if x == g:
+                return d
+        return None
+
+    for g in (0, 1):
+        sd = dim_of(src, g)
+        if sd is not None and dim_of(dst, g) != sd:
+            return False
+    return True
+
+
+# --------------------------------------------------------------------- #
+# 1-D sweeps                                                             #
+# --------------------------------------------------------------------- #
+@pytest.mark.parametrize("size", MESHES_1D)
+@pytest.mark.parametrize("dst", LAYOUTS_1D)
+@pytest.mark.parametrize("src", LAYOUTS_1D)
+def test_plan_cost_nonnegative_and_zero_iff_wire_free(src, dst, size):
+    for shape in SHAPES:
+        c = plan_cost(shape, "float32", src, dst, size)
+        assert c["wire_bytes"] >= 0
+        assert c["exact_wire_bytes"] >= 0
+        assert c["peak_live_bytes"] >= 0
+        if _wire_free_1d(src, dst, size):
+            assert c["wire_bytes"] == 0, (shape, src, dst, size)
+            assert c["exact_wire_bytes"] == 0
+        else:
+            assert c["wire_bytes"] > 0, (shape, src, dst, size)
+            assert c["exact_wire_bytes"] > 0
+
+
+@pytest.mark.parametrize("size", MESHES_1D)
+@pytest.mark.parametrize("dst", LAYOUTS_1D)
+@pytest.mark.parametrize("src", LAYOUTS_1D)
+def test_plan_cost_monotone_in_payload(src, dst, size):
+    bills = [
+        plan_cost(shape, "float32", src, dst, size)["wire_bytes"]
+        for shape in SHAPES
+    ]
+    assert bills == sorted(bills), (src, dst, size, bills)
+    exacts = [
+        plan_cost(shape, "float32", src, dst, size)["exact_wire_bytes"]
+        for shape in SHAPES
+    ]
+    assert exacts == sorted(exacts)
+
+
+@pytest.mark.parametrize("size", [2, 8])
+def test_plan_cost_identity_is_a_true_noop(size):
+    for lay in LAYOUTS_1D:
+        c = plan_cost((64, 32), "float32", lay, lay, size)
+        assert c["wire_bytes"] == 0
+        assert c["steps"] == ()
+
+
+# --------------------------------------------------------------------- #
+# grid sweeps (2×2 and 2×4)                                              #
+# --------------------------------------------------------------------- #
+@pytest.mark.parametrize("mesh", GRID_MESHES)
+@pytest.mark.parametrize("dst", LAYOUTS_GRID)
+@pytest.mark.parametrize("src", LAYOUTS_GRID)
+def test_grid_plan_cost_nonnegative_and_zero_iff_wire_free(src, dst, mesh):
+    for shape in SHAPES:
+        c = grid_plan_cost(shape, "float32", src, dst, mesh)
+        assert c["wire_bytes"] >= 0
+        assert c["exact_wire_bytes"] >= 0
+        assert c["peak_live_bytes"] >= 0
+        if _wire_free_grid(src, dst):
+            assert c["wire_bytes"] == 0, (shape, src, dst, mesh)
+        else:
+            assert c["wire_bytes"] > 0, (shape, src, dst, mesh)
+
+
+@pytest.mark.parametrize("mesh", GRID_MESHES)
+@pytest.mark.parametrize("dst", LAYOUTS_GRID)
+@pytest.mark.parametrize("src", LAYOUTS_GRID)
+def test_grid_plan_cost_monotone_in_payload(src, dst, mesh):
+    bills = [
+        grid_plan_cost(shape, "float32", src, dst, mesh)["wire_bytes"]
+        for shape in SHAPES
+    ]
+    assert bills == sorted(bills), (src, dst, mesh, bills)
+
+
+@pytest.mark.parametrize("mesh", GRID_MESHES)
+def test_grid_identity_is_a_true_noop(mesh):
+    for lay in LAYOUTS_GRID:
+        c = grid_plan_cost((64, 32), "float32", lay, lay, mesh)
+        assert c["wire_bytes"] == 0
+        assert c["steps"] == ()
+
+
+# --------------------------------------------------------------------- #
+# solver-facing consistency                                              #
+# --------------------------------------------------------------------- #
+@pytest.mark.parametrize("size", [2, 8])
+def test_solver_price_equals_plan_cost(size):
+    """LayoutSolver.price is a view over plan_cost — same bytes, so the
+    plan a pipeline executes cannot drift from the solved numbers."""
+    solver = LayoutSolver(size)
+    for src, dst in itertools.product(LAYOUTS_1D, repeat=2):
+        direct = plan_cost((64, 32), "float32", src, dst, size)
+        priced = solver.price((64, 32), "float32", src, dst)
+        assert priced["wire_bytes"] == direct["wire_bytes"]
+        assert priced["exact_wire_bytes"] == direct["exact_wire_bytes"]
+
+
+def test_layout_rank_is_a_strict_total_order():
+    """The tie-break key must order every layout spelling deterministically
+    and without collisions across kinds."""
+    layouts = [None, 0, 1, 2, (None, None), (0, None), (None, 0), (1, 0)]
+    ranks = [layout_rank(l) for l in layouts]
+    assert len(set(ranks)) == len(ranks)
+    assert sorted(ranks) == sorted(ranks, key=lambda r: r)  # comparable
+    assert layout_rank(None) < layout_rank(0) < layout_rank((None, None))

@@ -619,6 +619,63 @@ def f():
 """, "SPMD504") == []
 
 
+def test_spmd505_triggers_on_resplit_under_autoshard_decorator():
+    findings = lint("""
+import heat_tpu as ht
+
+@ht.autoshard
+def pipeline():
+    a = ht.ones((8, 8), split=0)
+    return a.resplit(1)
+""", "SPMD505")
+    assert findings, "hand resplit under @ht.autoshard must fire SPMD505"
+    assert "solver owns" in findings[0].message
+
+
+def test_spmd505_triggers_on_inline_wrapped_def():
+    findings = lint("""
+import heat_tpu as ht
+
+def pipeline():
+    a = ht.ones((8, 8), split=0)
+    return a.resplit(1)
+
+solved = ht.autoshard(pipeline)
+""", "SPMD505")
+    assert findings, "ht.autoshard(pipeline) wrapping must fire SPMD505"
+
+
+def test_spmd505_clean_without_autoshard():
+    assert lint("""
+import heat_tpu as ht
+
+def pipeline():
+    a = ht.ones((8, 8), split=0)
+    return a.resplit(1)
+""", "SPMD505") == []
+
+
+def test_spmd505_clean_for_layout_free_autoshard_body():
+    assert lint("""
+import heat_tpu as ht
+
+@ht.autoshard
+def pipeline(x, y):
+    return ht.sqrt(ht.abs(x + y))
+""", "SPMD505") == []
+
+
+def test_spmd505_suppression_honored():
+    assert lint("""
+import heat_tpu as ht
+
+@ht.autoshard
+def pipeline():
+    a = ht.ones((8, 8), split=0)
+    return a.resplit(1)  # spmdlint: disable=SPMD505
+""", "SPMD505") == []
+
+
 def test_program_rules_never_fire_on_unknown_layouts():
     # open-world parameters are ⊤; rules must stay silent, not guess
     assert [f for f in lint("""

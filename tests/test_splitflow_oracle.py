@@ -49,6 +49,7 @@ RETURNS = {
     "gnb_pipeline": ("x", "y", "pred", "proba"),
     "fused_pipeline": ("a", "b", "out"),
     "resplit_pipeline": ("x", "y", "z", "w"),
+    "staged_resplit_pipeline": ("x", "w"),
 }
 
 

@@ -1264,7 +1264,7 @@ def test_every_rule_is_registered():
         "SPMD001", "SPMD101", "SPMD102", "SPMD201", "SPMD202", "SPMD203",
         "SPMD204", "SPMD205", "SPMD206", "SPMD207", "SPMD208", "SPMD209",
         "SPMD210", "SPMD301", "SPMD302",
-        "SPMD401", "SPMD501", "SPMD502", "SPMD503", "SPMD504",
+        "SPMD401", "SPMD501", "SPMD502", "SPMD503", "SPMD504", "SPMD505",
     ]
 
 
