@@ -106,6 +106,8 @@ _HEADLINE = {
     "lasso_sweeps_per_sec": True,
     "serve_predictions_per_sec": True,
     "serve_p99_ms": False,
+    "replica_cold_start_ms": False,
+    "scale_event_p99_ms": False,
     "qr_svd_tall_skinny_ms": False,
     "attention_tokens_per_sec": True,
     "causal_attention_tokens_per_sec": True,
@@ -186,6 +188,13 @@ _GOLDEN_MAP = {
     # secondary machine-health control the _GOLDEN_MAP can express
     "serve_predictions_per_sec": ("roundtrip_ms", "mul"),
     "serve_p99_ms": ("roundtrip_ms", "div"),
+    # replica spin-up is host-side work (engine construction, sidecar
+    # read, executable install — zero device compiles by construction,
+    # asserted in fleet_model.zero_compile_scale_ups), so both fleet
+    # latencies track host/tunnel health: the latency golden is the
+    # control ("div": two latencies move together under a slower host)
+    "replica_cold_start_ms": ("roundtrip_ms", "div"),
+    "scale_event_p99_ms": ("roundtrip_ms", "div"),
     # qr_svd is a single fused dispatch as of r6 (the whole QR+SVD
     # pipeline in one fenced fori_loop — see qr_svd_ms), so the metric is
     # back to tracking device compute and its control is the compute
@@ -349,6 +358,15 @@ _NOT_MODELED = {
     "serve_p99_ms":
         "same serving stack, tail-latency view: p99 is queueing + batching "
         "delay + dispatch latency, not chip work — no fixed FLOP count",
+    "replica_cold_start_ms":
+        "host-side by design: engine construction + registry sidecar read "
+        "+ executable install, zero device compiles (the point of the "
+        "zero-cold-start path, asserted via fleet_model."
+        "zero_compile_scale_ups) — no chip roofline applies",
+    "scale_event_p99_ms":
+        "host-side by design: one autoscaler decision plus the warm "
+        "replica's first replies — dominated by replica_cold_start_ms, "
+        "same no-chip-work reasoning",
 }
 
 
@@ -531,6 +549,21 @@ _FLAG_DISPOSITIONS = {
         "kernel (f32 operands, 6-pass matmuls, ~33 TF/s ceiling); moves "
         "with causal_attention_tokens_per_sec under schedule changes and "
         "diverges from it only on precision-path regressions",
+    "replica_cold_start_ms":
+        "new in r15 (fleet-elasticity tentpole): median warm spin-up of a "
+        "scale-up replica (ctor + sidecar read + executable install); no "
+        "prior-round history.  The in-run verdict is fleet_model."
+        "zero_compile_scale_ups == true — if that flips false the sidecar "
+        "fell back to fresh compiles and the latency slide is a "
+        "CORRECTNESS signal, not noise; otherwise the metric is pure "
+        "host/tunnel work, read it against the roundtrip golden",
+    "scale_event_p99_ms":
+        "new in r15: tail of the autoscaler decision-to-first-reply "
+        "window across repeated scale-up events; dominated by "
+        "replica_cold_start_ms plus one micro-batch round trip per "
+        "replica — read the two together, and read scale_event_p50_ms in "
+        "fleet_model for the body-vs-tail split before calling a slide "
+        "real",
 }
 
 
@@ -1960,6 +1993,90 @@ def serve_rates(data):
     return (pps, pps_spread), (p99, p99_spread), twin, model
 
 
+def fleet_rates(data):
+    """PR-15 tentpole: fleet elasticity (heat_tpu.serve.fleet).  A KMeans
+    predict pipeline is AOT-exported to the registry executable sidecar,
+    then a watermark-autoscaled fleet is cycled through repeated
+    scale-up/scale-down events.  replica_cold_start_ms is the median
+    time a scale-up replica takes to come up WARM (engine construction +
+    sidecar load + executable install); scale_event_p99_ms is the tail
+    of the decision-to-first-reply window (one autoscaler tick that adds
+    a replica, then one request answered by every replica including the
+    newcomer).  The zero-cold-start verdict rides in fleet_model:
+    zero_compile_scale_ups asserts the fuse/compile miss counters never
+    moved across any post-scale first predict — every new replica
+    replayed installed executables, compiled nothing."""
+    import tempfile
+
+    import heat_tpu as ht
+    from heat_tpu import telemetry
+    from heat_tpu.serve import (
+        FleetEngine,
+        ModelRegistry,
+        ServeEngine,
+        WatermarkAutoscaler,
+    )
+
+    fit_rows = 2_000 if _SMOKE else 20_000
+    km = ht.cluster.KMeans(n_clusters=K, max_iter=3, random_state=0)
+    km.fit(ht.array(data[:fit_rows], split=0))
+    reg = ModelRegistry(tempfile.mkdtemp(prefix="heat-fleet-bench-"))
+    reg.publish("bench", "km", km)
+    src = ServeEngine(reg, max_batch_rows=64, min_bucket=8)
+    bundles = src.export_warm("bench", "km", version=1)
+    src.close()
+    reg.publish_executables("bench", "km", 1, bundles)
+
+    events = 5 if _SMOKE else 20
+    auto = WatermarkAutoscaler(low=1.0, high=4.0, hysteresis=1, max_replicas=2)
+    fleet = FleetEngine(reg, autoscaler=auto,
+                        warm_models=[("bench", "km", 1)],
+                        max_batch_rows=64, min_bucket=8)
+    was_enabled = telemetry.is_enabled()
+    telemetry.enable()
+    payload = np.ascontiguousarray(data[:8], dtype=np.float32)
+    fleet.predict("bench", "km", payload, version=1)  # route/bucket warmup
+    scale_ms = []
+    zero_compiles = True
+    for _ in range(events):
+        before = dict(telemetry.snapshot()["counters"])
+        t0 = time.perf_counter()
+        fleet.tick(queue_depth=50.0)  # high watermark: +1 replica, warmed
+        # round-robin one request onto every replica — the newcomer's
+        # first reply is inside this window
+        for _r in range(len(fleet.replicas)):
+            fleet.predict("bench", "km", payload, version=1)
+        scale_ms.append((time.perf_counter() - t0) * 1e3)
+        after = telemetry.snapshot()["counters"]
+        zero_compiles &= (
+            after.get("fuse.cache.misses", 0)
+            == before.get("fuse.cache.misses", 0)
+            and after.get("compile.cache.misses", 0)
+            == before.get("compile.cache.misses", 0)
+        )
+        fleet.tick(queue_depth=0.0)  # low watermark: back down to one
+    installed = [e["installed"] for e in fleet.scale_events
+                 if e["action"] == "scale-up"]
+    cold = list(fleet.cold_start_ms[1:])  # skip the bootstrap replica
+    stats = fleet.stats()
+    fleet.close()
+    if not was_enabled:
+        telemetry.disable()
+    cold_ms, cold_spread = _summary(cold)
+    p99 = float(np.percentile(scale_ms, 99))
+    _, scale_spread = _summary(scale_ms)
+    model = {
+        "scale_events": events,
+        "scale_ups": stats["scale_ups"],
+        "scale_downs": stats["scale_downs"],
+        "installed_per_scale_up": min(installed) if installed else 0,
+        "zero_compile_scale_ups": bool(zero_compiles),
+        "scale_event_p50_ms": round(float(np.percentile(scale_ms, 50)), 3),
+        "exported_bundles": len(bundles),
+    }
+    return (cold_ms, cold_spread), (p99, scale_spread), model
+
+
 #: headline-metric -> golden measurement group (goldens re-measured at
 #: each group boundary, adjacent in time to the metrics they control)
 _METRIC_GROUP = {
@@ -1980,6 +2097,8 @@ _METRIC_GROUP = {
     "lasso_sweeps_per_sec": "eager_lasso",
     "serve_predictions_per_sec": "serve",
     "serve_p99_ms": "serve",
+    "replica_cold_start_ms": "serve",
+    "scale_event_p99_ms": "serve",
     "qr_svd_tall_skinny_ms": "qr",
     "attention_tokens_per_sec": "attention",
     "causal_attention_tokens_per_sec": "attention",
@@ -2091,6 +2210,11 @@ def main():
         serve_twin,
         serve_model,
     ) = serve_rates(data)
+    (
+        (fleet_cold_ms, fleet_cold_spread),
+        (fleet_p99_ms, fleet_scale_spread),
+        fleet_model,
+    ) = fleet_rates(data)
     golden.measure("qr")
     qr_ms, qr_spread = qr_svd_ms()
     golden.measure("attention")
@@ -2206,6 +2330,15 @@ def main():
                     else None
                 ),
                 "serve_model": serve_model,
+                # PR-15 tentpole: watermark-autoscaled fleet elasticity —
+                # a scale-up replica warms from the registry executable
+                # sidecar (zero compiles, asserted in
+                # fleet_model.zero_compile_scale_ups) and the pair below
+                # is its spin-up cost: median warm cold-start and the
+                # p99 of the decision-to-first-reply window
+                "replica_cold_start_ms": round(fleet_cold_ms, 3),
+                "scale_event_p99_ms": round(fleet_p99_ms, 3),
+                "fleet_model": fleet_model,
                 "qr_svd_tall_skinny_ms": round(qr_ms, 2),
                 # sequence-parallel flagship: fused flash-attention
                 # forwards, bf16 S=4096 H=16 D=64 (tokens/s)
@@ -2243,6 +2376,10 @@ def main():
                     "lasso_sweeps_per_sec": lasso_spread,
                     "serve_predictions_per_sec": serve_pps_spread,
                     "serve_p99_ms": serve_p99_spread,
+                    "replica_cold_start_ms": fleet_cold_spread,
+                    # dispersion of the underlying scale-event windows
+                    # (the headline is their p99)
+                    "scale_event_p99_ms": fleet_scale_spread,
                     "qr_svd_tall_skinny_ms": qr_spread,
                     "attention_tokens_per_sec": attn_spread,
                     "causal_attention_tokens_per_sec": causal_spread,

@@ -1478,6 +1478,111 @@ def check_traced_observability(ctx: FileContext) -> Iterable[Finding]:
 
 
 # --------------------------------------------------------------------- #
+# SPMD211: retry loop without a deadline                                 #
+# --------------------------------------------------------------------- #
+#: identifier fragments whose presence anywhere in the loop marks it as
+#: BOUNDED: a deadline/timeout check, an attempt budget, or delegation to
+#: the retry engine (``for attempt in retry(policy)`` never matches the
+#: rule anyway — it is a ``for``, not a ``while True``)
+_RETRY_BOUND_MARKERS = (
+    "deadline", "attempt", "retry", "timeout", "tries", "budget", "backoff",
+)
+
+
+def _loop_mentions_bound(node: ast.While) -> bool:
+    """True when any identifier in the loop smells like a bound — the
+    author is counting attempts or watching a clock, so the loop is a
+    (possibly hand-rolled) bounded retry, not an infinite one."""
+    for sub in ast.walk(node):
+        name = None
+        if isinstance(sub, ast.Name):
+            name = sub.id
+        elif isinstance(sub, ast.Attribute):
+            name = sub.attr
+        elif isinstance(sub, ast.keyword):
+            name = sub.arg
+        if name is not None:
+            low = name.lower()
+            if any(m in low for m in _RETRY_BOUND_MARKERS):
+                return True
+    return False
+
+
+def _handler_swallows_and_retries(handler: ast.ExceptHandler) -> bool:
+    """True when the handler neither escapes the loop (``break``/
+    ``return``) nor propagates (``raise``) — control falls back to the
+    ``while True`` header and the failing call runs again, forever."""
+    for stmt in handler.body:
+        for sub in ast.walk(stmt):
+            if isinstance(sub, (ast.Raise, ast.Break, ast.Return)):
+                return False
+    return True
+
+
+def _retried_site(ctx: FileContext, try_node: ast.Try) -> Optional[str]:
+    """The retry-worthy call inside the ``try`` body, if any: a compiled
+    program call (fuse/jit product) or one of SPMD207's guarded io/layout
+    sites.  Anything else failing forever is somebody else's lint."""
+    for stmt in try_node.body:
+        for sub in ast.walk(stmt):
+            if not isinstance(sub, ast.Call):
+                continue
+            if _is_compiled_callable(ctx, sub.func, sub):
+                return "a compiled program call"
+            dotted = ctx.resolve(sub.func) or ""
+            leaf = dotted.rsplit(".", 1)[-1]
+            if leaf in _GUARDED_SITE_CALLS:
+                return f"guarded site {leaf!r}"
+    return None
+
+
+@rule("SPMD211", "retry loop without a deadline around a compiled/guarded call")
+def check_unbounded_retry(ctx: FileContext) -> Iterable[Finding]:
+    """A ``while True`` whose body try/excepts a compiled program call or
+    a guarded io/layout site, where the handler swallows and loops (no
+    ``raise``/``break``/``return``), retries FOREVER: a permanent fault
+    (mesh gone, manifest corrupt, sidecar deleted) turns into a silent
+    busy-loop that holds the serving thread, never surfaces an incident,
+    and defeats the chaos lane's determinism (fire counts diverge with
+    host timing).  Bounded retries belong on the retry engine —
+    ``for attempt in resilience.retry.retry(policy, site=...)`` gives a
+    deadline, jittered backoff, and incident records for free.  Loops
+    that visibly count attempts or check a deadline/timeout are exempt,
+    as is the retry engine's own implementation."""
+    if ctx.relpath.endswith("resilience/retry.py"):
+        return
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.While):
+            continue
+        test = node.test
+        if not (isinstance(test, ast.Constant) and bool(test.value)):
+            continue
+        if _loop_mentions_bound(node):
+            continue
+        for sub in ast.walk(node):
+            if not isinstance(sub, ast.Try):
+                continue
+            site = _retried_site(ctx, sub)
+            if site is None:
+                continue
+            for handler in sub.handlers:
+                if not _handler_swallows_and_retries(handler):
+                    continue
+                yield ctx.finding(
+                    "SPMD211", handler,
+                    f"`while True` retries {site} with no deadline or "
+                    "attempt budget — a permanent fault becomes an "
+                    "infinite busy-loop",
+                    hint="route the call through `for attempt in "
+                    "resilience.retry.retry(policy, site=...)` (deadline + "
+                    "seeded backoff + incidents), or bound the loop with "
+                    "an attempt counter / deadline check; mark with "
+                    "`# spmdlint: disable=SPMD211` if the forever-retry "
+                    "is deliberate",
+                )
+
+
+# --------------------------------------------------------------------- #
 # SPMD301/302: Pallas tiling and grids                                   #
 # --------------------------------------------------------------------- #
 @rule("SPMD301", "Pallas BlockSpec tiles must respect the hardware tile grid")

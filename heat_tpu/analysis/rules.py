@@ -16,6 +16,8 @@ SPMD206    monolithic split→split resplit inside a loop body
 SPMD207    silent broad except around dispatch/collective/io sites
 SPMD208    unbucketed dynamic batch shape entering a compiled program in a loop
 SPMD209    serialized ring body: ppermute result consumed in the same round
+SPMD210    request-scoped observability inside traced functions
+SPMD211    retry loop without a deadline around a compiled/guarded call
 SPMD301    Pallas BlockSpec tiles must respect the hardware tile grid
 SPMD302    pallas_call grids must be static (no traced values)
 SPMD401    jitted() cache keys: hashable, identity-stable parts only

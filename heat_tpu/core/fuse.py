@@ -74,6 +74,11 @@ __all__ = ["fuse", "FuseTraceError"]
 
 _FUSE_CACHE: Dict[Tuple, Any] = {}
 
+#: active AOT capture sinks (:func:`heat_tpu.core.aot.capture_programs`):
+#: each is a dict keyed by fuse-cache key, fed one entry per distinct
+#: cache-keyed call so a warm process can export its executables
+_CAPTURE_SINKS: list = []
+
 
 @contextlib.contextmanager
 def _null_ctx():
@@ -102,13 +107,18 @@ class _Program:
     guarded and an unguarded trace of the same pipeline never collide.
     """
 
-    __slots__ = ("jfn", "out_treedef", "out_meta", "guarded")
+    __slots__ = ("jfn", "out_treedef", "out_meta", "guarded", "aot_payload")
 
     def __init__(self, jfn):
         self.jfn = jfn
         self.out_treedef = None
         self.out_meta = None
         self.guarded = False
+        # set only on installed programs: the original serialized
+        # (payload, in_tree, out_tree) triple, kept so a warm replica can
+        # re-export without re-serializing a loaded executable (which
+        # XLA cannot soundly deserialize a second time)
+        self.aot_payload = None
 
 
 def _build(fn: Callable, slots: Tuple, treedef, donate: bool) -> _Program:
@@ -237,6 +247,19 @@ class _FusedFunction:
         elif _tel.enabled:
             _tel.inc("fuse.cache.hits")
 
+        # AOT capture: operand specs must be snapshotted BEFORE the call
+        # (donation may consume the buffers), the entry recorded after it
+        # (the first call populates program.out_meta)
+        capture_specs = None
+        if _CAPTURE_SINKS and key is not None:
+            capture_specs = tuple(
+                jax.ShapeDtypeStruct(
+                    tuple(op.shape), op.dtype,
+                    sharding=op.sharding if isinstance(op, jax.Array) else None,
+                )
+                for op in operands
+            )
+
         # jax.jit is lazy, so the plan context must cover EVERY launch:
         # the first call runs the DNDarray trace (where resplits consult
         # the plan) inside jfn, and jit may silently retrace later
@@ -255,6 +278,20 @@ class _FusedFunction:
             else:
                 raws = program.jfn(tuple(operands))
         record_dispatch()
+
+        if capture_specs is not None:
+            entry = {
+                "fn": self._fn,
+                "donate": self._donate,
+                "plan_token": self._plan_token,
+                "treedef": treedef,
+                "keyparts": tuple(keyparts),
+                "comm": comm,
+                "program": program,
+                "specs": capture_specs,
+            }
+            for sink in _CAPTURE_SINKS:
+                sink.setdefault(key, entry)
 
         flag = None
         if program.guarded:

@@ -46,7 +46,7 @@ resume determinism contract.
 
 from __future__ import annotations
 
-from .faults import DeviceLossError, Preempted, inject
+from .faults import DeviceArrival, DeviceLossError, Preempted, inject
 from .guards import (
     GuardWarning,
     NumericalHealthError,
@@ -62,13 +62,14 @@ from .resume import (
     save_loop_state,
 )
 from .retry import RetryPolicy
-from .elastic import DeadlineWatchdog, recover, set_watchdog
+from .elastic import DeadlineWatchdog, grow, recover, set_watchdog
 # NOTE: bound last on purpose — `retry` must stay the submodule at the
 # package level (the engine function is retry.retry / retry.call)
 from . import elastic, faults, guards, incidents, resume, retry
 
 __all__ = [
     "DeadlineWatchdog",
+    "DeviceArrival",
     "DeviceLossError",
     "GuardWarning",
     "Incident",
@@ -81,6 +82,7 @@ __all__ = [
     "elastic",
     "faults",
     "get_guard_policy",
+    "grow",
     "guard",
     "guards",
     "incident_log",

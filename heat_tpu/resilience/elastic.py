@@ -1,4 +1,4 @@
-"""Elastic recovery: shrink the mesh, migrate the carry, resume the fit.
+"""Elastic recovery: resize the mesh, migrate the carry, resume the fit.
 
 PR 5 made the segmented fit loops preemption-safe (snapshot the carry at
 every segment boundary; resume is bitwise-equal to never having been
@@ -30,6 +30,15 @@ block grid at the next ring step, so the int8_block trajectory at mesh
 ``Q`` differs from the never-interrupted mesh-``P`` one only within the
 documented quantization bound.)
 
+Since PR 15 the contract is direction-symmetric: :func:`grow` re-enters
+a fit on a LARGER mesh when devices arrive (injected ``device_arrival``,
+or the fleet autoscaler's scale-up decision), with the same guarantee —
+grown-at-``Q`` is bitwise-identical to an uninterrupted mesh-``Q`` fit
+resumed from the same snapshot.  :func:`migrate_stacked` already works
+in both directions (``r -> r * new_p // old_p`` folds rows going down
+and spreads them injectively going up), so shrink and grow share one
+migration path and one re-entry driver.
+
 The :class:`DeadlineWatchdog` closes the detection loop: per-site
 dispatch budgets are fed from telemetry span aggregates (mean duration ×
 ``factor``), and a dispatch blowing its budget — including simulated
@@ -56,6 +65,7 @@ __all__ = [
     "DeadlineWatchdog",
     "dispatch_guard",
     "get_watchdog",
+    "grow",
     "migrate_stacked",
     "migrate_state",
     "recover",
@@ -132,13 +142,15 @@ def migrate_state(
                     jnp.asarray(np.ascontiguousarray(migrated)), 0
                 )
         out[name] = migrated
+        growing = new_mesh > old_mesh
         incidents.record(
-            kind="mesh-shrink",
+            kind="mesh-grow" if growing else "mesh-shrink",
             site=f"elastic.{name}",
             policy=f"migrate_stacked({old_mesh}->{new_mesh})",
             action="migrated",
-            detail=f"carry entry {name!r}: {old_mesh} rows folded into "
-            f"{new_mesh} (deferred residual mass conserved)",
+            detail=f"carry entry {name!r}: {old_mesh} rows "
+            + ("spread over" if growing else "folded into")
+            + f" {new_mesh} (deferred residual mass conserved)",
         )
         if _tel.enabled:
             _tel.inc("resilience.elastic.migrated")
@@ -273,8 +285,49 @@ def dispatch_guard(site: str, comm=None):
 
 
 # --------------------------------------------------------------------- #
-# recovery driver                                                        #
+# re-entry drivers (shrink and grow share one body)                      #
 # --------------------------------------------------------------------- #
+def _reenter(fit, snapshot: str, data, comm, policy, *, site: str,
+             kind: str, start_action: str, done_action: str,
+             done_detail: str, counter: str):
+    """The shared kill→resize→resume body behind :func:`recover` and
+    :func:`grow`: probe the snapshot under the seeded retry policy,
+    repoint the fit's checkpoint path, re-enter via ``resume="elastic"``
+    (which migrates the carry to the comm the input data lives on), and
+    bracket it all with incidents."""
+    probe = _retry.retry(policy or _retry.IO_POLICY, site=site)
+    state, meta = None, None
+    for attempt in probe:
+        with attempt:
+            state, meta = _resume.load_loop_state(snapshot)
+    old_mesh = meta.get("mesh")
+    new_mesh = int(getattr(comm, "size", 0) or 0) or None
+    if hasattr(fit, "checkpoint_path") and fit.checkpoint_path != snapshot:
+        fit.checkpoint_path = snapshot
+    incidents.record(
+        kind=kind,
+        site=site,
+        policy="elastic",
+        action=start_action,
+        detail=f"resuming {meta.get('algo')!r} from it={meta.get('it')} "
+        f"on mesh {old_mesh}->{new_mesh if new_mesh else '?'}",
+    )
+    if _tel.enabled:
+        _tel.inc(counter)
+    if hasattr(fit, "fit"):
+        out = fit.fit(*data, resume="elastic")
+    else:
+        out = fit(*data, resume="elastic") if data else fit()
+    incidents.record(
+        kind=kind,
+        site=site,
+        policy="elastic",
+        action=done_action,
+        detail=f"{meta.get('algo')!r} {done_detail}",
+    )
+    return out
+
+
 def recover(fit, snapshot: str, *data, comm=None,
             policy: Optional[_retry.RetryPolicy] = None):
     """Kill→shrink→recover in one call.
@@ -287,34 +340,37 @@ def recover(fit, snapshot: str, *data, comm=None,
     policy — recovery is exactly when storage is most likely to still be
     failing over — and the whole cycle lands in the incident log.
     """
-    probe = _retry.retry(policy or _retry.IO_POLICY, site="elastic.recover")
-    state, meta = None, None
-    for attempt in probe:
-        with attempt:
-            state, meta = _resume.load_loop_state(snapshot)
-    old_mesh = meta.get("mesh")
-    new_mesh = int(getattr(comm, "size", 0) or 0) or None
-    if hasattr(fit, "checkpoint_path") and fit.checkpoint_path != snapshot:
-        fit.checkpoint_path = snapshot
-    incidents.record(
-        kind="device-loss",
+    return _reenter(
+        fit, snapshot, data, comm, policy,
         site="elastic.recover",
-        policy="elastic",
-        action="recovering",
-        detail=f"resuming {meta.get('algo')!r} from it={meta.get('it')} "
-        f"on mesh {old_mesh}->{new_mesh if new_mesh else '?'}",
-    )
-    if _tel.enabled:
-        _tel.inc("resilience.elastic.recoveries")
-    if hasattr(fit, "fit"):
-        out = fit.fit(*data, resume="elastic")
-    else:
-        out = fit(*data, resume="elastic") if data else fit()
-    incidents.record(
         kind="device-loss",
-        site="elastic.recover",
-        policy="elastic",
-        action="recovered",
-        detail=f"{meta.get('algo')!r} finished on the shrunk mesh",
+        start_action="recovering",
+        done_action="recovered",
+        done_detail="finished on the shrunk mesh",
+        counter="resilience.elastic.recoveries",
     )
-    return out
+
+
+def grow(fit, snapshot: str, *data, comm=None,
+         policy: Optional[_retry.RetryPolicy] = None):
+    """Arrival→grow→resume in one call — the scale-up mirror of
+    :func:`recover`.
+
+    ``comm`` spans the ENLARGED device set (survivors + arrivals) and
+    ``data`` are the input arrays already built on it; the snapshot is
+    the one the smaller-mesh fit was ticking.  The carry migrates up
+    through the same :func:`migrate_state` path shrink uses
+    (``r -> r * new_p // old_p`` is injective going up, so no residual
+    mass merges), and the re-entered fit is **bitwise-identical** to an
+    uninterrupted fit on the large mesh resumed from the same snapshot —
+    the contract the fleet autoscaler's scale-up events lean on.
+    """
+    return _reenter(
+        fit, snapshot, data, comm, policy,
+        site="elastic.grow",
+        kind="device-arrival",
+        start_action="growing",
+        done_action="grown",
+        done_detail="finished on the grown mesh",
+        counter="resilience.elastic.grows",
+    )

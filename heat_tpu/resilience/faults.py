@@ -39,6 +39,13 @@ Kinds
     error carries the surviving-mesh description.  Catch it, shrink the
     mesh, then ``fit(..., resume="elastic")`` — the ICE-preempted-host
     lifecycle of a multi-host TPU slice.
+``"device_arrival"``
+    The inverse of ``device_loss``: raises :class:`DeviceArrival` at an
+    arrival point (the fleet's scale tick), announcing ``rank`` new
+    devices (default 1) joining the mesh.  Catch it, build a comm over
+    the larger device set, then :func:`heat_tpu.resilience.elastic.grow`
+    — the scale-up half of the elastic lifecycle, as a pure function of
+    the plan's seed.
 ``"slow_rank"``
     Arms a simulated straggler: :func:`extra_latency` reports ``delay``
     extra seconds for rank ``rank`` at matching sites.  Consumed by the
@@ -64,7 +71,14 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-__all__ = ["DeviceLossError", "Preempted", "inject", "any_active", "clear"]
+__all__ = [
+    "DeviceArrival",
+    "DeviceLossError",
+    "Preempted",
+    "inject",
+    "any_active",
+    "clear",
+]
 
 _KINDS = (
     "nonfinite",
@@ -73,6 +87,7 @@ _KINDS = (
     "io_error",
     "preempt",
     "device_loss",
+    "device_arrival",
     "slow_rank",
 )
 
@@ -110,6 +125,28 @@ class DeviceLossError(RuntimeError):
         self.survivors = tuple(
             r for r in range(self.mesh_size) if r != self.lost_rank
         )
+        self.site = site
+
+
+class DeviceArrival(RuntimeError):
+    """New devices joined the mesh (injected ``device_arrival``) — the
+    scale-up mirror of :class:`DeviceLossError`.
+
+    Carries the arrival topology so callers can grow: ``arrived`` (how
+    many devices showed up), ``mesh_size`` (the old device count),
+    ``new_mesh_size`` (old + arrived).  The latest snapshot is durable
+    (the arrival point sits after the checkpoint tick), so the scale-up
+    story is: build a comm over the larger device set, then
+    :func:`heat_tpu.resilience.elastic.grow` — bitwise-identical to a
+    run that held the big mesh all along.
+    """
+
+    def __init__(self, message: str, *, arrived: int, mesh_size: int,
+                 site: str = ""):
+        super().__init__(message)
+        self.arrived = int(arrived)
+        self.mesh_size = int(mesh_size)
+        self.new_mesh_size = self.mesh_size + self.arrived
         self.site = site
 
 
@@ -154,8 +191,14 @@ class _Plan:
     def should_fire(self, site: Optional[str] = None) -> bool:
         """One schedule decision.  Every trigger opportunity advances the
         call counter AND the RNG stream (even under ``nth``), so a plan's
-        fire pattern depends only on the opportunity sequence."""
-        if self.site is not None and site is not None and site != self.site:
+        fire pattern depends only on the opportunity sequence.
+
+        A plan armed with a ``site`` fires ONLY at seams that announce
+        that exact site — a seam that passes no site (``site=None``)
+        never matches a site-filtered plan.  This keeps e.g. a
+        ``site="registry_open"`` io_error plan from leaking into the
+        checkpoint/HDF5 open seams that predate site announcements."""
+        if self.site is not None and site != self.site:
             return False
         self.calls += 1
         draw = float(self.rng.random())
@@ -278,10 +321,13 @@ def payload_input(site: str, array):
     return array
 
 
-def io_open(path: str) -> None:
-    """Transient-``OSError`` seam at an HDF5/NetCDF open site."""
+def io_open(path: str, site: Optional[str] = None) -> None:
+    """Transient-``OSError`` seam at a file-open site.  ``site`` (e.g.
+    ``"registry_open"`` for the fleet's model-registry reads) lets a plan
+    target one open seam; the HDF5/NetCDF/checkpoint sites pass no site
+    and so only match unfiltered plans."""
     for plan in list(_PLANS):
-        if plan.kind == "io_error" and plan.should_fire():
+        if plan.kind == "io_error" and plan.should_fire(site):
             raise OSError(
                 errno.EIO, f"injected transient IO fault (seed={plan.seed})", path
             )
@@ -314,6 +360,27 @@ def device_point(site: str, mesh: Optional[int] = None) -> None:
                 f"#{plan.calls}); latest snapshot is durable — shrink the "
                 f'mesh and resume with resume="elastic"',
                 lost_rank=lost,
+                mesh_size=size,
+                site=site,
+            )
+
+
+def arrival_point(site: str, mesh: Optional[int] = None) -> None:
+    """Device-arrival seam — the scale-up mirror of
+    :func:`device_point`, placed at the fleet's scale tick (after the
+    durable snapshot, same contract).  ``mesh`` is the current device
+    count; the plan's ``rank`` is reused as the number of arriving
+    devices (default 1)."""
+    for plan in list(_PLANS):
+        if plan.kind == "device_arrival" and plan.should_fire(site):
+            size = int(mesh) if mesh is not None else 1
+            arrived = plan.rank if plan.rank is not None else 1
+            raise DeviceArrival(
+                f"injected device arrival at {site}: {arrived} device(s) "
+                f"joined mesh size {size} (seed={plan.seed}, opportunity "
+                f"#{plan.calls}); latest snapshot is durable — build a "
+                f"comm over the larger device set and grow",
+                arrived=arrived,
                 mesh_size=size,
                 site=site,
             )

@@ -113,15 +113,25 @@ IO_POLICY = RetryPolicy(attempts=3, base_delay=0.01, retry_on=(OSError,))
 def backoff_schedule(policy: RetryPolicy) -> Tuple[float, ...]:
     """The full delay schedule (seconds before retry 1, 2, …) a policy
     produces — a pure function of the policy, seed included.  Exposed so
-    tests (and operators) can pin the chaos lane's exact sleeps."""
+    tests (and operators) can pin the chaos lane's exact sleeps.
+
+    A ``deadline`` truncates the schedule: once the cumulative sleep
+    reaches the deadline, no further retry can ever run (the engine's
+    runtime check gives up first), so those tail delays are dropped and
+    the schedule length tells the truth about the retries a policy can
+    actually deliver."""
     rng = np.random.default_rng(
         policy.seed if policy.seed is not None else _default_seed()
     )
     out = []
+    total = 0.0
     for k in range(policy.attempts - 1):
+        if policy.deadline is not None and total >= policy.deadline:
+            break
         delay = min(policy.base_delay * policy.multiplier**k, policy.max_delay)
         factor = 1.0 + policy.jitter * float(rng.uniform(-1.0, 1.0))
         out.append(delay * factor)
+        total += out[-1]
     return tuple(out)
 
 
@@ -188,10 +198,13 @@ class Retrying:
         past_deadline = (
             self.policy.deadline is not None and elapsed >= self.policy.deadline
         )
+        # a deadline-truncated schedule can be shorter than attempts-1;
+        # running past its end is the same give-up as the runtime check
+        out_of_schedule = attempt.number > len(self.delays)
         if _tel.enabled:
             _tel.inc("resilience.retries")
             _tel.inc(f"resilience.retries.{self.site}")
-        if out_of_attempts or past_deadline:
+        if out_of_attempts or past_deadline or out_of_schedule:
             self._done = True
             if _tel.enabled:
                 _tel.inc("resilience.retry_exhausted")
@@ -203,6 +216,11 @@ class Retrying:
                 detail=(
                     f"attempt {attempt.number}/{self.policy.attempts}"
                     + (", deadline exceeded" if past_deadline else "")
+                    + (
+                        ", schedule truncated at deadline"
+                        if out_of_schedule and not past_deadline
+                        else ""
+                    )
                     + f": {exc}"
                 ),
             )

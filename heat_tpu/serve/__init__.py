@@ -14,7 +14,11 @@ Four parts, one pipeline:
   ``serve:*`` spans and queue/occupancy gauges;
 - :mod:`loadgen` — seeded open-loop load generation producing the
   ``serve_predictions_per_sec`` / ``serve_p99_ms`` headlines with an
-  in-run unbatched direct-predict twin as the bitwise golden.
+  in-run unbatched direct-predict twin as the bitwise golden;
+- :mod:`fleet` — fleet-scale elasticity on top of the engine: watermark
+  autoscaling over the queue/SLO signals, zero-cold-start replicas
+  replaying serialized AOT executables from the registry sidecar, and
+  seeded canary rollout with a same-run stable golden twin.
 
 The contract underneath it all: a batched reply is BITWISE equal to the
 same request's unbatched predict, because every predict program in the
@@ -24,6 +28,8 @@ reply leaves the engine.
 
 from .batcher import MicroBatcher, Request, StagingPool, bucket_rows, pad_batch
 from .engine import Reply, ServeEngine
+from .errors import ServeClosedError, ServeOverloadError
+from .fleet import CanaryConfig, FleetEngine, WatermarkAutoscaler
 from .registry import (
     ManifestError,
     ModelNotFoundError,
@@ -34,6 +40,8 @@ from .registry import (
 from . import loadgen
 
 __all__ = [
+    "CanaryConfig",
+    "FleetEngine",
     "ManifestError",
     "MicroBatcher",
     "ModelNotFoundError",
@@ -41,9 +49,12 @@ __all__ = [
     "RegistryError",
     "Reply",
     "Request",
+    "ServeClosedError",
     "ServeEngine",
+    "ServeOverloadError",
     "StagingPool",
     "VersionNotFoundError",
+    "WatermarkAutoscaler",
     "bucket_rows",
     "loadgen",
     "pad_batch",

@@ -44,6 +44,7 @@ from typing import Callable, Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from ..telemetry import _core as _tel
+from .errors import ServeClosedError, ServeOverloadError
 
 __all__ = ["MicroBatcher", "Request", "StagingPool", "bucket_rows", "pad_batch"]
 
@@ -164,20 +165,27 @@ class MicroBatcher:
         max_batch_rows: int = 64,
         max_delay_s: float = 0.002,
         name: str = "serve",
+        max_queue_rows: Optional[int] = None,
     ):
         if int(max_batch_rows) < 1:
             raise ValueError(f"max_batch_rows must be >= 1, got {max_batch_rows}")
         if float(max_delay_s) < 0:
             raise ValueError(f"max_delay_s must be >= 0, got {max_delay_s}")
+        if max_queue_rows is not None and int(max_queue_rows) < 1:
+            raise ValueError(
+                f"max_queue_rows must be >= 1 (or None), got {max_queue_rows}"
+            )
         self._process = process
         self.max_batch_rows = int(max_batch_rows)
         self.max_delay_s = float(max_delay_s)
+        self.max_queue_rows = None if max_queue_rows is None else int(max_queue_rows)
         self.name = name
         self._queue: "deque[Request]" = deque()
         self._cond = threading.Condition()
         self._seq = 0
         self._worker: Optional[threading.Thread] = None
         self._closed = False
+        self.n_shed = 0
 
     # ------------------------------------------------------------------ #
     @property
@@ -209,7 +217,32 @@ class MicroBatcher:
             trace_id = ambient[-1] if ambient else None
         with self._cond:
             if self._closed:
-                raise RuntimeError(f"MicroBatcher {self.name!r} is closed")
+                raise ServeClosedError(f"MicroBatcher {self.name!r} is closed")
+            rows = int(payload.shape[0])
+            if self.max_queue_rows is not None:
+                pending = self._rows_pending()
+                if pending + rows > self.max_queue_rows:
+                    # shed, with a deterministic retry hint: micro-batches
+                    # needed to drain the backlog × the per-batch delay
+                    # budget (a pure function of queue state, so the chaos
+                    # lane replays identical hints)
+                    self.n_shed += 1
+                    batches = max(1, -(-pending // self.max_batch_rows))
+                    hint = batches * max(self.max_delay_s, 1e-4)
+                    if _tel.enabled:
+                        _tel.inc("serve.shed")
+                        _tel.record_event(
+                            "serve.shed", site=self.name, rows=rows,
+                            queue_rows=pending,
+                        )
+                    raise ServeOverloadError(
+                        f"MicroBatcher {self.name!r} queue is full "
+                        f"({pending}+{rows} > {self.max_queue_rows} rows); "
+                        f"retry after {hint:.4f}s",
+                        retry_after_s=hint,
+                        queue_rows=pending,
+                        max_queue_rows=self.max_queue_rows,
+                    )
             self._seq += 1
             rid = trace_id if trace_id is not None else f"{self.name}#{self._seq}"
             req = Request(
@@ -267,7 +300,7 @@ class MicroBatcher:
         """Spawn the background coalescing worker (idempotent)."""
         with self._cond:
             if self._closed:
-                raise RuntimeError(f"MicroBatcher {self.name!r} is closed")
+                raise ServeClosedError(f"MicroBatcher {self.name!r} is closed")
             if self._worker is not None:
                 return
             self._worker = threading.Thread(
@@ -299,9 +332,15 @@ class MicroBatcher:
                     self._cond.wait(timeout=remaining)
             self.flush()
 
-    def close(self) -> None:
-        """Stop the worker (after it drains the queue) and refuse new
-        submits.  Synchronous lanes: drains inline."""
+    def close(self, *, drain: bool = True) -> None:
+        """Stop the worker and refuse new submits (idempotent; further
+        submits raise :class:`ServeClosedError`).
+
+        ``drain=True`` (default) processes everything still queued, so
+        every accepted request gets its real reply.  ``drain=False``
+        abandons the queue instead: every still-pending future resolves
+        with :class:`ServeClosedError` — resolved, never left hanging —
+        the fast-shutdown half of the close contract."""
         with self._cond:
             if self._closed:
                 return
@@ -310,4 +349,16 @@ class MicroBatcher:
         if self._worker is not None:
             self._worker.join()
             self._worker = None
-        self.drain()
+        if drain:
+            self.drain()
+        else:
+            with self._cond:
+                abandoned, self._queue = list(self._queue), deque()
+            for req in abandoned:
+                if not req.future.done():
+                    req.future.set_exception(
+                        ServeClosedError(
+                            f"MicroBatcher {self.name!r} closed without "
+                            f"draining; request #{req.seq} abandoned"
+                        )
+                    )

@@ -69,6 +69,7 @@ from ..telemetry import _core as _tel
 from ..telemetry import flight as _flight
 from ..telemetry.httpz import MetricsServer
 from .batcher import MicroBatcher, Request, StagingPool, bucket_rows, pad_batch
+from .errors import ServeClosedError
 from .registry import ModelRegistry, RegistryError
 
 __all__ = ["Reply", "ServeEngine"]
@@ -145,6 +146,7 @@ class _Lane:
             max_batch_rows=engine.max_batch_rows,
             max_delay_s=engine.max_delay_s,
             name=f"serve:{tenant}/{model}/v{version}",
+            max_queue_rows=engine.max_queue_rows,
         )
 
     def check(self, payload: np.ndarray) -> None:
@@ -181,6 +183,12 @@ class ServeEngine:
     method : str — the estimator method lanes serve (default "predict").
     slo : SloMonitor | None — when given, every reply's latency feeds
         the monitor (burn-rate gauges + ``slo-burn`` incident on burn).
+    max_queue_rows : int | None — admission-control bound per lane queue;
+        a submit that would exceed it is shed with
+        :class:`~heat_tpu.serve.errors.ServeOverloadError` (carrying a
+        deterministic ``retry_after_s`` hint) instead of growing the
+        queue without bound.  ``None`` (default) keeps the unbounded
+        PR 10 behavior.
     """
 
     def __init__(
@@ -194,12 +202,14 @@ class ServeEngine:
         donate: bool = True,
         method: str = "predict",
         slo=None,
+        max_queue_rows: Optional[int] = None,
     ):
         if split not in (None, 0, "auto"):
             raise ValueError(f'split must be None, 0 or "auto", got {split!r}')
         self.registry = registry
         self.max_batch_rows = int(max_batch_rows)
         self.max_delay_s = float(max_delay_s)
+        self.max_queue_rows = None if max_queue_rows is None else int(max_queue_rows)
         self.min_bucket = int(min_bucket)
         self.split = split
         self.donate = bool(donate)
@@ -229,7 +239,7 @@ class ServeEngine:
         key = (tenant, model, resolved)
         with self._lock:
             if self._closed:
-                raise RuntimeError("ServeEngine is closed")
+                raise ServeClosedError("ServeEngine is closed")
             lane = self._lanes.get(key)
             if lane is None:
                 lane = _Lane(self, tenant, model, resolved, est)
@@ -312,6 +322,74 @@ class ServeEngine:
         lane.check(payload)
         x = self._commit(lane, np.ascontiguousarray(payload), None)
         return np.asarray(lane.predict(x).numpy())
+
+    # ------------------------------------------------------------------ #
+    # zero-cold-start: AOT executable export / install (design.md §22)
+    # ------------------------------------------------------------------ #
+    def _buckets(self) -> List[int]:
+        """The finite bucket set a lane serves: powers of two from
+        ``min_bucket`` up to the coalescing cap's bucket."""
+        out, b = [], self.min_bucket
+        top = bucket_rows(self.max_batch_rows, min_bucket=self.min_bucket)
+        while b <= top:
+            out.append(b)
+            b *= 2
+        return out
+
+    def export_warm(self, tenant: str, model: str, *,
+                    version: Optional[int] = None, dtype="float32") -> List[dict]:
+        """Capture and AOT-serialize this engine's predict programs for
+        ``(tenant, model)``: one zero-payload warmup per bucket per
+        serving layout (the batched split and the replicated direct
+        path), recorded via :func:`heat_tpu.core.aot.capture_programs`.
+        Returns the bundles — hand them to
+        :meth:`ModelRegistry.publish_executables` so replicas can
+        :meth:`warm` without paying the compile tax."""
+        from ..core import aot as _aot
+
+        lane = self._lane(tenant, model, version)
+        if lane.n_features is None:
+            raise ValueError(
+                f"{lane.site}: estimator exposes no feature count — cannot "
+                "synthesize warmup payloads for executable export"
+            )
+        dt = np.dtype(lane.dtype if lane.dtype is not None else dtype)
+        with _aot.capture_programs() as cap:
+            for bucket in self._buckets():
+                payload = np.zeros((bucket, lane.n_features), dtype=dt)
+                for split in dict.fromkeys(
+                    (self._pick_split(lane, bucket), None)
+                ):
+                    x = self._commit(lane, payload, split)
+                    lane.predict(x).numpy()
+        return _aot.export_programs(cap)
+
+    def warm(self, tenant: str, model: str, *,
+             version: Optional[int] = None, policy=None) -> int:
+        """Install a version's serialized executables from the registry
+        sidecar into the fuse cache; returns how many programs were
+        installed.  0 — no sidecar, a fingerprint/topology mismatch, or
+        a partial install — is the sound-fallback signal: serving still
+        works, the missing programs just compile fresh on first use (and
+        the shortfall lands in the incident log)."""
+        from ..core import aot as _aot
+
+        bundles, resolved = self.registry.load_executables(
+            tenant, model, version, policy=policy
+        )
+        if not bundles:
+            return 0
+        lane = self._lane(tenant, model, resolved)
+        installed = _aot.install_programs(bundles, comm=lane.comm)
+        if installed < len(bundles):
+            _incidents.record(
+                "aot-fallback", lane.site, "executable-install", "fell-back",
+                detail=f"installed {installed}/{len(bundles)} serialized "
+                "executables; the rest take the fresh-compile rung",
+            )
+        if _tel.enabled:
+            _tel.inc("serve.warm_installs", installed)
+        return installed
 
     def flush(self) -> int:
         """Drain every lane synchronously; returns requests processed."""
@@ -457,14 +535,20 @@ class ServeEngine:
         for lane in lanes:
             lane.batcher.start()
 
-    def close(self) -> None:
+    def close(self, *, drain: bool = True) -> None:
+        """Close the engine (idempotent).  New submits raise
+        :class:`~heat_tpu.serve.errors.ServeClosedError`; every request
+        already accepted either gets its real reply (``drain=True``,
+        default) or a future resolved with ``ServeClosedError``
+        (``drain=False``) — never a hang, even when a submit races the
+        close."""
         with self._lock:
             if self._closed:
                 return
             self._closed = True
             lanes = list(self._lanes.values())
         for lane in lanes:
-            lane.batcher.close()
+            lane.batcher.close(drain=drain)
         if self._metrics is not None:
             self._metrics.close()
             self._metrics = None
@@ -508,7 +592,10 @@ class ServeEngine:
         """Aggregate serving counters, plus the derived dispatch model:
         dispatches per micro-batch (the ==1.0 steady-state invariant) and
         mean batch occupancy (real rows / padded rows)."""
+        with self._lock:
+            lanes = list(self._lanes.values())
         return {
+            "shed": sum(ln.batcher.n_shed for ln in lanes),
             "requests": self.n_requests,
             "batches": self.n_batches,
             "rows": self.n_rows,

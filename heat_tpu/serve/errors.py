@@ -1,0 +1,33 @@
+"""Typed serving errors: the contract between the engine and its callers.
+
+Both subclass ``RuntimeError`` so pre-existing ``except RuntimeError``
+handlers (and tests) keep working; the point of the subtypes is that a
+fleet client can *distinguish* "this lane is gone, re-resolve" from
+"this lane is busy, back off and retry" without parsing messages.
+"""
+
+from __future__ import annotations
+
+__all__ = ["ServeClosedError", "ServeOverloadError"]
+
+
+class ServeClosedError(RuntimeError):
+    """The engine (or one of its lanes) has been closed: the submit was
+    refused, or an in-flight future was resolved with this error during
+    a non-draining shutdown.  Terminal for this engine — re-resolve a
+    replica instead of retrying here."""
+
+
+class ServeOverloadError(RuntimeError):
+    """Admission control shed this request: the lane's bounded queue is
+    full (``max_queue_rows``).  Transient — ``retry_after_s`` is a
+    deterministic backoff hint derived from the queue depth and the
+    lane's drain rate, sized so a client that honors it meets a freshly
+    drained queue."""
+
+    def __init__(self, message: str, *, retry_after_s: float,
+                 queue_rows: int, max_queue_rows: int):
+        super().__init__(message)
+        self.retry_after_s = float(retry_after_s)
+        self.queue_rows = int(queue_rows)
+        self.max_queue_rows = int(max_queue_rows)

@@ -23,11 +23,14 @@ from __future__ import annotations
 
 import collections
 import os
+import pickle
 import re
 import threading
 from typing import List, Optional, Tuple
 
 from ..core import checkpoint as _ckpt
+from ..resilience import faults as _faults
+from ..resilience import retry as _retry
 from ..telemetry import _core as _tel
 
 __all__ = [
@@ -192,6 +195,72 @@ class ModelRegistry:
         else:
             _ckpt.save_estimator(est, path)
         return version
+
+    # ------------------------------------------------------------------ #
+    # executable sidecars (zero-cold-start replicas, docs/design.md §22)
+    # ------------------------------------------------------------------ #
+    def _aotx_path(self, tenant: str, model: str, version: int) -> str:
+        """The executable-sidecar path next to a version's checkpoint.
+        ``.aotx`` deliberately does NOT match ``_VERSION_RE``, so sidecars
+        are invisible to :meth:`versions` / manifest scans — a version
+        with no sidecar is simply a cold replica, never an error."""
+        return os.path.join(
+            self.root, tenant, model, f"v{int(version)}.aotx"
+        )
+
+    def publish_executables(
+        self, tenant: str, model: str, version: int, bundles: List[dict]
+    ) -> str:
+        """Attach serialized AOT executables (bundles from
+        :func:`heat_tpu.core.aot.export_programs`) to an already-published
+        version.  Sidecars inherit version immutability: re-publishing one
+        is refused.  Returns the sidecar path."""
+        tenant = _check_name("tenant", tenant)
+        model = _check_name("model", model)
+        if int(version) not in self.versions(tenant, model):
+            raise VersionNotFoundError(
+                f"tenant={tenant!r} model={model!r} has no version "
+                f"{int(version)} to attach executables to"
+            )
+        path = self._aotx_path(tenant, model, int(version))
+        if os.path.exists(path):
+            raise RegistryError(
+                f"tenant={tenant!r} model={model!r} v{int(version)} already "
+                "has an executable sidecar (sidecars are immutable)"
+            )
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as fh:
+            pickle.dump(list(bundles), fh)
+        os.replace(tmp, path)  # atomic: readers never see a partial sidecar
+        if _tel.enabled:
+            _tel.inc("serve.registry.aotx_publishes")
+        return path
+
+    def load_executables(
+        self, tenant: str, model: str, version: Optional[int] = None,
+        *, policy: Optional[_retry.RetryPolicy] = None,
+    ) -> Tuple[List[dict], int]:
+        """``(bundles, version)`` for a version's executable sidecar —
+        ``([], version)`` when none was published (the cold rung of the
+        fallback ladder, not an error).  The read retries transient
+        ``OSError`` under ``policy`` (default :data:`~heat_tpu.resilience.
+        retry.IO_POLICY`) at site ``"registry_open"`` — the fleet's
+        chaos seam (:func:`heat_tpu.resilience.faults.io_open` with the
+        same site filter)."""
+        version, path = self.resolve(tenant, model, version)
+        apath = self._aotx_path(tenant, model, version)
+        if not os.path.exists(apath):
+            return [], version
+        bundles: List[dict] = []
+        for attempt in _retry.retry(policy, site="registry_open"):
+            with attempt:
+                if _faults.any_active():
+                    _faults.io_open(apath, site="registry_open")
+                with open(apath, "rb") as fh:
+                    bundles = pickle.load(fh)
+        if _tel.enabled:
+            _tel.inc("serve.registry.aotx_loads")
+        return bundles, version
 
     def load(self, tenant: str, model: str, version: Optional[int] = None):
         """``(estimator, version)`` for a request, LRU-cached so repeat

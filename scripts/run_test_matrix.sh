@@ -155,6 +155,76 @@ then
     echo "FAILED serve chaos scenario (reproduce with HEAT_CHAOS_SEED=${HEAT_CHAOS_SEED:-0})"
     fail=1
 fi
+# autoscale lane (docs/design.md §22): fleet elasticity under chaos —
+# the fleet suite (watermark hysteresis, warm zero-compile scale-ups,
+# canary bitwise parity, close contract), then the scale-event scenario
+# replayed twice: a canaried fleet served while devices arrive and die
+# on seeded schedules must produce an identical (tick ledger,
+# scale-event log, canary assignment) triple both times — the whole
+# elastic history is a pure function of HEAT_CHAOS_SEED
+echo "=== autoscale lane (seed=${HEAT_CHAOS_SEED:-0}: watermarks, warm replicas, canary, chaos replay) ==="
+if ! HEAT_CHAOS_SEED="${HEAT_CHAOS_SEED:-0}" python -m pytest tests/test_fleet.py -q; then
+    echo "FAILED autoscale lane (reproduce with HEAT_CHAOS_SEED=${HEAT_CHAOS_SEED:-0})"
+    fail=1
+fi
+if ! HEAT_CHAOS_SEED="${HEAT_CHAOS_SEED:-0}" python - <<'PY'
+import tempfile
+import numpy as np
+import heat_tpu as ht
+from heat_tpu.resilience import faults
+from heat_tpu.serve import (CanaryConfig, FleetEngine, ModelRegistry,
+                            WatermarkAutoscaler, loadgen)
+
+rng = np.random.default_rng(0)
+X = ht.array(rng.normal(size=(64, 5)).astype(np.float32), split=0)
+km = ht.cluster.KMeans(n_clusters=3, max_iter=5, random_state=0)
+km.fit(X)
+km2 = ht.cluster.KMeans(n_clusters=3, max_iter=7, random_state=1)
+km2.fit(X)
+reg = ModelRegistry(tempfile.mkdtemp(prefix="heat-autoscale-lane-"))
+reg.publish("ci", "km", km)
+reg.publish("ci", "km", km2)
+seed = loadgen.chaos_seed()
+
+def scenario():
+    # seed=None on the canary -> HEAT_CHAOS_SEED drives the slice, and
+    # the armed fault plans replay arrivals/losses on the same seed
+    can = CanaryConfig(tenant="ci", model="km", stable_version=1,
+                       canary_version=2, fraction=0.3)
+    auto = WatermarkAutoscaler(low=1, high=8, hysteresis=2,
+                               min_replicas=1, max_replicas=3)
+    fleet = FleetEngine(reg, canary=can, autoscaler=auto,
+                        max_batch_rows=32, min_bucket=8)
+    ledger = []
+    with faults.inject("device_arrival", site="fleet.tick", nth=2, rank=1,
+                       seed=seed):
+        with faults.inject("device_loss", site="fleet.tick", nth=4, rank=0,
+                           seed=seed):
+            for step in range(6):
+                for s in range(3):
+                    p = np.random.default_rng([seed, step * 3 + s]).normal(
+                        size=(4, 5)).astype(np.float32)
+                    fleet.predict("ci", "km", p)
+                rec = fleet.tick(queue_depth=10 if step < 3 else 0)
+                ledger.append((rec["decision"], rec["replicas"]))
+    events = [(e["action"], e["cause"], e["replicas"])
+              for e in fleet.scale_events]
+    out = (tuple(ledger), tuple(events), tuple(fleet.assignments))
+    fleet.close()
+    return out
+
+a, b = scenario(), scenario()
+assert a == b, "scale-event scenario diverged across identical-seed replays"
+actions = [e[0] for e in a[1]]
+assert "scale-up" in actions and "replica-loss" in actions, actions
+print(f"autoscale chaos scenario (seed={seed}): {len(a[0])} ticks, "
+      f"events={actions}, canary slice {sum(a[2])}/{len(a[2])} — "
+      f"ledger+events+assignments replayed bit-for-bit")
+PY
+then
+    echo "FAILED autoscale chaos scenario (reproduce with HEAT_CHAOS_SEED=${HEAT_CHAOS_SEED:-0})"
+    fail=1
+fi
 # obs lane (docs/design.md §19): the request-scoped observability suite,
 # then a /metrics scrape of a LIVE ServeEngine (Prometheus text parsed
 # and byte-compared against telemetry.snapshot()), then the bench_diff

@@ -169,6 +169,8 @@ def _fake_full_result():
         "lasso_sweeps_per_sec": 1318.6,
         "serve_predictions_per_sec": 9919.9,
         "serve_p99_ms": 27.32,
+        "replica_cold_start_ms": 24.6,
+        "scale_event_p99_ms": 36.6,
         "qr_svd_tall_skinny_ms": 2.87,
         "attention_tokens_per_sec": 3400000.0,
         "causal_attention_tokens_per_sec": 3700000.0,

@@ -401,6 +401,143 @@ def test_recovery_snapshot_probe_retries_transient_io_error(tmp_path):
 
 
 # --------------------------------------------------------------------- #
+# elastic grow: the scale-up mirror of the recovery matrix                #
+# --------------------------------------------------------------------- #
+GROW_PAIRS = [(4, 8), (2, 4), (1, 2)]
+
+
+@pytest.mark.parametrize("old_k,new_k", GROW_PAIRS)
+def test_kmeans_elastic_grow_is_bitwise_identical(tmp_path, old_k, new_k):
+    """The grow contract, mirroring the shrink matrix: a fit interrupted
+    on the small mesh resumes on the grown mesh bitwise-identical to an
+    uninterrupted large-mesh run resumed from the same snapshot."""
+    small, big = _sub_comm(old_k), _sub_comm(new_k)
+    p = str(tmp_path / "km.h5")
+    p_twin = str(tmp_path / "km_twin.h5")
+    kw = dict(n_clusters=2, max_iter=20, tol=0.0, random_state=5)
+    est = ht.cluster.KMeans(**kw, checkpoint_every=2, checkpoint_path=p)
+    with pytest.raises(DeviceLossError):
+        with faults.inject("device_loss", site="iteration", nth=1):
+            est.fit(_kmeans_data(small))
+    shutil.copyfile(p, p_twin)
+    xb = _kmeans_data(big)
+    out = elastic.grow(est, p, xb, comm=big)
+    twin = ht.cluster.KMeans(**kw, checkpoint_every=2, checkpoint_path=p_twin)
+    twin.fit(xb, resume="elastic")
+    assert _bits(out.cluster_centers_.larray) == _bits(twin.cluster_centers_.larray)
+    assert _bits(out.labels_.larray) == _bits(twin.labels_.larray)
+    assert out.n_iter_ == twin.n_iter_
+    acts = [i.action for i in ht.resilience.incident_log()]
+    assert "growing" in acts and "grown" in acts
+
+
+@pytest.mark.parametrize("old_k,new_k", GROW_PAIRS)
+@pytest.mark.parametrize("policy", [None, "int8_block"])
+def test_lasso_gd_elastic_grow_is_bitwise_identical(
+    tmp_path, old_k, new_k, policy
+):
+    if policy and old_k == 1:
+        # a 1-rank fit has no collectives, so its snapshots are written by
+        # the exact path; growing them onto the quantized path is a policy
+        # change (fresh EF residual), not an elastic resume
+        pytest.skip("1-rank snapshots are exact-path; q-grow is out of scope")
+    small, big = _sub_comm(old_k), _sub_comm(new_k)
+    p = str(tmp_path / "lasso.h5")
+    p_twin = str(tmp_path / "lasso_twin.h5")
+    kw = dict(lam=0.01, max_iter=30, tol=0.0, solver="gd")
+    ctx = ht.comm.collective_precision(policy) if policy else None
+    if ctx:
+        ctx.__enter__()
+    try:
+        xs, ys = _lasso_data(small)
+        est = ht.regression.Lasso(**kw, checkpoint_every=7, checkpoint_path=p)
+        with pytest.raises(DeviceLossError):
+            with faults.inject("device_loss", site="iteration", nth=2):
+                est.fit(xs, ys)
+        shutil.copyfile(p, p_twin)
+        xb, yb = _lasso_data(big)
+        out = elastic.grow(est, p, xb, yb, comm=big)
+        twin = ht.regression.Lasso(**kw, checkpoint_every=7, checkpoint_path=p_twin)
+        twin.fit(xb, yb, resume="elastic")
+        assert _bits(out.theta.larray) == _bits(twin.theta.larray)
+        assert out.n_iter == twin.n_iter == 30
+    finally:
+        if ctx:
+            ctx.__exit__(None, None, None)
+
+
+def test_grow_lands_on_counters_and_incident_order(tmp_path):
+    small, big = _sub_comm(4), _sub_comm(8)
+    p = str(tmp_path / "km.h5")
+    kw = dict(n_clusters=2, max_iter=20, tol=0.0, random_state=5)
+    telemetry.enable()
+    est = ht.cluster.KMeans(**kw, checkpoint_every=2, checkpoint_path=p)
+    with pytest.raises(DeviceLossError):
+        with faults.inject("device_loss", site="iteration", nth=1):
+            est.fit(_kmeans_data(small))
+    elastic.grow(est, p, _kmeans_data(big), comm=big)
+    counters = telemetry.snapshot()["counters"]
+    assert counters["resilience.elastic.grows"] == 1
+    assert "resilience.elastic.recoveries" not in counters
+    acts = [i.action for i in ht.resilience.incident_log()]
+    assert acts.index("growing") < acts.index("grown")
+    kinds = {i.kind for i in ht.resilience.incident_log() if i.action == "growing"}
+    assert kinds == {"device-arrival"}
+
+
+def test_shrink_then_grow_round_trip_is_bitwise_identical(tmp_path):
+    """The full elastic round trip: lose devices mid-fit, recover on the
+    shrunk mesh, lose the recovery too, then grow back to the full mesh —
+    still bitwise-identical to a clean full-mesh resume from the final
+    snapshot (direction symmetry of the carry migration)."""
+    c8, c4 = _sub_comm(8), _sub_comm(4)
+    p = str(tmp_path / "lasso.h5")
+    p_twin = str(tmp_path / "lasso_twin.h5")
+    kw = dict(lam=0.01, max_iter=30, tol=0.0, solver="gd")
+    est = ht.regression.Lasso(**kw, checkpoint_every=7, checkpoint_path=p)
+    x8, y8 = _lasso_data(c8)
+    with pytest.raises(DeviceLossError):
+        with faults.inject("device_loss", site="iteration", nth=2):
+            est.fit(x8, y8)
+    # shrink leg, itself interrupted after a durable tick on the 4-mesh
+    x4, y4 = _lasso_data(c4)
+    with pytest.raises(DeviceLossError):
+        with faults.inject("device_loss", site="iteration", nth=1):
+            elastic.recover(est, p, x4, y4, comm=c4)
+    shutil.copyfile(p, p_twin)
+    # grow leg: the devices came back; finish on the full mesh
+    x8b, y8b = _lasso_data(c8)
+    out = elastic.grow(est, p, x8b, y8b, comm=c8)
+    twin = ht.regression.Lasso(**kw, checkpoint_every=7, checkpoint_path=p_twin)
+    twin.fit(x8b, y8b, resume="elastic")
+    assert _bits(out.theta.larray) == _bits(twin.theta.larray)
+    assert out.n_iter == twin.n_iter == 30
+
+
+def test_device_arrival_seam_is_site_filtered():
+    from heat_tpu.resilience.faults import DeviceArrival
+
+    with faults.inject("device_arrival", site="fleet.tick", nth=1, rank=2):
+        # a different site (or no site) never matches the filtered plan
+        faults.arrival_point("iteration", mesh=4)
+        faults.arrival_point(None, mesh=4)
+        with pytest.raises(DeviceArrival) as ei:
+            faults.arrival_point("fleet.tick", mesh=4)
+    assert ei.value.arrived == 2
+    assert ei.value.mesh_size == 4 and ei.value.new_mesh_size == 6
+    assert "grow" in str(ei.value)
+
+
+def test_registry_open_io_plan_never_leaks_into_unsited_seams():
+    with faults.inject("io_error", site="registry_open", nth=1):
+        # the HDF5/checkpoint open seams announce no site: must not fire
+        faults.io_open("/spool/ckpt.h5")
+        faults.io_open("/spool/ckpt.h5", site="manifest_open")
+        with pytest.raises(OSError, match="injected transient"):
+            faults.io_open("/spool/models/v1.aotx", site="registry_open")
+
+
+# --------------------------------------------------------------------- #
 # retry engine: seeded schedules, bounded attempts, deadlines             #
 # --------------------------------------------------------------------- #
 def test_backoff_schedule_is_pure_function_of_policy(monkeypatch):
@@ -488,6 +625,77 @@ def test_retry_deadline_cuts_off_remaining_attempts():
     assert calls[0] == 1
     gave_up = [i for i in ht.resilience.incident_log() if i.action == "gave-up"]
     assert len(gave_up) == 1 and "deadline" in gave_up[0].detail
+
+
+def test_backoff_schedule_truncates_at_deadline():
+    """A deadline cuts the schedule to the prefix whose cumulative sleep
+    fits: sleeps the engine could never take are not in the plan."""
+    full = backoff_schedule(RetryPolicy(attempts=8, seed=3))
+    assert len(full) == 7
+    cut = sum(full[:2]) + 1e-6
+    trunc = backoff_schedule(RetryPolicy(attempts=8, seed=3, deadline=cut))
+    assert trunc == full[: len(trunc)]  # a prefix: same seeded stream
+    assert len(trunc) == 3  # d1+d2 < deadline admits one more delay
+    assert sum(trunc[:-1]) < cut
+    # a tiny deadline still schedules the first (pre-deadline) retry
+    tiny = backoff_schedule(RetryPolicy(attempts=8, seed=3, deadline=1e-9))
+    assert tiny == full[:1]
+
+
+def test_retry_gives_up_when_schedule_is_truncated():
+    # schedule truncated to 1 delay by the deadline, clock frozen at t=0
+    # (so the deadline itself never trips): the engine must still give up
+    # when it runs out of scheduled sleeps instead of indexing past the
+    # truncated schedule
+    telemetry.set_clock(lambda: 0.0)
+    retry_mod.set_sleep(lambda s: None)
+    policy = RetryPolicy(attempts=8, seed=3, deadline=1e-9)
+    assert len(backoff_schedule(policy)) == 1
+    calls = [0]
+
+    def flaky():
+        calls[0] += 1
+        raise OSError("down")
+
+    with pytest.raises(OSError):
+        retry_mod.call(flaky, policy=policy, site="unit")
+    assert calls[0] == 2  # one scheduled retry, then out of schedule
+    gave_up = [i for i in ht.resilience.incident_log() if i.action == "gave-up"]
+    assert len(gave_up) == 1 and "schedule truncated" in gave_up[0].detail
+
+
+def test_registry_open_retries_spread_the_herd():
+    """Two replicas retrying the same flapping sidecar must not retry in
+    lockstep: distinct policy seeds give distinct jitter streams at the
+    ``registry_open`` site."""
+    schedules = []
+    for seed in (1, 2):
+        slept = []
+        retry_mod.set_sleep(slept.append)
+        calls = [0]
+
+        def flaky():
+            calls[0] += 1
+            if calls[0] < 4:
+                raise OSError("sidecar failing over")
+            return "ok"
+
+        assert (
+            retry_mod.call(
+                flaky,
+                policy=RetryPolicy(attempts=6, seed=seed),
+                site="registry_open",
+            )
+            == "ok"
+        )
+        # the sleeps taken are exactly the schedule's prefix
+        assert tuple(slept) == backoff_schedule(
+            RetryPolicy(attempts=6, seed=seed)
+        )[:3]
+        schedules.append(tuple(slept))
+    assert schedules[0] != schedules[1]
+    sites = {i.site for i in ht.resilience.incident_log() if i.action == "retried"}
+    assert sites == {"registry_open"}
 
 
 def test_retry_policy_validation():

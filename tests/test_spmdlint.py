@@ -1101,6 +1101,109 @@ def f(x):
 
 
 # --------------------------------------------------------------------- #
+# SPMD211: retry loop without a deadline                                 #
+# --------------------------------------------------------------------- #
+def test_spmd211_triggers_on_forever_retry_of_compiled_call():
+    src = """
+import jax
+
+@jax.jit
+def step(x):
+    return x * 2
+
+def run(x):
+    while True:
+        try:
+            return step(x)
+        except Exception:
+            pass
+"""
+    findings = lint(src, "SPMD211")
+    assert findings and "no deadline" in findings[0].message
+
+
+def test_spmd211_triggers_on_forever_retry_of_guarded_io():
+    src = """
+def read(path):
+    while True:
+        try:
+            with open(path, "rb") as fh:
+                return fh.read()
+        except OSError:
+            continue
+"""
+    findings = lint(src, "SPMD211")
+    assert findings and "guarded site 'open'" in findings[0].message
+
+
+def test_spmd211_clean_on_retry_engine_and_bounded_loops():
+    # the blessed pattern: the retry engine's for-loop; plus hand-rolled
+    # loops that visibly count attempts or watch a deadline
+    src = """
+import time
+from heat_tpu.resilience import retry as _retry
+
+def read(path, policy):
+    for attempt in _retry.retry(policy, site="registry_open"):
+        with attempt:
+            with open(path, "rb") as fh:
+                return fh.read()
+
+def read_counted(path):
+    for attempt in range(5):
+        try:
+            with open(path, "rb") as fh:
+                return fh.read()
+        except OSError:
+            continue
+
+def read_deadline(path, deadline):
+    while True:
+        try:
+            with open(path, "rb") as fh:
+                return fh.read()
+        except OSError:
+            if time.monotonic() > deadline:
+                raise
+
+def poll(path):
+    # no compiled/guarded call inside the try: not this rule's business
+    while True:
+        try:
+            return path.stat()
+        except FileNotFoundError:
+            pass
+"""
+    assert lint(src, "SPMD211") == []
+
+
+def test_spmd211_handler_that_escapes_is_clean():
+    src = """
+def read(path):
+    while True:
+        try:
+            with open(path, "rb") as fh:
+                return fh.read()
+        except OSError:
+            break
+"""
+    assert lint(src, "SPMD211") == []
+
+
+def test_spmd211_suppression_comment_silences():
+    src = """
+def read(path):
+    while True:
+        try:
+            with open(path, "rb") as fh:
+                return fh.read()
+        except OSError:  # spmdlint: disable=SPMD211
+            pass
+"""
+    assert lint(src, "SPMD211") == []
+
+
+# --------------------------------------------------------------------- #
 # SPMD301/302: Pallas tiling and grids                                   #
 # --------------------------------------------------------------------- #
 def test_spmd301_triggers_on_off_tile_blocks():
@@ -1263,7 +1366,7 @@ def test_every_rule_is_registered():
     assert [r.id for r in all_rules()] == [
         "SPMD001", "SPMD101", "SPMD102", "SPMD201", "SPMD202", "SPMD203",
         "SPMD204", "SPMD205", "SPMD206", "SPMD207", "SPMD208", "SPMD209",
-        "SPMD210", "SPMD301", "SPMD302",
+        "SPMD210", "SPMD211", "SPMD301", "SPMD302",
         "SPMD401", "SPMD501", "SPMD502", "SPMD503", "SPMD504", "SPMD505",
     ]
 
