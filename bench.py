@@ -96,6 +96,8 @@ _HEADLINE = {
     "allreduce_q_gbps": True,
     "resplit_gbps": True,
     "summa2d_tflops": True,
+    "qr2d_tflops": True,
+    "svd2d_tflops": True,
     "ring_overlap_efficiency": True,
     "kmedians_iter_per_sec": True,
     "kmedians_churn_iter_per_sec": True,
@@ -164,6 +166,13 @@ _GOLDEN_MAP = {
     # summa2d_vs_replicated) — the matmul golden is the secondary
     # machine-health control the _GOLDEN_MAP framework can express
     "summa2d_tflops": ("matmul_tflops", "div"),
+    # the grid factorizations are MXU-bound between collectives; the
+    # PRIMARY control for each is its in-run bitwise replicated golden
+    # (_grid_qr_reference / _qdwh_svd_reference, compared before timing)
+    # plus the 1-D TSQR twin (qr1d_tflops) — the matmul golden is the
+    # secondary machine-health control the _GOLDEN_MAP can express
+    "qr2d_tflops": ("matmul_tflops", "div"),
+    "svd2d_tflops": ("matmul_tflops", "div"),
     "kmedians_iter_per_sec": ("reduce_gb_per_sec", "div"),
     "kmedians_churn_iter_per_sec": ("reduce_gb_per_sec", "div"),
     "kmedoids_iter_per_sec": ("reduce_gb_per_sec", "div"),
@@ -343,6 +352,19 @@ _NOT_MODELED = {
         "critical_path_ms rather than a single-resource roofline: the "
         "binding resource mixes MXU block products with ICI panel "
         "broadcasts, and the split depends on the mesh shape",
+    "qr2d_tflops":
+        "already denominated in achieved TFLOP/s (Householder nominal "
+        "2mn² - 2n³/3 over the fenced region) — read it against the 1-D "
+        "TSQR twin (qr1d_tflops) and the grid wire model's "
+        "critical_path_ms rather than a single-resource roofline: the "
+        "schedule interleaves MXU panel products with ICI broadcasts and "
+        "TSQR gathers, and the split depends on the mesh shape",
+    "svd2d_tflops":
+        "already denominated in achieved TFLOP/s (a worst-case "
+        "_QDWH_MAXIT-iteration nominal — the on-device while_loop may "
+        "converge earlier, so the figure understates achieved silicon "
+        "throughput by the convergence margin); read it against "
+        "qr2d_tflops and the svd2d wire model's critical_path_ms",
     "ring_overlap_efficiency":
         "dimensionless by design: the metric IS a roofline fraction — "
         "achieved overlap(\"on\") time vs max(compute_ms, wire_ms) per ring "
@@ -515,6 +537,24 @@ _FLAG_DISPOSITIONS = {
         "regression — the win condition is ICI-attached meshes where "
         "per-device memory (O(mn/rc) vs the replicated O(mn)) and the "
         "critical_path_ms wire model bind",
+    "qr2d_tflops":
+        "new in r16 (pod-scale grid linalg tentpole): blocked/CAQR QR "
+        "with both operands splits (0, 1) on the r×c mesh; no "
+        "prior-round history.  PRIMARY control is the in-run bitwise "
+        "replicated golden (asserted before timing) plus the 1-D TSQR "
+        "twin on the identical operand (qr1d_tflops, ratio qr2d_vs_1d); "
+        "on a single-host mesh the panel broadcasts and TSQR gathers "
+        "pay their cost with no slow link to win back, so qr2d_vs_1d "
+        "below the grid's compute advantage is structural there, not a "
+        "regression",
+    "svd2d_tflops":
+        "new in r16 (pod-scale grid linalg tentpole): QDWH polar SVD on "
+        "the grid, one while_loop dispatch; no prior-round history.  "
+        "PRIMARY control is the in-run bitwise replicated golden "
+        "(asserted before timing); the TFLOP/s nominal prices the "
+        "static _QDWH_MAXIT trip cap, so early convergence shows up as "
+        "apparent extra throughput — compare across rounds at matched "
+        "shapes only",
     "ring_overlap_efficiency":
         "new in r11 (latency-hiding tentpole): fraction of the "
         "max(compute, wire) roofline the double-buffered rings achieve "
@@ -1285,6 +1325,197 @@ def summa2d_rates(X):
         (s1d_tf, s1d_spread),
         (mono_tf, mono_spread),
         wire_model,
+    )
+
+
+def gridlinalg_rates(X):
+    """Grid dense-factorization headlines (the r16 tentpole, pod-scale
+    grid linalg): achieved TFLOP/s of the blocked/CAQR QR
+    (``qr2d_tflops``) and the QDWH polar-decomposition SVD
+    (``svd2d_tflops``) on the r×c grid factorization of the mesh,
+    operand splits ``(0, 1)``, each ONE compiled dispatch.
+
+    Controls, per the module methodology: each kernel's PRIMARY control
+    is its in-run replicated golden — ``_grid_qr_reference`` /
+    ``_qdwh_svd_reference`` replay the identical panel-ordered schedule
+    on one device and the outputs are compared BITWISE before any timing
+    (the twin discipline of docs/design.md §23; the goldens replay the
+    serial arm, to which the kernels' overlap arm is pinned in
+    tests/test_linalg2d.py, so one canonical golden covers both arms
+    transitively).  The 1-D TSQR twin (``qr1d_tflops``, the tall-skinny
+    kernel on the identical operand at split 0) isolates grid-schedule
+    changes from tall-skinny-schedule changes; both QR arms must
+    reconstruct A (allclose — TSQR and CAQR differ in column-sign
+    convention, so reconstruction is the shared invariant).  QR rates
+    are denominated in the Householder nominal ``2mn² - 2n³/3``; the SVD
+    in ``_QDWH_MAXIT`` stacked-QR iterations plus the epilogue
+    corrections — a worst-case nominal, same convention as the wire
+    model (the on-device while_loop may converge earlier).  Wire/memory
+    figures come from the ONE shared source
+    (``comm/_costs.grid_qr_model`` / ``qdwh_svd_model`` — the same
+    arithmetic the telemetry ledger is credited with, byte-for-byte by
+    delegation, asserted in tests) and land as ``qr2d_wire_model`` /
+    ``svd2d_wire_model`` including the ``critical_path_ms``
+    serial/overlap pairs."""
+    import jax
+    import jax.numpy as jnp
+
+    import heat_tpu as ht
+    from heat_tpu.comm import _costs
+    from heat_tpu.comm.overlap import overlap
+    from heat_tpu.core.communication import grid_comm
+    # the linalg package re-exports qr()/svd() as functions that shadow the
+    # submodules of the same name, so any `import ... qr` form grabs the
+    # callable — load the submodules through sys.modules instead
+    import importlib
+
+    _lq = importlib.import_module("heat_tpu.core.linalg.qr")
+    _lsvd = importlib.import_module("heat_tpu.core.linalg.svd")
+
+    comm = X.comm
+    p = comm.size
+    # r×c grid: largest divisor of p at most sqrt(p) (2x4 on 8 devices)
+    r = max(d for d in range(1, int(p**0.5) + 1) if p % d == 0)
+    c = p // r
+    gc = grid_comm((r, c))
+
+    # divisible by (r, c) AND tall enough for the 1-D TSQR twin's
+    # shards (m/p >= n); svd sizes stay modest — the replicated QDWH
+    # golden simulates every mesh position's blocks in one program
+    qm, qn = (8 * p, 2 * c) if _SMOKE else (4096, 512)
+    sm, sn = (8 * p, 2 * c) if _SMOKE else (1024, 256)
+    maxit = _lsvd._QDWH_MAXIT
+    qr_flops = int(2 * qm * qn * qn - 2 * qn**3 // 3)
+    stacked_qr = 2 * (sm + sn) * sn * sn - 2 * sn**3 // 3
+    svd_flops = int(
+        maxit * (stacked_qr + 2 * (sm + sn) * sn * sn)
+        + 4 * sm * sn * sn + 9 * sn**3
+    )
+
+    rng = np.random.default_rng(29)
+    qa_np = rng.normal(size=(qm, qn)).astype(np.float32)
+    sa_np = rng.normal(size=(sm, sn)).astype(np.float32)
+
+    if p > 1:
+        # in-run bitwise goldens on the public entry points (serial arm)
+        with overlap("off"):
+            a_nd = ht.array(qa_np, splits=(0, 1), comm=gc)
+            gq, gr = _lq._grid_qr_reference(jnp.asarray(qa_np), (r, c))
+            res = ht.linalg.qr(a_nd)
+            np.testing.assert_array_equal(
+                np.asarray(gq)[:qm, :qn], np.asarray(res.Q.larray)
+            )
+            np.testing.assert_array_equal(
+                np.asarray(gr)[:, :qn], np.asarray(res.R.larray)
+            )
+            s_nd = ht.array(sa_np, splits=(0, 1), comm=gc)
+            ut, st, vt = _lsvd._qdwh_svd_reference(jnp.asarray(sa_np), (r, c))
+            sres = ht.linalg.svd(s_nd)
+            np.testing.assert_array_equal(
+                np.asarray(ut)[:sm, :sn], np.asarray(sres.U.larray)
+            )
+            np.testing.assert_array_equal(
+                np.asarray(st), np.asarray(sres.S.larray)
+            )
+            np.testing.assert_array_equal(
+                np.asarray(vt), np.asarray(sres.V.larray)
+            )
+
+    # raw cached programs (the same ones the dispatch gates launch)
+    nloc, bounds, vcs = _lq._grid_panel_schedule(qn, c, 1)
+    fn_qr = _lq._grid_qr_fn(
+        gc, bounds, vcs, False, nloc, qn, (qm, qn), "float32"
+    )
+    aq = gc.apply_sharding(jnp.asarray(qa_np), (0, 1))
+    fn_t = _lq.jitted(("qr.tsqr", comm), lambda: _lq._tsqr_program(comm))
+    a1 = comm.apply_sharding(jnp.asarray(qa_np), 0)
+    fn_svd = _lsvd._grid_svd_fn(gc, (sm, sn), sn, "float32", False)
+    asv = gc.apply_sharding(jnp.asarray(sa_np), (0, 1))
+
+    # one-shot sanity: both QR arms reconstruct A; QDWH matches LAPACK's
+    # singular values (the calibrated ulp gates live in
+    # tests/test_linalg2d.py — this is the in-run smoke check)
+    q2, r2 = fn_qr(aq)
+    np.testing.assert_allclose(
+        np.asarray(q2) @ np.asarray(r2), qa_np, rtol=1e-3, atol=1e-2
+    )
+    q1, r1 = fn_t(a1)
+    np.testing.assert_allclose(
+        np.asarray(q1)[:qm] @ np.asarray(r1), qa_np, rtol=1e-3, atol=1e-2
+    )
+    _, sv, _ = fn_svd(asv)
+    np.testing.assert_allclose(
+        np.asarray(sv), np.linalg.svd(sa_np, compute_uv=False),
+        rtol=1e-3, atol=1e-3,
+    )
+
+    def make_loop(body):
+        @jax.jit
+        def loop(a_, reps):
+            def step(i, carry):
+                y = a_ + carry  # runtime carry: no hoisting/DCE across reps
+                tot = jnp.float32(0.0)
+                for t in body(y):
+                    tot = tot + jnp.sum(t).astype(jnp.float32)
+                return tot * 1e-30
+
+            return jax.lax.fori_loop(0, reps, step, jnp.float32(0.0))
+
+        return loop
+
+    def rate(loop, aa, flops, lo, hi):
+        def sample(reps):
+            t0 = time.perf_counter()
+            float(loop(aa, reps))  # the float() readback fences the region
+            return time.perf_counter() - t0
+
+        slopes, fallback = _pair_samples(sample, *_win(lo, hi, 5))
+        if not slopes:
+            slopes = [fallback]
+        return _summary([flops / d / 1e12 for d in slopes])
+
+    qr2d_tf, qr2d_spread = rate(make_loop(fn_qr), aq, qr_flops, 3, 33)
+    qr1d_tf, qr1d_spread = rate(make_loop(fn_t), a1, qr_flops, 3, 33)
+    svd2d_tf, svd2d_spread = rate(make_loop(fn_svd), asv, svd_flops, 2, 12)
+
+    qmodel = _costs.grid_qr_model(qm, qn, (r, c))
+    qr_wire_model = {
+        "mesh_shape": [r, c],
+        "dims_mn": [qm, qn],
+        "flops_per_rep": qr_flops,
+        "panels": qmodel["panels"],
+        "ring_hops_per_device": qmodel["hops"],
+        "wire_bytes_per_rep": qmodel["wire_bytes"],
+        "peak_live_bytes": qmodel["peak_live_bytes"],
+        "critical_path_ms": qmodel["critical_path_ms"],
+    }
+    smodel = _costs.qdwh_svd_model(sm, sn, (r, c), iterations=maxit)
+    svd_wire_model = {
+        "mesh_shape": [r, c],
+        "dims_mn": [sm, sn],
+        "flops_per_rep": svd_flops,
+        "iterations": smodel["iterations"],
+        "per_iteration_wire_bytes": smodel["per_iteration_wire_bytes"],
+        "ring_hops_per_device": smodel["hops"],
+        "wire_bytes_per_rep": smodel["wire_bytes"],
+        "peak_live_bytes": smodel["peak_live_bytes"],
+        "critical_path_ms": smodel["critical_path_ms"],
+    }
+    if jax.default_backend() != "tpu":
+        for wm in (qr_wire_model, svd_wire_model):
+            wm["disposition"] = (
+                "off-TPU smoke: the wire figures price ICI rings that do "
+                "not exist on a host-device mesh — schema documentation "
+                "only; the panel broadcasts and TSQR gathers pay their "
+                "cost with no slow link to win back, so read the TFLOP/s "
+                "against the in-run twins, not a roofline"
+            )
+    return (
+        (qr2d_tf, qr2d_spread),
+        (qr1d_tf, qr1d_spread),
+        qr_wire_model,
+        (svd2d_tf, svd2d_spread),
+        svd_wire_model,
     )
 
 
@@ -2087,6 +2318,8 @@ _METRIC_GROUP = {
     "allreduce_q_gbps": "aux",
     "resplit_gbps": "aux",
     "summa2d_tflops": "aux",
+    "qr2d_tflops": "aux",
+    "svd2d_tflops": "aux",
     "ring_overlap_efficiency": "aux",
     "kmedians_iter_per_sec": "medians",
     "kmedians_churn_iter_per_sec": "medians",
@@ -2178,6 +2411,13 @@ def main():
         (smono_tf, smono_spread),
         summa2d_wire_model,
     ) = summa2d_rates(X)
+    (
+        (qr2d_tf, qr2d_spread),
+        (qr1d_tf, qr1d_spread),
+        qr2d_wire_model,
+        (svd2d_tf, svd2d_spread),
+        svd2d_wire_model,
+    ) = gridlinalg_rates(X)
     (
         ring_eff,
         overlap_vs_serial,
@@ -2272,6 +2512,21 @@ def main():
                     round(s2d_tf / s1d_tf, 3) if s1d_tf else None
                 ),
                 "summa2d_wire_model": summa2d_wire_model,
+                # r16 tentpole: pod-scale grid linalg — blocked/CAQR QR
+                # and QDWH polar SVD on the r×c mesh, one dispatch each,
+                # in-run bitwise replicated goldens asserted before
+                # timing.  The 1-D TSQR twin on the identical operand
+                # isolates grid-schedule changes (see gridlinalg_rates).
+                # 6 decimals, not 3: the CPU-smoke panels are tiny enough
+                # (64x8) that micro-TFLOP rates are the honest signal
+                "qr2d_tflops": round(qr2d_tf, 6),
+                "qr1d_tflops": round(qr1d_tf, 6),
+                "qr2d_vs_1d": (
+                    round(qr2d_tf / qr1d_tf, 3) if qr1d_tf else None
+                ),
+                "qr2d_wire_model": qr2d_wire_model,
+                "svd2d_tflops": round(svd2d_tf, 6),
+                "svd2d_wire_model": svd2d_wire_model,
                 # PR-11 tentpole: double-buffered rings under
                 # ht.comm.set_overlap — achieved overlap("on") time vs the
                 # max(compute, wire) latency-hiding roofline, minimum
@@ -2363,6 +2618,9 @@ def main():
                     "summa2d_tflops": s2d_spread,
                     "summa1d_tflops": s1d_spread,
                     "matmul_replicated_tflops": smono_spread,
+                    "qr2d_tflops": qr2d_spread,
+                    "qr1d_tflops": qr1d_spread,
+                    "svd2d_tflops": svd2d_spread,
                     "kmedians_iter_per_sec": med_spread,
                     "kmedians_churn_iter_per_sec": churn_spread,
                     "kmedoids_iter_per_sec": medoid_spread,

@@ -671,6 +671,10 @@ class _Interp:
             params["split"] = kw_lits.get("split", MISSING)
         elif kind == "entry_svd":
             params["compute_uv"] = kw_lits.get("compute_uv", MISSING)
+        elif kind == "entry_qr":
+            # calc_q is the third positional after tiles_per_proc
+            params["calc_q"] = kw_lits.get(
+                "calc_q", lit_extras[1] if len(lit_extras) > 1 else MISSING)
 
         result, facts = apply_kind(kind, operands, **params)
         self._emit(node, facts)

@@ -189,8 +189,15 @@ def _matmul(a: Spec, b: Spec) -> Tuple[Spec, List[OpFact]]:
         return Spec(split=TOP, shape=shape, dtype=dtype), []
     if _is_splits_tuple(a.split) or _is_splits_tuple(b.split):
         # grid SUMMA path: two fully 2-D-sharded operands keep the grid
-        # layout; anything else over a splits tuple is left unknown
+        # layout, and the rank-local schedules — rows-by-r times
+        # cols-by-c ("rowcol") and its mirror ("colrow") — commit their
+        # product onto the grid without redistributing either operand;
+        # anything else over a splits tuple is left unknown
         if a.split == (0, 1) and b.split == (0, 1):
+            return Spec(split=(0, 1), shape=shape, dtype=dtype), []
+        if a.split == (0, None) and b.split == (None, 1):
+            return Spec(split=(0, 1), shape=shape, dtype=dtype), []
+        if a.split == (None, 1) and b.split == (0, None):
             return Spec(split=(0, 1), shape=shape, dtype=dtype), []
         return Spec(split=TOP, shape=shape, dtype=dtype), []
     if a.split == 0:
@@ -427,6 +434,21 @@ def _entry_svd(a: Spec, compute_uv) -> Tuple[object, List[OpFact]]:
     tall = None
     if a.shape is not None and len(a.shape) == 2:
         tall = a.shape[0] >= a.shape[1]
+    if _is_splits_tuple(a.split):
+        # grid QDWH path: a fully 2-D-sharded tall operand keeps U on
+        # the grid with S and V replicated; wide grid inputs factor the
+        # transpose and swap, landing V on the grid instead
+        if a.split in ((0, 1), (1, 0)):
+            if tall is None:
+                return (Spec(split=TOP, dtype=a.dtype), s_spec,
+                        Spec(split=TOP, dtype=a.dtype)), []
+            if tall:
+                return (Spec(split=(0, 1), dtype=a.dtype), s_spec,
+                        Spec(split=None, dtype=a.dtype)), []
+            return (Spec(split=None, dtype=a.dtype), s_spec,
+                    Spec(split=(0, 1), dtype=a.dtype)), []
+        return (Spec(split=TOP, dtype=a.dtype), s_spec,
+                Spec(split=TOP, dtype=a.dtype)), []
     if a.split is TOP or tall is None:
         return (Spec(split=TOP, dtype=a.dtype), s_spec,
                 Spec(split=TOP, dtype=a.dtype)), []
@@ -438,10 +460,38 @@ def _entry_svd(a: Spec, compute_uv) -> Tuple[object, List[OpFact]]:
     return (Spec(split=None, dtype=a.dtype), s_spec, v), []
 
 
+def _entry_qr(a: Spec, calc_q) -> Tuple[object, List[OpFact]]:
+    """qr contract: grid ``(0, 1)`` operands pin ``Q`` to ``(0, 1)`` and
+    ``R`` to ``(None, 1)`` (each row of the panel hierarchy owns its R
+    stripe); on a 1-D mesh Q follows the operand split while R is only
+    sharded down the split-1 chain."""
+    if not a.is_array:
+        return UNKNOWN, []
+    if a.split is TOP:
+        top = Spec(split=TOP, dtype=a.dtype)
+        return (top, top) if calc_q is not False else (NOT_ARRAY, top), []
+    if _is_splits_tuple(a.split):
+        if a.split == (0, 1):
+            q = Spec(split=(0, 1), dtype=a.dtype)
+            r = Spec(split=(None, 1), dtype=a.dtype)
+        else:
+            q = Spec(split=TOP, dtype=a.dtype)
+            r = Spec(split=TOP, dtype=a.dtype)
+    else:
+        q = Spec(split=a.split, dtype=a.dtype)
+        r = Spec(split=1 if a.split == 1 else None, dtype=a.dtype)
+    if calc_q is False:
+        # the runtime returns QR(None, R); R's layout does not depend on
+        # whether Q was materialized
+        return (NOT_ARRAY, r), []
+    return (q, r), []
+
+
 def apply_kind(kind: str, operands: Sequence[Spec], *,
                axis=_MISSING, shape=_MISSING, split=_MISSING,
                dtype: Optional[str] = None, keepdims=_MISSING,
-               compute_uv=_MISSING, arrays: Sequence[Spec] = (),
+               compute_uv=_MISSING, calc_q=_MISSING,
+               arrays: Sequence[Spec] = (),
                splits=_MISSING, has_comm=False,
                ) -> Tuple[object, List[OpFact]]:
     """Dispatch one op kind over evaluated operand specs.
@@ -461,6 +511,8 @@ def apply_kind(kind: str, operands: Sequence[Spec], *,
         keepdims = _MISSING
     if compute_uv is NONLIT:
         compute_uv = _MISSING
+    if calc_q is NONLIT:
+        calc_q = _MISSING
     x = _first_array(operands)
     if kind == "elementwise":
         return _elementwise(x)
@@ -529,4 +581,6 @@ def apply_kind(kind: str, operands: Sequence[Spec], *,
         return _entry_split0(x)
     if kind == "entry_svd":
         return _entry_svd(x, compute_uv if compute_uv is not _MISSING else True)
+    if kind == "entry_qr":
+        return _entry_qr(x, calc_q if calc_q is not _MISSING else True)
     return UNKNOWN, []

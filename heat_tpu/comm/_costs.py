@@ -43,11 +43,14 @@ __all__ = [
     "LayoutSolver",
     "critical_path_ms",
     "encoded_bytes",
+    "grid_panel_bounds",
     "grid_plan_cost",
+    "grid_qr_model",
     "itemsize",
     "layout_rank",
     "monolithic_cost",
     "plan_cost",
+    "qdwh_svd_model",
     "resolve_mode",
     "ring_wire_model",
     "summa_grid_model",
@@ -803,44 +806,283 @@ def summa_grid_model(
     *,
     mode: Optional[str] = None,
     overlap: bool = False,
+    layout: str = "grid",
     compute_ms_per_step: float = 0.0,
     gbps: float = DEFAULT_ICI_GBPS,
 ) -> dict:
     """Per-device wire/memory model of the grid SUMMA matmul.
 
-    ``A (m, k) @ B (k, n)`` on an ``r×c`` mesh with A splits ``(0, 1)``
-    and B splits ``(0, 1)``: the schedule runs ``L = r*c`` k-panels of
-    width ``w = ceil(k / L)``; each panel step broadcasts A's
-    ``(m/r, w)`` panel along the mesh columns (a masked psum over the
-    ``c``-ring) and B's ``(w, n/c)`` panel along the mesh rows (over the
-    ``r``-ring).  Figures assume f32 panels (:func:`ring_wire_model`'s
-    exact-byte convention); degenerate mesh axes contribute zero wire.
-    This function is the single source the runtime telemetry is credited
-    from (``core/linalg/basics.py``) and the bench headline prices —
-    delegation keeps accounted and modeled bytes identical.
+    ``layout`` selects the operand schedule on the ``r×c`` mesh:
+
+    * ``"grid"`` — A splits ``(0, 1)``, B splits ``(0, 1)``: the schedule
+      runs ``L = r*c`` k-panels of width ``w = ceil(k / L)``; each panel
+      step broadcasts A's ``(m/r, w)`` panel along the mesh columns (a
+      masked psum over the ``c``-ring) and B's ``(w, n/c)`` panel along
+      the mesh rows (over the ``r``-ring).
+    * ``"rowcol"`` — A splits ``(0, None)``, B splits ``(None, 1)``: every
+      device already owns A's full k rows for its row block and B's full
+      k columns for its column block, so the same L-panel accumulation
+      runs entirely rank-local — ZERO wire.  This is the layout whose
+      modeled bytes are strictly below the redistribute-to-``(0, 1)``-
+      then-SUMMA alternative (which pays the full grid broadcast wire).
+    * ``"colrow"`` — A splits ``(None, 1)``, B splits ``(0, None)``: the
+      k axis is the sharded axis of both operands, and the panel
+      broadcasts (owner slices its own row/column block before the masked
+      psum) ship exactly the grid schedule's bytes — wire PARITY with
+      redistribute-then-SUMMA; the win is eliding the two planned
+      redistribution dispatches and their committed copies.
+
+    All three run the identical L-step panel-ordered accumulation, so
+    they share one bitwise replicated twin.  Figures assume f32 panels
+    (:func:`ring_wire_model`'s exact-byte convention); degenerate mesh
+    axes contribute zero wire.  This function is the single source the
+    runtime telemetry is credited from (``core/linalg/basics.py``) and
+    the bench headline prices — delegation keeps accounted and modeled
+    bytes identical.
     """
+    if layout not in ("grid", "rowcol", "colrow"):
+        raise ValueError(f"unknown SUMMA layout {layout!r}")
     r, c = (max(int(s), 1) for s in mesh_shape)
     L = r * c
     w = -(-int(k) // L) if k else 0
     mloc = -(-int(m) // r)
     nloc = -(-int(n) // c)
-    a_step = ring_wire_model(mloc * w, c, mode, op="allreduce")
-    b_step = ring_wire_model(w * nloc, r, mode, op="allreduce")
-    hops = L * (a_step["ring_hops_per_device"] + b_step["ring_hops_per_device"])
-    exact = L * (a_step["exact_wire_bytes"] + b_step["exact_wire_bytes"])
-    wire = L * (a_step["wire_bytes"] + b_step["wire_bytes"])
+    if layout == "rowcol":
+        hops = exact = wire = 0
+    else:
+        a_step = ring_wire_model(mloc * w, c, mode, op="allreduce")
+        b_step = ring_wire_model(w * nloc, r, mode, op="allreduce")
+        hops = L * (a_step["ring_hops_per_device"] + b_step["ring_hops_per_device"])
+        exact = L * (a_step["exact_wire_bytes"] + b_step["exact_wire_bytes"])
+        wire = L * (a_step["wire_bytes"] + b_step["wire_bytes"])
     # at-rest operands + accumulator + in-flight panels (x2 double-buffered)
     bufs = 2 if overlap else 1
+    if layout == "rowcol":
+        a_rest, b_rest = mloc * (L * w), (L * w) * nloc
+    elif layout == "colrow":
+        a_rest, b_rest = (r * mloc) * (r * w), (c * w) * (c * nloc)
+    else:
+        a_rest, b_rest = mloc * (r * w), (c * w) * nloc
     peak = 4 * (
-        mloc * (r * w) + (c * w) * nloc + mloc * nloc
+        a_rest + b_rest + mloc * nloc
         + bufs * (mloc * w + w * nloc)
     )
     return {
         "mesh": (r, c),
+        "layout": layout,
         "panels": L,
         "panel_width": w,
         "panel_a_elems": mloc * w,
         "panel_b_elems": w * nloc,
+        "hops": hops,
+        "exact_wire_bytes": exact,
+        "wire_bytes": wire,
+        "bytes_ratio": round(wire / exact, 4) if exact else None,
+        "peak_live_bytes": peak,
+        "critical_path_ms": {
+            "serial": critical_path_ms(
+                wire, hops, compute_ms_per_step, gbps=gbps, overlap=False
+            ),
+            "overlap": critical_path_ms(
+                wire, hops, compute_ms_per_step, gbps=gbps, overlap=True
+            ),
+        },
+    }
+
+
+def grid_panel_bounds(
+    n: int, c: int, tiles_per_proc: int = 1
+) -> Tuple[Tuple[int, int, int], ...]:
+    """The column-panel schedule of the grid blocked QR: one
+    ``(owner mesh column, local column offset, width)`` triple per panel.
+
+    Columns live block-distributed over the ``c`` mesh columns in chunks
+    of ``nloc = ceil(n / c)``; each chunk's REAL width (``valid_counts``
+    algebra — pads only ever trail the last nonempty chunks) is cut into
+    ``tiles_per_proc`` tiles.  Pad columns are never part of any panel:
+    the kernel and the wire model both iterate this exact tuple, which is
+    what keeps modeled and executed collectives in lock-step."""
+    c = max(int(c), 1)
+    nloc = -(-int(n) // c)
+    out = []
+    for jc in range(c):
+        vc = min(nloc, max(0, int(n) - jc * nloc))
+        if vc <= 0:
+            continue
+        nb = -(-vc // max(int(tiles_per_proc), 1))
+        lo = 0
+        while lo < vc:
+            out.append((jc, lo, min(nb, vc - lo)))
+            lo += nb
+    return tuple(out)
+
+
+def grid_qr_model(
+    m: int,
+    n: int,
+    mesh_shape: Tuple[int, int],
+    *,
+    tiles_per_proc: int = 1,
+    mode: Optional[str] = None,
+    overlap: bool = False,
+    compute_ms_per_step: float = 0.0,
+    gbps: float = DEFAULT_ICI_GBPS,
+) -> dict:
+    """Per-device wire model of the grid blocked/CAQR QR (``m >= n``,
+    operand splits ``(0, 1)`` on an ``r×c`` mesh).
+
+    Per panel of width ``nb`` (schedule from :func:`grid_panel_bounds`):
+
+    1. panel broadcast — masked psum of the owner column's ``(m/r, nb)``
+       slab along the mesh columns (``c``-ring allreduce);
+    2. BCGS2 reorthogonalization (every panel after the first) — the
+       ``(n/c, nb)`` projection-coefficient stack gathered down the mesh
+       rows, then the ``((m/r + n/c), nb)`` correction/coefficient bundle
+       gathered along the mesh columns (both all-gathers followed by a
+       panel-ordered local sum, keeping the combine bitwise-pinnable);
+    3. TSQR combine — the ``(nb, nb)`` R factors all-gathered down the
+       mesh rows;
+    4. trailing coefficients — the ``(nb, n/c)`` W partials all-gathered
+       down the mesh rows and summed in row order.
+
+    All genuine reductions go through all-gather + ordered local sum
+    rather than psum: a psum's internal reduction order is unspecified,
+    and the twin discipline (docs/design.md §23) requires every combine
+    to be reproducible op-for-op on the replicated golden.  Figures
+    assume f32 (the :func:`ring_wire_model` convention).
+    """
+    r, c = (max(int(s), 1) for s in mesh_shape)
+    mloc = -(-int(m) // r)
+    nloc = -(-int(n) // c)
+    bounds = grid_panel_bounds(n, c, tiles_per_proc)
+    hops = exact = wire = 0
+    for idx, (_jc, _lo, nb) in enumerate(bounds):
+        steps = [
+            ring_wire_model(mloc * nb, c, mode, op="allreduce"),
+            ring_wire_model(nb * nb, r, mode, op="allgather"),
+            ring_wire_model(nb * nloc, r, mode, op="allgather"),
+        ]
+        if idx:
+            steps.append(ring_wire_model(nloc * nb, r, mode, op="allgather"))
+            steps.append(
+                ring_wire_model((mloc + nloc) * nb, c, mode, op="allgather")
+            )
+        for s in steps:
+            hops += s["ring_hops_per_device"]
+            exact += s["exact_wire_bytes"]
+            wire += s["wire_bytes"]
+    nb_max = max((b[2] for b in bounds), default=0)
+    # working set: A + Q + R columns at rest, plus the widest panel's
+    # broadcast slab, TSQR stack, and W row block (x2 when the lookahead
+    # arm keeps the next panel in flight)
+    bufs = 2 if overlap else 1
+    peak = 4 * (
+        2 * mloc * nloc + (c * nloc) * nloc
+        + bufs * (mloc * nb_max + r * nb_max * nb_max + r * nb_max * nloc)
+    )
+    return {
+        "mesh": (r, c),
+        "panels": len(bounds),
+        "panel_widths": tuple(b[2] for b in bounds),
+        "hops": hops,
+        "exact_wire_bytes": exact,
+        "wire_bytes": wire,
+        "bytes_ratio": round(wire / exact, 4) if exact else None,
+        "peak_live_bytes": peak,
+        "critical_path_ms": {
+            "serial": critical_path_ms(
+                wire, hops, compute_ms_per_step, gbps=gbps, overlap=False
+            ),
+            "overlap": critical_path_ms(
+                wire, hops, compute_ms_per_step, gbps=gbps, overlap=True
+            ),
+        },
+    }
+
+
+def qdwh_svd_model(
+    m: int,
+    n: int,
+    mesh_shape: Tuple[int, int],
+    *,
+    iterations: int = 12,
+    mode: Optional[str] = None,
+    compute_ms_per_step: float = 0.0,
+    gbps: float = DEFAULT_ICI_GBPS,
+) -> dict:
+    """Per-device wire model of the QDWH polar-decomposition SVD (``m >=
+    n``, operand splits ``(0, 1)`` on an ``r×c`` mesh).
+
+    Components, mirroring the kernel's collectives exactly:
+
+    * init — the Frobenius-norm scale: two scalar all-gathers (down the
+      mesh rows, then along the columns) with ordered local sums;
+    * per Halley iteration (``iterations`` is the static trip cap the
+      telemetry is credited for — the on-device ``while_loop`` may stop
+      earlier, and the model documents the worst case): one grid blocked
+      QR of the stacked ``(m + n, n)`` operand (:func:`grid_qr_model` on
+      the row-augmented shape), the identity-block Q2 gathered down the
+      mesh rows, ``c`` panel steps of the Q1·Q2ᵀ combine (two masked
+      psums along the mesh columns each), and the convergence scalars;
+    * epilogue — A gathered along the mesh columns, the Upᵀ·A partials
+      gathered down the rows, the symmetric factor H replicated along the
+      columns, and the U = Up·V partials gathered along the columns.
+    """
+    r, c = (max(int(s), 1) for s in mesh_shape)
+    mloc = -(-int(m) // r)
+    nloc = -(-int(n) // c)
+    Np = c * nloc
+    nploc = -(-Np // r)
+    Npr = r * nploc
+
+    def _steps(*steps):
+        return (
+            sum(s["ring_hops_per_device"] for s in steps),
+            sum(s["exact_wire_bytes"] for s in steps),
+            sum(s["wire_bytes"] for s in steps),
+        )
+
+    scalar = _steps(
+        ring_wire_model(1, r, mode, op="allgather"),
+        ring_wire_model(1, c, mode, op="allgather"),
+    )
+    qr_m = grid_qr_model(
+        r * (mloc + nploc), Np, (r, c), mode=mode,
+        compute_ms_per_step=compute_ms_per_step, gbps=gbps,
+    )
+    combine = _steps(
+        ring_wire_model(nploc * nloc, r, mode, op="allgather"),
+        *(
+            [
+                ring_wire_model(mloc * nloc, c, mode, op="allreduce"),
+                ring_wire_model(Npr * nloc, c, mode, op="allreduce"),
+            ]
+            * c
+        ),
+    )
+    per_iter = (
+        qr_m["hops"] + combine[0] + scalar[0],
+        qr_m["exact_wire_bytes"] + combine[1] + scalar[1],
+        qr_m["wire_bytes"] + combine[2] + scalar[2],
+    )
+    epilogue = _steps(
+        ring_wire_model(mloc * nloc, c, mode, op="allgather"),
+        ring_wire_model(nloc * Np, r, mode, op="allgather"),
+        ring_wire_model(nloc * Np, c, mode, op="allgather"),
+        ring_wire_model(mloc * Np, c, mode, op="allgather"),
+    )
+    it = max(int(iterations), 1)
+    hops = scalar[0] + it * per_iter[0] + epilogue[0]
+    exact = scalar[1] + it * per_iter[1] + epilogue[1]
+    wire = scalar[2] + it * per_iter[2] + epilogue[2]
+    peak = qr_m["peak_live_bytes"] + 4 * (
+        2 * mloc * nloc + Npr * nloc + mloc * Npr + 2 * Np * Np
+    )
+    return {
+        "mesh": (r, c),
+        "iterations": it,
+        "per_iteration_wire_bytes": per_iter[2],
+        "qr_wire_bytes": qr_m["wire_bytes"],
         "hops": hops,
         "exact_wire_bytes": exact,
         "wire_bytes": wire,

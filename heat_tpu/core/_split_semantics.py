@@ -64,7 +64,15 @@ Kinds (the transfer-function families; ``params`` refine them):
                    ZERO layout traffic (no resplit event to cost);
                    only a feature-split input re-splits onto rows
 ``entry_svd``      ``SVD(U, S, V)`` namedtuple: U per ``entry_split0``,
-                   S and V replicated
+                   S and V replicated; grid ``(0, 1)``/``(1, 0)``
+                   operands pin U to ``(0, 1)`` with S and V replicated
+                   (wide grid inputs transpose-and-swap, so V lands on
+                   the grid instead of U)
+``entry_qr``       ``QR(Q, R)`` namedtuple: grid ``(0, 1)`` operands
+                   pin Q to ``(0, 1)`` and R to ``(None, 1)``; 1-D Q
+                   follows the operand split, R is sharded only down
+                   the split-1 chain (``split == 1`` keeps R on 1,
+                   everything else replicates R)
 =================  =====================================================
 """
 
@@ -104,6 +112,7 @@ KINDS = frozenset(
         "entry_fit",
         "entry_split0",
         "entry_svd",
+        "entry_qr",
     }
 )
 
@@ -175,6 +184,7 @@ KIND_LAYOUT_FREEDOM: Dict[str, str] = {
     "entry_fit": "fixed",
     "entry_split0": "fixed",
     "entry_svd": "fixed",
+    "entry_qr": "fixed",
 }
 
 

@@ -48,10 +48,17 @@ from .._compile import jitted
 from .._jax_compat import shard_map
 from ..dndarray import DNDarray
 from ..sanitation import sanitize_in
+from .._split_semantics import split_semantics as _split_semantics
+from ...telemetry import _core as _tel
 
 __all__ = ["qr"]
 
 QR = collections.namedtuple("QR", "Q, R")
+
+# compiled replicated-golden twins, keyed on (mesh, shape, dtype, tiles,
+# arm) — a plain dict, NOT the production jit cache: twin runs must not
+# record dispatches (tests gate the kernel's count at exactly one)
+_REFERENCE_CACHE: dict = {}
 
 
 def _tsqr_program(comm):
@@ -203,6 +210,473 @@ def _cgs2_split1(a: DNDarray, tiles_per_proc: int) -> Tuple[jnp.ndarray, jnp.nda
     return jitted(key, make)(arr)
 
 
+def _mm(a, b):
+    """Matmul pinned behind an optimization barrier — the grid QR/SVD
+    twin discipline's determinism primitive.  XLA CPU decides a dot's
+    emission (library GEMM vs inlined fusion loop, with different
+    accumulation orders) from its fusion CONTEXT, so the same matmul can
+    produce different bits inside the shard_map kernel and the
+    replicated golden simulation.  Barriers on the operands and the
+    result pin every twin-sensitive dot as a standalone op in BOTH
+    programs, making the pair bitwise-reproducible (without them the
+    ragged-panel shapes in tests/test_linalg2d.py diverge by 1 ulp)."""
+    a, b = jax.lax.optimization_barrier((a, b))
+    return jax.lax.optimization_barrier(jnp.matmul(a, b))
+
+
+def _sumsq(x):
+    """Sum of squares pinned behind optimization barriers — same
+    rationale as :func:`_mm`, for reductions: XLA CPU's reduce emission
+    also depends on fusion context, and the QDWH convergence scalars
+    (norm scale, delta) feed every subsequent bit of the iteration."""
+    t = jax.lax.optimization_barrier(x * x)
+    return jax.lax.optimization_barrier(jnp.sum(t))
+
+
+def _grid_panel_schedule(n: int, c: int, tiles_per_proc: int):
+    """Enrich :func:`heat_tpu.comm._costs.grid_panel_bounds` with each
+    panel's padded-global column start and the per-mesh-column valid
+    counts — the static facts the kernel, the wire model, and the
+    replicated golden all iterate in lock-step."""
+    from ...comm._costs import grid_panel_bounds
+
+    nloc = -(-n // c)
+    bounds = tuple(
+        (jc, lo, nb, jc * nloc + lo)
+        for (jc, lo, nb) in grid_panel_bounds(n, c, tiles_per_proc)
+    )
+    vcs = tuple(min(nloc, max(0, n - jc * nloc)) for jc in range(c))
+    return nloc, bounds, vcs
+
+
+def _caqr_shard_body(a_loc, *, ax0, ax1, r, c, nloc, bounds, vcs, overlapped):
+    """Per-device body of the grid blocked/CAQR QR — called inside a
+    shard_map over the r×c mesh (axes ``ax0``/``ax1`` bound), and reused
+    verbatim by the QDWH SVD's inner factorization (svd.py).
+
+    Panel ownership algebra (docs/design.md §23): columns live
+    block-distributed along the mesh columns in chunks of ``nloc``;
+    ``bounds`` holds ``(owner, local offset, width, global start)`` per
+    panel over REAL columns only (pad columns are never factored — a
+    factored zero column would produce garbage orthonormal directions
+    that corrupt every trailing real column).  Per panel:
+
+    1. masked-psum broadcast of the owner's panel along the mesh columns
+       (owner block + zero blocks — any-order exact);
+    2. BCGS2 reorthogonalization against the accumulated basis (skipped
+       on the first panel): the projection coefficients are reduced down
+       the mesh rows and the correction/coefficient bundle combined
+       along the columns, both via all-gather + index-ordered local sums
+       (a psum's internal reduction order is unspecified and would break
+       the bitwise twin);
+    3. TSQR down the mesh rows: local QR, all-gather of the small R
+       stack, second QR, Q-correction matmul;
+    4. trailing update via the W = Qpᵀ·A coefficients (reduced down the
+       rows in index order), applied as TWO column-disjoint masked
+       subtracts — next panel, then the rest — in BOTH arms, so the
+       overlap arm can factor panel ``p+1`` between them (distance-2
+       lookahead) while every column still sees the identical op
+       sequence, keeping the two arms bitwise-equal.
+
+    Q columns and the panel's R diagonal block are written at factor
+    time (the lookahead factor of ``p+1`` must see the basis including
+    panel ``p``); R's trailing rows get the W coefficients and R's
+    second-projection rows the BCGS2 coefficients via ``.add`` — each R
+    entry receives at most two addends from zero, and two-term IEEE
+    addition commutes, so the arms' different write orders agree
+    bitwise.  Returns ``(q_loc, r_loc)`` with ``r_loc`` of padded shape
+    ``(c*nloc, nloc)``, bit-identical down the mesh rows.
+    """
+    mloc = a_loc.shape[0]
+    Np = c * nloc
+    dt = a_loc.dtype
+    i = jax.lax.axis_index(ax0)
+    j = jax.lax.axis_index(ax1)
+    ids = jnp.arange(nloc)
+    col_gids = j * nloc + ids
+    valid = ids < jnp.asarray(vcs)[j]
+    row_valid = np.zeros((Np,), dtype=bool)
+    for jc in range(c):
+        row_valid[jc * nloc : jc * nloc + vcs[jc]] = True
+    row_valid = jnp.asarray(row_valid)
+    zero = jnp.zeros((), dt)
+
+    def bcast_cols(x, owner):
+        return jax.lax.psum(jnp.where(owner == j, x, zero), ax1)
+
+    def rowsum(x):
+        g = jax.lax.all_gather(x, ax0)
+        acc = g[0]
+        for b in range(1, r):
+            acc = acc + g[b]
+        return acc
+
+    def factor(p, a_cur, q_acc, r_acc):
+        jc, lo, nb, gstart = bounds[p]
+        pan = bcast_cols(jax.lax.slice_in_dim(a_cur, lo, lo + nb, axis=1), jc)
+        if p:
+            z_loc = rowsum(_mm(q_acc.T, pan))
+            prev = valid & (col_gids < gstart)
+            z_loc = jnp.where(prev[:, None], z_loc, zero)
+            bundle = jnp.concatenate([_mm(q_acc, z_loc), z_loc], axis=0)
+            g = jax.lax.all_gather(bundle, ax1)  # (c, mloc+nloc, nb)
+            corr = g[0, :mloc]
+            for b in range(1, c):
+                corr = corr + g[b, :mloc]
+            z_full = jnp.reshape(g[:, mloc:], (Np, nb))
+            pan = pan - corr
+            zmask = (row_valid & (jnp.arange(Np) < gstart))[:, None]
+            r_add = jnp.zeros_like(r_acc).at[:, lo : lo + nb].set(
+                jnp.where(zmask, z_full, zero)
+            )
+            r_acc = r_acc + jnp.where(jc == j, r_add, zero)
+        q1, r1 = jnp.linalg.qr(pan)
+        st = jax.lax.all_gather(r1, ax0, tiled=True)  # (r*nb, nb)
+        q2, rp = jnp.linalg.qr(st)
+        qp = _mm(q1, jax.lax.dynamic_slice_in_dim(q2, i * nb, nb, 0))
+        q_acc = jnp.where(jc == j, q_acc.at[:, lo : lo + nb].set(qp), q_acc)
+        r_blk = jnp.zeros_like(r_acc).at[gstart : gstart + nb, lo : lo + nb].set(rp)
+        r_acc = r_acc + jnp.where(jc == j, r_blk, zero)
+        return qp, q_acc, r_acc
+
+    def masks(p):
+        _jc, _lo, nb, gstart = bounds[p]
+        trail = valid & (col_gids >= gstart + nb)
+        if p + 1 < len(bounds):
+            _, _, nbn, gsn = bounds[p + 1]
+            nxt = valid & (col_gids >= gsn) & (col_gids < gsn + nbn)
+        else:
+            nxt = jnp.zeros_like(trail)
+        return trail, nxt, trail & ~nxt
+
+    a_cur = a_loc
+    q_acc = jnp.zeros_like(a_loc)
+    r_acc = jnp.zeros((Np, nloc), dt)
+    P = len(bounds)
+    if not overlapped:
+        for p in range(P):
+            qp, q_acc, r_acc = factor(p, a_cur, q_acc, r_acc)
+            _jc, _lo, nb, gstart = bounds[p]
+            trail, nxt, rest = masks(p)
+            w = rowsum(_mm(qp.T, a_cur))
+            a_cur = a_cur - _mm(qp, jnp.where(nxt[None, :], w, zero))
+            a_cur = a_cur - _mm(qp, jnp.where(rest[None, :], w, zero))
+            r_acc = r_acc.at[gstart : gstart + nb, :].add(
+                jnp.where(trail[None, :], w, zero)
+            )
+    else:
+        qp, q_acc, r_acc = factor(0, a_cur, q_acc, r_acc)
+        for p in range(P):
+            _jc, _lo, nb, gstart = bounds[p]
+            trail, nxt, rest = masks(p)
+            w = rowsum(_mm(qp.T, a_cur))
+            a_cur = a_cur - _mm(qp, jnp.where(nxt[None, :], w, zero))
+            if p + 1 < P:
+                qn, q_acc, r_acc = factor(p + 1, a_cur, q_acc, r_acc)
+            a_cur = a_cur - _mm(qp, jnp.where(rest[None, :], w, zero))
+            r_acc = r_acc.at[gstart : gstart + nb, :].add(
+                jnp.where(trail[None, :], w, zero)
+            )
+            if p + 1 < P:
+                qp = qn
+    return q_acc, r_acc
+
+
+def _caqr_sim(blocks, *, r, c, nloc, bounds, vcs, overlapped):
+    """Lockstep replicated simulation of :func:`_caqr_shard_body` — the
+    bitwise golden twin (PR 11 discipline).  ``blocks[(i, j)]`` holds the
+    ``(mloc, nloc)`` shard of mesh position ``(i, j)``; every collective
+    is replayed op-for-op: the masked psum as an index-ordered sum of
+    the owner block plus explicit zero blocks (mirroring psum's ``-0 +
+    +0 = +0`` normalization), all-gathers as index-ordered stacks.
+    Returns ``(q_blocks, r_blocks)`` matching the kernel bit-for-bit."""
+    mloc = blocks[(0, 0)].shape[0]
+    Np = c * nloc
+    dt = blocks[(0, 0)].dtype
+    zero = jnp.zeros((), dt)
+    col_gids = {j: j * nloc + jnp.arange(nloc) for j in range(c)}
+    valid = {j: jnp.arange(nloc) < jnp.asarray(vcs)[j] for j in range(c)}
+    row_valid = np.zeros((Np,), dtype=bool)
+    for jc in range(c):
+        row_valid[jc * nloc : jc * nloc + vcs[jc]] = True
+    row_valid = jnp.asarray(row_valid)
+
+    def bcast_cols(vals_row, owner):
+        acc = vals_row[0] if owner == 0 else jnp.where(False, vals_row[0], zero)
+        for jp in range(1, c):
+            acc = acc + (
+                vals_row[jp] if owner == jp else jnp.where(False, vals_row[jp], zero)
+            )
+        return acc
+
+    def rowsum(vals_col):
+        acc = vals_col[0]
+        for b in range(1, r):
+            acc = acc + vals_col[b]
+        return acc
+
+    def factor(p, a_cur, q_acc, r_acc):
+        jc, lo, nb, gstart = bounds[p]
+        pan = {}
+        for i in range(r):
+            row = [
+                jax.lax.slice_in_dim(a_cur[(i, jp)], lo, lo + nb, axis=1)
+                for jp in range(c)
+            ]
+            p_i = bcast_cols(row, jc)
+            for j in range(c):
+                pan[(i, j)] = p_i
+        qp = {}
+        if p:
+            z = {}
+            for j in range(c):
+                for i in range(r):
+                    z[(i, j)] = rowsum(
+                        [
+                            _mm(q_acc[(b, j)].T, pan[(b, j)])
+                            for b in range(r)
+                        ]
+                    )
+            for j in range(c):
+                prev = valid[j] & (col_gids[j] < gstart)
+                for i in range(r):
+                    z[(i, j)] = jnp.where(prev[:, None], z[(i, j)], zero)
+            for i in range(r):
+                bundles = [
+                    jnp.concatenate(
+                        [_mm(q_acc[(i, jp)], z[(i, jp)]), z[(i, jp)]],
+                        axis=0,
+                    )
+                    for jp in range(c)
+                ]
+                g = jnp.stack(bundles)  # all_gather along the mesh columns
+                corr = g[0, :mloc]
+                for b in range(1, c):
+                    corr = corr + g[b, :mloc]
+                z_full = jnp.reshape(g[:, mloc:], (Np, nb))
+                for j in range(c):
+                    pan[(i, j)] = pan[(i, j)] - corr
+                zmask = (row_valid & (jnp.arange(Np) < gstart))[:, None]
+                r_add = jnp.zeros((Np, nloc), dt).at[:, lo : lo + nb].set(
+                    jnp.where(zmask, z_full, zero)
+                )
+                for j in range(c):
+                    r_acc[(i, j)] = r_acc[(i, j)] + (
+                        r_add if jc == j else jnp.where(False, r_add, zero)
+                    )
+        for j in range(c):
+            q1s, r1s = {}, {}
+            for i in range(r):
+                q1s[i], r1s[i] = jnp.linalg.qr(pan[(i, j)])
+            st = jnp.concatenate([r1s[b] for b in range(r)], axis=0)
+            q2, rp = jnp.linalg.qr(st)
+            for i in range(r):
+                qp[(i, j)] = _mm(
+                    q1s[i], jax.lax.dynamic_slice_in_dim(q2, i * nb, nb, 0)
+                )
+                if jc == j:
+                    q_acc[(i, j)] = q_acc[(i, j)].at[:, lo : lo + nb].set(qp[(i, j)])
+                r_blk = jnp.zeros((Np, nloc), dt).at[
+                    gstart : gstart + nb, lo : lo + nb
+                ].set(rp)
+                r_acc[(i, j)] = r_acc[(i, j)] + (
+                    r_blk if jc == j else jnp.where(False, r_blk, zero)
+                )
+        return qp
+
+    def masks(p, j):
+        _jc, _lo, nb, gstart = bounds[p]
+        trail = valid[j] & (col_gids[j] >= gstart + nb)
+        if p + 1 < len(bounds):
+            _, _, nbn, gsn = bounds[p + 1]
+            nxt = valid[j] & (col_gids[j] >= gsn) & (col_gids[j] < gsn + nbn)
+        else:
+            nxt = jnp.zeros_like(trail)
+        return trail, nxt, trail & ~nxt
+
+    def wcoeffs(qp, a_cur):
+        w = {}
+        for j in range(c):
+            for i in range(r):
+                w[(i, j)] = rowsum(
+                    [_mm(qp[(b, j)].T, a_cur[(b, j)]) for b in range(r)]
+                )
+        return w
+
+    def update(qp, a_cur, r_acc, p, which):
+        for j in range(c):
+            trail, nxt, rest = masks(p, j)
+            mask = {"next": nxt, "rest": rest}[which]
+            for i in range(r):
+                a_cur[(i, j)] = a_cur[(i, j)] - _mm(
+                    qp[(i, j)], jnp.where(mask[None, :], w[(i, j)], zero)
+                )
+
+    a_cur = dict(blocks)
+    q_acc = {k: jnp.zeros_like(v) for k, v in blocks.items()}
+    r_acc = {k: jnp.zeros((Np, nloc), dt) for k in blocks}
+    P = len(bounds)
+    if not overlapped:
+        for p in range(P):
+            qp = factor(p, a_cur, q_acc, r_acc)
+            _jc, _lo, nb, gstart = bounds[p]
+            w = wcoeffs(qp, a_cur)
+            update(qp, a_cur, r_acc, p, "next")
+            update(qp, a_cur, r_acc, p, "rest")
+            for j in range(c):
+                trail = masks(p, j)[0]
+                for i in range(r):
+                    r_acc[(i, j)] = r_acc[(i, j)].at[gstart : gstart + nb, :].add(
+                        jnp.where(trail[None, :], w[(i, j)], zero)
+                    )
+    else:
+        qp = factor(0, a_cur, q_acc, r_acc)
+        for p in range(P):
+            _jc, _lo, nb, gstart = bounds[p]
+            w = wcoeffs(qp, a_cur)
+            update(qp, a_cur, r_acc, p, "next")
+            if p + 1 < P:
+                qn = factor(p + 1, a_cur, q_acc, r_acc)
+            update(qp, a_cur, r_acc, p, "rest")
+            for j in range(c):
+                trail = masks(p, j)[0]
+                for i in range(r):
+                    r_acc[(i, j)] = r_acc[(i, j)].at[gstart : gstart + nb, :].add(
+                        jnp.where(trail[None, :], w[(i, j)], zero)
+                    )
+            if p + 1 < P:
+                qp = qn
+    return q_acc, r_acc
+
+
+def _grid_qr_reference(arr, mesh_shape, *, tiles_per_proc=1, overlapped=False):
+    """Replicated golden twin of the grid CAQR: runs the exact panel
+    schedule of :func:`_grid_qr_fn` on an unsharded operand via
+    :func:`_caqr_sim` and reassembles the padded global ``(q, r)`` —
+    bitwise-equal to the kernel's outputs (bench.py and
+    tests/test_linalg2d.py pin this).
+
+    The whole simulation runs as ONE jitted program: eager per-op
+    execution changes XLA CPU's fusion context and with it the emission
+    of small dots, so an unjitted twin diverges by 1 ulp on ragged
+    panels even with :func:`_mm`'s barriers in place."""
+    r, c = mesh_shape
+    m, n = arr.shape
+    mloc = -(-m // r)
+    nloc, bounds, vcs = _grid_panel_schedule(n, c, tiles_per_proc)
+    Mp, Np = r * mloc, c * nloc
+
+    def run(x):
+        x = jnp.pad(x, ((0, Mp - m), (0, Np - n)))
+        blocks = {
+            (i, j): x[i * mloc : (i + 1) * mloc, j * nloc : (j + 1) * nloc]
+            for i in range(r)
+            for j in range(c)
+        }
+        qb, rb = _caqr_sim(
+            blocks, r=r, c=c, nloc=nloc, bounds=bounds, vcs=vcs,
+            overlapped=overlapped,
+        )
+        q = jnp.concatenate(
+            [
+                jnp.concatenate([qb[(i, j)] for j in range(c)], axis=1)
+                for i in range(r)
+            ],
+            axis=0,
+        )
+        r_full = jnp.concatenate([rb[(0, j)] for j in range(c)], axis=1)
+        return q, r_full[:n]
+
+    key = (mesh_shape, (m, n), str(arr.dtype), tiles_per_proc, overlapped)
+    fn = _REFERENCE_CACHE.get(key)
+    if fn is None:
+        fn = _REFERENCE_CACHE[key] = jax.jit(run)
+    return fn(arr)
+
+
+def _grid_qr_fn(comm, bounds, vcs, overlapped, nloc, n, shape, dtype_str):
+    """The grid CAQR as ONE cached shard_map program ``f(a_padded) ->
+    (q, r)``: Q on the ``(ax0, ax1)`` grid, R column-sharded with true
+    row count (replicated down the mesh rows bit-identically)."""
+    key = ("qr.grid", comm, bounds, vcs, shape, dtype_str, overlapped)
+
+    def make():
+        ax0, ax1 = comm.axis_names
+        r, c = comm.mesh_shape
+
+        def kern(a_loc):
+            q_loc, r_loc = _caqr_shard_body(
+                a_loc,
+                ax0=ax0,
+                ax1=ax1,
+                r=r,
+                c=c,
+                nloc=nloc,
+                bounds=bounds,
+                vcs=vcs,
+                overlapped=overlapped,
+            )
+            return q_loc, r_loc[:n]
+
+        return shard_map(
+            kern,
+            mesh=comm.mesh,
+            in_specs=(PartitionSpec(ax0, ax1),),
+            out_specs=(PartitionSpec(ax0, ax1), PartitionSpec(None, ax1)),
+            check_vma=False,
+        )
+
+    return jitted(key, make)
+
+
+def _grid_qr(a: DNDarray, jt, tiles_per_proc: int):
+    """Dispatch wrapper of the grid blocked/CAQR QR (operand splits
+    ``(0, 1)``, ``m >= n``): ships the ZEROED buffer (pad rows/columns
+    must be exact zeros — pads in a factored panel would corrupt the
+    basis), launches the one cached program, credits the telemetry
+    ledger with figures straight from
+    :func:`heat_tpu.comm._costs.grid_qr_model` (delegation keeps
+    accounted and modeled bytes byte-identical), and times the dispatch
+    under the overlap policy."""
+    from ...comm import _costs
+    from ...comm.overlap import overlap_enabled, timed_dispatch
+
+    comm = a.comm
+    m, n = a.shape
+    r, c = comm.mesh_shape
+    mloc = -(-m // r)
+    nloc, bounds, vcs = _grid_panel_schedule(n, c, int(tiles_per_proc))
+    nb_max = max(b[2] for b in bounds)
+    if mloc < nb_max:
+        raise ValueError(
+            f"qr: grid CAQR needs row shards at least as tall as the widest "
+            f"column panel: {m}x{n} over the {r}x{c} mesh leaves "
+            f"({mloc}, {nloc}) shards with {mloc} rows < panel width "
+            f"{nb_max}; use a taller matrix, a flatter mesh, or raise "
+            f"tiles_per_proc"
+        )
+    arr = a._zeroed_buffer()
+    if arr.dtype != jt:
+        arr = arr.astype(jt)
+    ov = overlap_enabled(len(bounds))
+    fn = _grid_qr_fn(
+        comm, bounds, vcs, ov, nloc, n, tuple(map(int, arr.shape)), str(arr.dtype)
+    )
+    if _tel.enabled:
+        model = _costs.grid_qr_model(
+            m, n, (r, c), tiles_per_proc=int(tiles_per_proc), overlap=ov
+        )
+        _tel.account_bytes(
+            "qr2d", "f32", model["exact_wire_bytes"], model["wire_bytes"]
+        )
+        with _tel.span(
+            "comm:qr2d", mesh=f"{r}x{c}", panels=len(bounds), overlap=ov
+        ):
+            return timed_dispatch("qr2d", ov, lambda: fn(arr))
+    return timed_dispatch("qr2d", ov, lambda: fn(arr))
+
+
+@_split_semantics("entry_qr")
 def qr(
     a: DNDarray,
     tiles_per_proc: int = 1,
@@ -224,6 +698,28 @@ def qr(
         raise ValueError(f"qr requires a 2-D DNDarray, got {a.ndim}-d")
 
     dtype = a.dtype if types.heat_type_is_inexact(a.dtype) else types.float32
+
+    comm = a.comm
+    if comm.mesh_ndim == 2 and comm.size > 1 and a.splits == (0, 1):
+        # grid blocked/CAQR QR on the r×c mesh (arXiv 2112.09017's dense
+        # QR at pod scale): panel TSQR down the mesh columns + trailing
+        # update, one cached dispatch, bitwise-pinned overlap arm
+        m, n = a.shape
+        if m < n:
+            r_m, c_m = comm.mesh_shape
+            raise ValueError(
+                f"qr: wide inputs have no grid formulation: {m}x{n} with "
+                f"splits (0, 1) on the {r_m}x{c_m} mesh — factor the "
+                f"transpose (resplit its layout to (0, 1)) and transpose "
+                f"back, or use svd for the spectral path"
+            )
+        q_arr, r_arr = _grid_qr(a, dtype.jax_type(), int(tiles_per_proc))
+        r_nd = DNDarray(r_arr, (n, n), dtype, (None, 1), a.device, comm, True)
+        if not calc_q:
+            return QR(None, r_nd)
+        q_nd = DNDarray(q_arr, (m, n), dtype, (0, 1), a.device, comm, True)
+        return QR(q_nd, r_nd)
+
     arr = a.larray.astype(dtype.jax_type())
     aa = a if (a.dtype is dtype and arr is a.larray) else DNDarray(
         arr, a.shape, dtype, a.split, a.device, a.comm, True

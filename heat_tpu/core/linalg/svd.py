@@ -144,6 +144,436 @@ def _svd_pipeline(a: DNDarray, osplit, dtype, compute_uv: bool):
 _fused_svd_pipeline = fuse(_svd_pipeline)
 
 
+# ---------------------------------------------------------------------------
+# grid (2-D mesh) QDWH polar-decomposition SVD — arXiv 2112.09017's route
+# to record-scale SVD: a dynamically-weighted Halley iteration built on the
+# grid blocked QR, then an eigendecomposition of the small symmetric factor
+# ---------------------------------------------------------------------------
+
+import jax
+
+from .._compile import jitted as _jitted
+from .._jax_compat import shard_map as _shard_map
+from jax.sharding import PartitionSpec as _P
+from ...telemetry import _core as _tel
+from .qr import (
+    _caqr_shard_body as _caqr_body,
+    _caqr_sim,
+    _grid_panel_schedule,
+    _mm,
+    _sumsq,
+)
+
+#: static trip cap of the QDWH while_loop — the cubic ``l`` recurrence
+#: reaches ``1 - eps`` from any f64 floor in <= 9 iterations, so 12 bounds
+#: both dtypes with margin; the telemetry model is credited for exactly
+#: this worst case (``qdwh_svd_model(iterations=_QDWH_MAXIT)``)
+_QDWH_MAXIT = 12
+
+
+def _qdwh_coeffs(l):
+    """The dynamically-weighted Halley coefficients ``(a, b, c, l')`` from
+    the lower bound ``l`` on the current polar iterate's smallest singular
+    value (Nakatsukasa/Bai/Gygi's closed form).  Shared verbatim by the
+    kernel and the replicated golden — the convergence decision must be
+    bitwise-identical in both programs (docs/design.md §23)."""
+    l2 = l * l
+    d = jnp.cbrt((4.0 * (1.0 - l2)) / (l2 * l2))
+    a = jnp.sqrt(1.0 + d) + 0.5 * jnp.sqrt(
+        8.0 - 4.0 * d + (8.0 * (2.0 - l2)) / (l2 * jnp.sqrt(1.0 + d))
+    )
+    b = (a - 1.0) ** 2 / 4.0
+    c = a + b - 1.0
+    ln = jnp.minimum(l * (a + b * l2) / (1.0 + c * l2), 1.0)
+    return a, b, c, ln
+
+
+def _qdwh_tols(n, np_dtype):
+    """Static convergence tolerances: iterate while the lower bound is
+    measurably below 1 OR successive polar iterates still move more than
+    rounding at the ``sqrt(n)``-element Frobenius scale."""
+    eps = float(np.finfo(np_dtype).eps)
+    return eps / n, 10.0 * eps, 10.0 * eps * float(n) ** 0.5
+
+
+def _grid_svd_fn(comm, shape, n, dtype_str, overlapped):
+    """The QDWH polar SVD as ONE cached shard_map program ``f(a_padded)
+    -> (u, s, v)`` over a ``(0, 1)``-laid-out tall operand.
+
+    Per device: scale by the Frobenius norm (scalar all-gathers + ordered
+    sums down both mesh axes — deterministic, unlike a bare psum), then a
+    ``jax.lax.while_loop`` whose carry holds ``(X, l, k, delta)`` — the
+    ``l`` lower-bound recurrence rides the carry, convergence is decided
+    ON DEVICE (no host syncs, SPMD202-clean), and the static trip cap
+    ``_QDWH_MAXIT`` bounds the program.  Each iteration stacks
+    ``[sqrt(c)·X; I]`` (the identity block INCLUDES the pad diagonal —
+    pad unit columns keep every panel full rank and provably wash out of
+    the combine: their Q1 columns are exactly zero), runs the grid CAQR
+    body (:func:`heat_tpu.core.linalg.qr._caqr_shard_body` — the same
+    code the public grid QR dispatches), and combines ``X' = (b/c)·X +
+    ((a - b/c)/sqrt(c))·Q1·Q2ᵀ`` in ``c`` panel-ordered steps of masked
+    column broadcasts.  Epilogue: ``H = UpᵀA`` assembled via ordered
+    gathers, symmetrized, eigendecomposed per device (replicated inputs
+    give replicated outputs bit-for-bit), and ``U = Up·V`` reduced in
+    mesh-column order."""
+    key = ("svd.qdwh", comm, shape, n, dtype_str, _QDWH_MAXIT, overlapped)
+
+    def make():
+        ax0, ax1 = comm.axis_names
+        r, c = comm.mesh_shape
+        mloc = shape[0] // r
+        nloc = shape[1] // c
+        Np = c * nloc
+        nploc = -(-Np // r)
+        Npr = r * nploc
+        qnloc, qbounds, qvcs = _grid_panel_schedule(Np, c, 1)
+        l0, ltol, dtol = _qdwh_tols(n, np.dtype(dtype_str))
+
+        def kern(a_loc):
+            dt = a_loc.dtype
+            i = jax.lax.axis_index(ax0)
+            j = jax.lax.axis_index(ax1)
+            zero = jnp.zeros((), dt)
+
+            def scalar_reduce(v):
+                g0 = jax.lax.all_gather(v, ax0)
+                acc = g0[0]
+                for b in range(1, r):
+                    acc = acc + g0[b]
+                g1 = jax.lax.all_gather(acc, ax1)
+                acc = g1[0]
+                for b in range(1, c):
+                    acc = acc + g1[b]
+                return acc
+
+            def bcast_cols(x, owner):
+                return jax.lax.psum(jnp.where(owner == j, x, zero), ax1)
+
+            def colsum(x):
+                g = jax.lax.all_gather(x, ax1)
+                acc = g[0]
+                for b in range(1, c):
+                    acc = acc + g[b]
+                return acc
+
+            def gather_cols(x):
+                g = jax.lax.all_gather(x, ax1)  # (c, rows, cols)
+                return jnp.reshape(
+                    jnp.moveaxis(g, 0, 1), (x.shape[0], c * x.shape[1])
+                )
+
+            alpha = jnp.sqrt(scalar_reduce(_sumsq(a_loc)))
+            alpha = jnp.where(alpha > 0, alpha, jnp.ones((), dt))
+            x0 = a_loc / alpha
+            row_gid = i * nploc + jnp.arange(nploc)[:, None]
+            col_gid = j * nloc + jnp.arange(nloc)[None, :]
+            eye_block = (row_gid == col_gid).astype(dt)
+
+            def cond(carry):
+                _x, l, k, delta = carry
+                return (k < _QDWH_MAXIT) & (
+                    (delta > dtol) | (jnp.abs(1.0 - l) > ltol)
+                )
+
+            def body(carry):
+                x, l, k, _delta = carry
+                ca, cb, cc, ln = _qdwh_coeffs(l)
+                sc = jnp.sqrt(cc).astype(dt)
+                stacked = jnp.concatenate([sc * x, eye_block], axis=0)
+                q_loc, _r_loc = _caqr_body(
+                    stacked,
+                    ax0=ax0,
+                    ax1=ax1,
+                    r=r,
+                    c=c,
+                    nloc=qnloc,
+                    bounds=qbounds,
+                    vcs=qvcs,
+                    overlapped=overlapped,
+                )
+                q1 = q_loc[:mloc]
+                q2f = jax.lax.all_gather(q_loc[mloc:], ax0, tiled=True)
+                acc = jnp.zeros((mloc, Npr), dt)
+                for t in range(c):
+                    acc = acc + _mm(
+                        bcast_cols(q1, t), bcast_cols(q2f, t).T
+                    )
+                m_loc = jax.lax.dynamic_slice_in_dim(acc, j * nloc, nloc, 1)
+                ca = ca.astype(dt)
+                cb = cb.astype(dt)
+                cc = cc.astype(dt)
+                x_new = (cb / cc) * x + ((ca - cb / cc) / sc) * m_loc
+                delta = jnp.sqrt(scalar_reduce(_sumsq(x_new - x)))
+                return x_new, ln.astype(l.dtype), k + 1, delta
+
+            init = (
+                x0,
+                jnp.asarray(l0, x0.dtype),
+                jnp.zeros((), jnp.int32),
+                jnp.asarray(jnp.inf, x0.dtype),
+            )
+            up_loc, _l, _k, _delta = jax.lax.while_loop(cond, body, init)
+
+            a_full = gather_cols(a_loc)  # (mloc, Np)
+            g = jax.lax.all_gather(_mm(up_loc.T, a_full), ax0)
+            h_rows = g[0]
+            for b in range(1, r):
+                h_rows = h_rows + g[b]  # (nloc, Np)
+            h_full = jnp.reshape(
+                jax.lax.all_gather(h_rows, ax1, tiled=True), (Np, Np)
+            )
+            h = h_full[:n, :n]
+            hs = 0.5 * (h + h.T)
+            evals, evecs = jnp.linalg.eigh(hs)
+            s = evals[::-1]
+            v = evecs[:, ::-1]
+            vp = jnp.zeros((Np, Np), dt).at[:n, :n].set(v)
+            u_part = _mm(
+                up_loc, jax.lax.dynamic_slice_in_dim(vp, j * nloc, nloc, 0)
+            )
+            u_full = colsum(u_part)  # (mloc, Np)
+            u_loc = jax.lax.dynamic_slice_in_dim(u_full, j * nloc, nloc, 1)
+            return u_loc, s, v
+
+        return _shard_map(
+            kern,
+            mesh=comm.mesh,
+            in_specs=(_P(ax0, ax1),),
+            out_specs=(_P(ax0, ax1), _P(), _P()),
+            check_vma=False,
+        )
+
+    return _jitted(key, make)
+
+
+def _grid_svd(a: DNDarray, dtype, compute_uv: bool):
+    """Dispatch wrapper of the grid QDWH SVD: early guard with shapes and
+    mesh in the message, zeroed buffer, one cached program, telemetry
+    credited straight from :func:`heat_tpu.comm._costs.qdwh_svd_model`
+    (op ``svd2d``), timed under the overlap policy."""
+    from ...comm import _costs
+    from ...comm.overlap import overlap_enabled, timed_dispatch
+
+    comm, device = a.comm, a.device
+    m, n = a.shape
+    r, c = comm.mesh_shape
+    mloc = -(-m // r)
+    nloc = -(-n // c)
+    Np = c * nloc
+    nploc = -(-Np // r)
+    if mloc + nploc < nloc:
+        raise ValueError(
+            f"svd: grid QDWH needs stacked shards at least as tall as a "
+            f"column panel: {m}x{n} over the {r}x{c} mesh stacks "
+            f"({mloc} + {nploc}) rows against panel width {nloc}; use a "
+            f"taller matrix or a flatter mesh"
+        )
+    arr = a._zeroed_buffer()
+    jt = dtype.jax_type()
+    if arr.dtype != jt:
+        arr = arr.astype(jt)
+    ov = overlap_enabled(c)
+    fn = _grid_svd_fn(comm, tuple(map(int, arr.shape)), n, str(arr.dtype), ov)
+    if _tel.enabled:
+        model = _costs.qdwh_svd_model(m, n, (r, c), iterations=_QDWH_MAXIT)
+        _tel.account_bytes(
+            "svd2d", "f32", model["exact_wire_bytes"], model["wire_bytes"]
+        )
+        with _tel.span(
+            "comm:svd2d",
+            mesh=f"{r}x{c}",
+            iterations=_QDWH_MAXIT,
+            overlap=ov,
+        ):
+            u_arr, s_arr, v_arr = timed_dispatch("svd2d", ov, lambda: fn(arr))
+    else:
+        u_arr, s_arr, v_arr = timed_dispatch("svd2d", ov, lambda: fn(arr))
+    S = DNDarray(s_arr, (n,), dtype, None, device, comm, True)
+    if not compute_uv:
+        return S
+    U = DNDarray(u_arr, (m, n), dtype, (0, 1), device, comm, True)
+    V = DNDarray(v_arr, (n, n), dtype, None, device, comm, True)
+    return SVD(U, S, V)
+
+
+def _qdwh_svd_reference(arr, mesh_shape):
+    """Replicated golden twin of the grid QDWH SVD: simulates the mesh's
+    blocks in lockstep — the while_loop (same carry, same tolerances,
+    same coefficient math, so the trip decisions agree bitwise), the
+    stacked CAQR via :func:`heat_tpu.core.linalg.qr._caqr_sim`, the
+    panel-ordered combine with explicit zero-block additions mirroring
+    the masked psums, and the eigh epilogue.  One jitted program (eager
+    execution changes XLA CPU's dot emission — see ``_mm``).  Returns
+    ``(u_padded, s, v)`` bitwise-equal to the kernel's outputs.
+
+    The golden replays the SERIAL panel order only: the kernel's overlap
+    arm is pinned bitwise to its serial arm (asserted directly in
+    tests/bench), so one canonical golden covers both.  Simulating the
+    reordered overlap schedule inside this much larger program trips
+    XLA CPU's fusion-context sensitivity in ops beyond the barriered
+    matmuls/reductions — the two sim arms match bitwise in a minimal
+    program but not embedded here, so we don't embed the second arm."""
+    from .qr import _REFERENCE_CACHE
+
+    r, c = mesh_shape
+    m, n = arr.shape
+    mloc = -(-m // r)
+    nloc = -(-n // c)
+    Mp, Np = r * mloc, c * nloc
+    nploc = -(-Np // r)
+    Npr = r * nploc
+    qnloc, qbounds, qvcs = _grid_panel_schedule(Np, c, 1)
+    l0, ltol, dtol = _qdwh_tols(n, np.dtype(arr.dtype.name))
+
+    def run(x):
+        dt = x.dtype
+        zero = jnp.zeros((), dt)
+        x = jnp.pad(x, ((0, Mp - m), (0, Np - n)))
+        blocks = {
+            (i, j): x[i * mloc : (i + 1) * mloc, j * nloc : (j + 1) * nloc]
+            for i in range(r)
+            for j in range(c)
+        }
+
+        def scalar_reduce(parts):
+            # parts[(i, j)] -> the same gather order as the kernel: down
+            # the mesh rows first, then along the columns
+            col_acc = {}
+            for j in range(c):
+                acc = parts[(0, j)]
+                for b in range(1, r):
+                    acc = acc + parts[(b, j)]
+                col_acc[j] = acc
+            acc = col_acc[0]
+            for b in range(1, c):
+                acc = acc + col_acc[b]
+            return acc
+
+        def bcast_cols(vals_row, owner):
+            acc = vals_row[0] if owner == 0 else jnp.where(False, vals_row[0], zero)
+            for jp in range(1, c):
+                acc = acc + (
+                    vals_row[jp]
+                    if owner == jp
+                    else jnp.where(False, vals_row[jp], zero)
+                )
+            return acc
+
+        alpha = jnp.sqrt(
+            scalar_reduce({k: _sumsq(v) for k, v in blocks.items()})
+        )
+        alpha = jnp.where(alpha > 0, alpha, jnp.ones((), dt))
+        x0 = {k: v / alpha for k, v in blocks.items()}
+        eye = {
+            (i, j): (
+                (i * nploc + jnp.arange(nploc)[:, None])
+                == (j * nloc + jnp.arange(nloc)[None, :])
+            ).astype(dt)
+            for i in range(r)
+            for j in range(c)
+        }
+
+        def cond(carry):
+            _x, l, k, delta = carry
+            return (k < _QDWH_MAXIT) & (
+                (delta > dtol) | (jnp.abs(1.0 - l) > ltol)
+            )
+
+        def body(carry):
+            xb, l, k, _delta = carry
+            ca, cb, cc, ln = _qdwh_coeffs(l)
+            sc = jnp.sqrt(cc).astype(dt)
+            stacked = {
+                k2: jnp.concatenate([sc * xb[k2], eye[k2]], axis=0)
+                for k2 in xb
+            }
+            qb, _rb = _caqr_sim(
+                stacked,
+                r=r,
+                c=c,
+                nloc=qnloc,
+                bounds=qbounds,
+                vcs=qvcs,
+                overlapped=False,
+            )
+            q2f = {
+                j: jnp.concatenate(
+                    [qb[(b, j)][mloc:] for b in range(r)], axis=0
+                )
+                for j in range(c)
+            }
+            ca = ca.astype(dt)
+            cb = cb.astype(dt)
+            cc = cc.astype(dt)
+            x_new = {}
+            for i in range(r):
+                acc = jnp.zeros((mloc, Npr), dt)
+                for t in range(c):
+                    q1_pan = bcast_cols(
+                        [qb[(i, jp)][:mloc] for jp in range(c)], t
+                    )
+                    q2f_pan = bcast_cols([q2f[jp] for jp in range(c)], t)
+                    acc = acc + _mm(q1_pan, q2f_pan.T)
+                for j in range(c):
+                    m_loc = jax.lax.dynamic_slice_in_dim(
+                        acc, j * nloc, nloc, 1
+                    )
+                    x_new[(i, j)] = (cb / cc) * xb[(i, j)] + (
+                        (ca - cb / cc) / sc
+                    ) * m_loc
+            delta = jnp.sqrt(
+                scalar_reduce({k2: _sumsq(x_new[k2] - xb[k2]) for k2 in xb})
+            )
+            return x_new, ln.astype(l.dtype), k + 1, delta
+
+        init = (
+            x0,
+            jnp.asarray(l0, dt),
+            jnp.zeros((), jnp.int32),
+            jnp.asarray(jnp.inf, dt),
+        )
+        up, _l, _k, _delta = jax.lax.while_loop(cond, body, init)
+
+        a_full = {
+            i: jnp.concatenate([blocks[(i, j)] for j in range(c)], axis=1)
+            for i in range(r)
+        }
+        h_rows = {}
+        for j in range(c):
+            acc = _mm(up[(0, j)].T, a_full[0])
+            for b in range(1, r):
+                acc = acc + _mm(up[(b, j)].T, a_full[b])
+            h_rows[j] = acc
+        h_full = jnp.concatenate([h_rows[j] for j in range(c)], axis=0)
+        h = h_full[:n, :n]
+        hs = 0.5 * (h + h.T)
+        evals, evecs = jnp.linalg.eigh(hs)
+        s = evals[::-1]
+        v = evecs[:, ::-1]
+        vp = jnp.zeros((Np, Np), dt).at[:n, :n].set(v)
+        u_rows = []
+        for i in range(r):
+            parts = [
+                _mm(
+                    up[(i, j)],
+                    jax.lax.dynamic_slice_in_dim(vp, j * nloc, nloc, 0),
+                )
+                for j in range(c)
+            ]
+            acc = parts[0]
+            for b in range(1, c):
+                acc = acc + parts[b]
+            u_rows.append(acc)
+        u = jnp.concatenate(u_rows, axis=0)  # (Mp, Np)
+        return u, s, v
+
+    key = ("qdwh", mesh_shape, (m, n), str(arr.dtype))
+    fn = _REFERENCE_CACHE.get(key)
+    if fn is None:
+        fn = _REFERENCE_CACHE[key] = _jax.jit(run)
+    return fn(arr)
+
+
 from .._split_semantics import split_semantics as _split_semantics
 
 
@@ -166,6 +596,22 @@ def svd(a: DNDarray, full_matrices: bool = False, compute_uv: bool = True):
 
     dtype = a.dtype if types.heat_type_is_inexact(a.dtype) else types.float32
     m, n = a.shape
+
+    comm = a.comm
+    if comm.mesh_ndim == 2 and comm.size > 1 and a.splits in ((0, 1), (1, 0)):
+        # grid QDWH polar SVD (arXiv 2112.09017): wide inputs factor the
+        # transpose — its (1, 0) layout is re-committed to (0, 1) by one
+        # planned redistribution — and swap U with V; the generic wide
+        # recursion below cannot do this (a.T's tuple layout would fall
+        # into the 1-D tall chain and gather)
+        if m < n:
+            res = svd(a.T.resplit((0, 1)), compute_uv=compute_uv)
+            if not compute_uv:
+                return res
+            return SVD(res.V, res.S, res.U)
+        if a.splits == (1, 0):
+            a = a.resplit((0, 1))
+        return _grid_svd(a, dtype, compute_uv)
 
     if m < n:
         # wide: factor the transpose, swap U and V

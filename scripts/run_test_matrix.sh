@@ -405,6 +405,26 @@ if ! python scripts/spmdlint.py --baseline -q; then
     echo "FAILED spmdlint baseline with SPMD505 (autoshard hand-layout rule)"
     fail=1
 fi
+# linalg2d lane (docs/design.md §23): pod-scale grid linear algebra —
+# the blocked/CAQR QR and QDWH polar SVD suites with their bitwise
+# replicated-golden twins, serial-vs-overlap arm pinning, one-dispatch
+# and ledger==wire-model gates, the ill-conditioned QDWH sweep, the
+# rank-local SUMMA schedules, the wide-input/shard-geometry guards, and
+# the host-sync-free norm() — at 4 devices (2x2 grid; 2x4 tests
+# self-skip) and 8 (2x2 AND 2x4).  Then the splitflow suites re-run so
+# the entry_qr/entry_svd grid transfer facts hold the registry oracle
+# and a zero-findings tree.
+echo "=== linalg2d lane (grid QR/SVD golden twins, QDWH sweep, rank-local SUMMA) ==="
+for n in 4 8; do
+    if ! HEAT_TEST_DEVICES="$n" python -m pytest tests/test_linalg2d.py -q; then
+        echo "FAILED linalg2d suite at $n devices"
+        fail=1
+    fi
+done
+if ! python -m pytest tests/test_splitflow.py tests/test_splitflow_oracle.py -q; then
+    echo "FAILED splitflow suites with the entry_qr/grid-svd transfer facts"
+    fail=1
+fi
 for n in "${sizes[@]}"; do
     echo "=== mesh size $n ==="
     if ! HEAT_TEST_DEVICES="$n" python -m pytest tests/ -q -x; then

@@ -159,6 +159,10 @@ def _fake_full_result():
         "summa1d_tflops": 37.8,
         "matmul_replicated_tflops": 44.1,
         "summa2d_vs_replicated": 0.934,
+        "qr2d_tflops": 18.4,
+        "qr1d_tflops": 15.2,
+        "qr2d_vs_1d": 1.21,
+        "svd2d_tflops": 22.7,
         "kmedians_iter_per_sec": 1063.5,
         "kmedians_churn_iter_per_sec": 143.21,
         "kmedoids_iter_per_sec": 10466.7,

@@ -220,6 +220,117 @@ def test_matmul_precision_and_out_forwarding_on_grid():
 
 
 # --------------------------------------------------------------------- #
+# rank-local SUMMA schedules: (0,None)x(None,1) and (None,1)x(0,None)    #
+# --------------------------------------------------------------------- #
+RANK_LOCAL_LAYOUTS = [
+    ("rowcol", (0, None), (None, 1)),
+    ("colrow", (None, 1), (0, None)),
+]
+
+
+@pytest.mark.parametrize("mesh_shape", MESHES)
+@pytest.mark.parametrize("layout,sa,sb", RANK_LOCAL_LAYOUTS)
+@pytest.mark.parametrize("m,k,n", [(8, 16, 8), (7, 13, 9)])
+def test_grid_summa_rank_local_bitwise_vs_replicated_twin(
+    mesh_shape, layout, sa, sb, m, k, n
+):
+    """The rank-local schedules run the IDENTICAL L-step panel-ordered
+    accumulation as the (0,1)x(0,1) grid schedule, so all three layouts
+    share one bitwise replicated twin — no redistribution to (0,1) ever
+    happens (the result commits straight to (0,1))."""
+    comm = _grid(mesh_shape)
+    a = RNG.normal(size=(m, k)).astype(np.float32)
+    b = RNG.normal(size=(k, n)).astype(np.float32)
+    A = ht.array(a, splits=sa, comm=comm)
+    B = ht.array(b, splits=sb, comm=comm)
+    got = A @ B
+    assert got.splits == (0, 1)
+    assert got.shape == (m, n)
+    np.testing.assert_array_equal(got.numpy(), _replicated_twin(a, b, mesh_shape))
+
+
+@pytest.mark.parametrize("mesh_shape", MESHES)
+@pytest.mark.parametrize("layout,sa,sb", RANK_LOCAL_LAYOUTS)
+def test_grid_summa_rank_local_one_dispatch(mesh_shape, layout, sa, sb):
+    comm = _grid(mesh_shape)
+    L = mesh_shape[0] * mesh_shape[1]
+    a = RNG.normal(size=(4 * mesh_shape[0], 2 * L)).astype(np.float32)
+    b = RNG.normal(size=(2 * L, 4 * mesh_shape[1])).astype(np.float32)
+    A = ht.array(a, splits=sa, comm=comm)
+    B = ht.array(b, splits=sb, comm=comm)
+    jax.block_until_ready((A @ B).larray)  # warm the compile cache
+    with _tracing.counting_dispatches() as d:
+        jax.block_until_ready((A @ B).larray)
+    assert d.count == 1, f"rank-local SUMMA must be ONE dispatch, saw {d.count}"
+
+
+@pytest.mark.parametrize("mesh_shape", MESHES)
+def test_grid_summa_rowcol_wire_strictly_below_redistribute(mesh_shape):
+    """The rank-local (0,None)x(None,1) schedule ships ZERO bytes; the
+    alternative — redistribute both operands to (0,1), then grid SUMMA —
+    pays two planned layout changes plus the full panel-broadcast wire.
+    The modeled gap is the whole point of the layout-freedom work."""
+    m, k, n = 64, 64, 64
+    size = mesh_shape[0] * mesh_shape[1]
+    model = _costs.summa_grid_model(m, k, n, mesh_shape, layout="rowcol")
+    assert model["wire_bytes"] == 0
+    assert model["exact_wire_bytes"] == 0
+    grid = _costs.summa_grid_model(m, k, n, mesh_shape)
+    alt = (
+        grid["wire_bytes"]
+        + rd.plan((m, k), "float32", (0, None), (0, 1), size,
+                  mesh_shape=mesh_shape).wire_bytes
+        + rd.plan((k, n), "float32", (None, 1), (0, 1), size,
+                  mesh_shape=mesh_shape).wire_bytes
+    )
+    assert model["wire_bytes"] < alt
+    assert grid["wire_bytes"] > 0  # the gap is real, not two zeros
+
+
+@pytest.mark.parametrize("mesh_shape", MESHES)
+def test_grid_summa_colrow_wire_parity_with_grid_schedule(mesh_shape):
+    """(None,1)x(0,None) ships exactly the grid schedule's bytes (owners
+    slice their own blocks before the masked psums); the win over
+    redistribute-then-SUMMA is eliding the two planned redistributions."""
+    m, k, n = 64, 64, 64
+    size = mesh_shape[0] * mesh_shape[1]
+    model = _costs.summa_grid_model(m, k, n, mesh_shape, layout="colrow")
+    grid = _costs.summa_grid_model(m, k, n, mesh_shape)
+    assert model["wire_bytes"] == grid["wire_bytes"]
+    assert model["exact_wire_bytes"] == grid["exact_wire_bytes"]
+    # the alternative's redistributions to (0,1) are themselves zero-wire
+    # (sharding a replicated dim is a local slice), so there is no byte
+    # gap — only the two elided dispatches and their committed copies
+    for shape, src in (((m, k), (None, 1)), ((k, n), (0, None))):
+        p = rd.plan(shape, "float32", src, (0, 1), size, mesh_shape=mesh_shape)
+        assert p.wire_bytes == 0
+        assert len(p.steps) >= 1
+
+
+@pytest.mark.parametrize("layout,sa,sb", RANK_LOCAL_LAYOUTS)
+def test_grid_summa_rank_local_telemetry_matches_model(layout, sa, sb):
+    mesh_shape = (2, 2)
+    comm = _grid(mesh_shape)
+    m, k, n = 8, 12, 10
+    a = RNG.normal(size=(m, k)).astype(np.float32)
+    b = RNG.normal(size=(k, n)).astype(np.float32)
+    A = ht.array(a, splits=sa, comm=comm)
+    B = ht.array(b, splits=sb, comm=comm)
+    model = _costs.summa_grid_model(m, k, n, mesh_shape, layout=layout)
+    telemetry.enable()
+    telemetry.reset()
+    try:
+        jax.block_until_ready((A @ B).larray)
+        snap = telemetry.snapshot()
+        assert snap["counters"]["comm.collectives.summa2d"] == 1
+        assert snap["counters"].get("comm.wire_bytes", 0) == model["wire_bytes"]
+        assert snap["counters"].get("comm.exact_bytes", 0) == model["exact_wire_bytes"]
+    finally:
+        telemetry.reset()
+        telemetry.disable()
+
+
+# --------------------------------------------------------------------- #
 # planned 2-D redistribution                                             #
 # --------------------------------------------------------------------- #
 GRID_TRANSITIONS = [

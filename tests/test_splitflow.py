@@ -207,6 +207,53 @@ def test_entry_svd_tall_and_wide():
     assert (u.split, v.split) == (None, 0)
 
 
+def test_entry_svd_grid_layouts():
+    u, s, v = apply_kind("entry_svd", [Spec(split=(0, 1), shape=(64, 8))])[0]
+    assert (u.split, s.split, v.split) == ((0, 1), None, None)
+    # wide grid inputs factor the transpose and swap: V lands on the grid
+    u, s, v = apply_kind("entry_svd", [Spec(split=(1, 0), shape=(8, 64))])[0]
+    assert (u.split, s.split, v.split) == (None, None, (0, 1))
+    # shape unknown: which factor rides the grid is undecidable
+    u, s, v = apply_kind("entry_svd", [Spec(split=(0, 1))])[0]
+    assert u.split is TOP and v.split is TOP and s.split is None
+    # compute_uv=False replicates S regardless of the grid layout
+    out = apply_kind("entry_svd", [Spec(split=(0, 1), shape=(64, 8))],
+                     compute_uv=False)[0]
+    assert out.split is None
+
+
+def test_entry_qr_grid_and_1d():
+    q, r = apply_kind("entry_qr", [Spec(split=(0, 1), shape=(64, 8))])[0]
+    assert (q.split, r.split) == ((0, 1), (None, 1))
+    q, r = apply_kind("entry_qr", [Spec(split=0, shape=(64, 8))])[0]
+    assert (q.split, r.split) == (0, None)
+    q, r = apply_kind("entry_qr", [Spec(split=1, shape=(64, 8))])[0]
+    assert (q.split, r.split) == (1, 1)
+    q, r = apply_kind("entry_qr", [Spec(split=None, shape=(64, 8))])[0]
+    assert (q.split, r.split) == (None, None)
+    # other splits tuples have no declared contract
+    q, r = apply_kind("entry_qr", [Spec(split=(1, 0), shape=(64, 8))])[0]
+    assert q.split is TOP and r.split is TOP
+    # calc_q=False drops Q; R's layout is unchanged
+    q, r = apply_kind("entry_qr", [Spec(split=(0, 1), shape=(64, 8))],
+                      calc_q=False)[0]
+    assert not q.is_array and r.split == (None, 1)
+
+
+def test_matmul_rank_local_grid_layouts():
+    row = Spec(split=(0, None), shape=(64, 32))
+    col = Spec(split=(None, 1), shape=(32, 16))
+    out, facts = apply_kind("matmul", [row, col])
+    assert out.split == (0, 1) and facts == []
+    out, facts = apply_kind(
+        "matmul", [Spec(split=(None, 1), shape=(64, 32)),
+                   Spec(split=(0, None), shape=(32, 16))])
+    assert out.split == (0, 1) and facts == []
+    # unrecognized tuple pairings stay unknown
+    out, _ = apply_kind("matmul", [row, Spec(split=(0, 1), shape=(32, 16))])
+    assert out.split is TOP
+
+
 def test_unknown_operands_stay_unknown():
     out, facts = apply_kind("binary", [UNKNOWN, Spec(split=1)])
     assert out.split is TOP and facts == []
@@ -225,6 +272,7 @@ def test_package_registry_parses_without_importing_heat_tpu():
     assert reg["resplit"].kind == "resplit"
     assert reg["ones"].kind == "factory"
     assert reg["svd"].kind == "entry_svd"
+    assert reg["qr"].kind == "entry_qr"
 
 
 def test_parse_declarations_all_three_forms():
@@ -378,6 +426,20 @@ def f():
     assert env["u"].split == 0
     assert env["s"].split is None
     assert env["v"].split is None
+
+
+def test_tuple_unpacking_of_qr():
+    prog = program_of("""
+import heat_tpu as ht
+
+def f():
+    a = ht.ones((64, 8), split=0)
+    q, r = ht.linalg.qr(a)
+    return q, r
+""")
+    env = env_of(prog, "f")
+    assert env["q"].split == 0
+    assert env["r"].split is None
 
 
 def test_recursion_terminates_at_unknown():

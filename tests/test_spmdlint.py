@@ -354,6 +354,26 @@ def f(x, steps):
     assert lint(src, "SPMD202") == []
 
 
+def test_spmd202_triggers_on_old_norm_coercion_shape():
+    """Regression fixture for the pre-r16 ``linalg.norm``: it reduced the
+    local buffer on device and then coerced the traced result through
+    ``float(jnp.sqrt(...))`` — a host sync per call, and wrong under any
+    split (it ignored the other shards).  The rewrite keeps the whole
+    reduction inside one jitted program and returns a 0-d DNDarray;
+    this fixture pins the old shape as a permanent SPMD202 finding."""
+    src = """
+import jax.numpy as jnp
+from heat_tpu.core.fuse import fuse
+
+@fuse
+def norm(a):
+    return float(jnp.sqrt(jnp.sum(a.larray * a.larray)))
+"""
+    findings = lint(src, "SPMD202")
+    assert findings, "float(sqrt(traced)) under @fuse must fire SPMD202"
+    assert "float()" in findings[0].message
+
+
 def test_spmd202_recognizes_ht_fuse_decorator():
     src = """
 import heat_tpu as ht
