@@ -56,6 +56,12 @@ from . import graph
 from . import naive_bayes
 from . import regression
 from . import resilience
+
+# ht.io is the io PACKAGE (flat loaders re-exported + the streaming path).
+# `from .core import *` above bound the name to the flat core.io module, so
+# a `from . import io` would be a no-op (the attribute already exists);
+# the absolute import forces the submodule load, which rebinds `io` here.
+import heat_tpu.io  # noqa: F401
 from . import spatial
 from . import telemetry
 from . import obs

@@ -1583,6 +1583,106 @@ def check_unbounded_retry(ctx: FileContext) -> Iterable[Finding]:
 
 
 # --------------------------------------------------------------------- #
+# SPMD212: blocking host read inside a compiled-program loop             #
+# --------------------------------------------------------------------- #
+#: dotted names whose call opens an on-disk dataset handle — re-opening
+#: (and reading) one of these per loop iteration serializes the loop on
+#: host storage latency
+_HOST_READ_OPENERS = frozenset({
+    "h5py.File",
+    "netCDF4.Dataset",
+    "scipy.io.netcdf_file",
+})
+
+
+def _file_handle_expr(ctx: FileContext, expr, at, depth: int = 0) -> bool:
+    """True when ``expr`` evaluates to (a view of) an on-disk dataset
+    handle: a direct opener call, a name once-bound to one, a subscript
+    chain off one (``f[name][lo:hi]``), or its ``.variables`` mapping."""
+    if depth > 8:
+        return False
+    if isinstance(expr, ast.Attribute) and expr.attr == "variables":
+        return _file_handle_expr(ctx, expr.value, at, depth + 1)
+    if isinstance(expr, ast.Subscript):
+        return _file_handle_expr(ctx, expr.value, at, depth + 1)
+    if isinstance(expr, ast.Call):
+        return (ctx.resolve(expr.func) or "") in _HOST_READ_OPENERS
+    if isinstance(expr, ast.Name):
+        rec = ctx.lookup(expr.id, at)
+        if rec is not None and rec[0] == "expr":
+            return _file_handle_expr(ctx, rec[1], at, depth + 1)
+    return False
+
+
+def _blocking_host_read(ctx: FileContext, call: ast.Call) -> Optional[str]:
+    """Why ``call`` is a blocking on-disk read, or None if it isn't."""
+    dotted = ctx.resolve(call.func) or ""
+    if dotted in _HOST_READ_OPENERS:
+        return f"`{dotted}` re-opens the file every iteration"
+    leaf = dotted.rsplit(".", 1)[-1]
+    if (
+        leaf in ("asarray", "array")
+        and call.args
+        and _file_handle_expr(ctx, call.args[0], call)
+    ):
+        return (
+            f"`{leaf}` of a file-handle slice materializes the slab "
+            "synchronously on the host"
+        )
+    if (
+        isinstance(call.func, ast.Attribute)
+        and call.func.attr == "read_direct"
+        and _file_handle_expr(ctx, call.func.value, call)
+    ):
+        return "`read_direct` on an open dataset handle blocks on storage"
+    return None
+
+
+@rule("SPMD212", "blocking host read inside a loop that dispatches compiled programs")
+def check_blocking_read_in_compiled_loop(ctx: FileContext) -> Iterable[Finding]:
+    """A loop body that both reads from an on-disk dataset (h5py/netCDF4
+    handle access, ``np.asarray`` over a file-handle slice) and dispatches
+    a compiled program serializes the device behind host storage: every
+    iteration the accelerator sits idle for the full read+copy latency
+    before its next dispatch, the exact ``h·(read+copy+compute)`` serial
+    schedule ``comm._costs.stream_model`` prices.  The streaming path
+    reads chunk ``t+1`` on a worker thread while chunk ``t`` computes —
+    ``read + h·max(read+copy, compute)`` — and its generator keeps the
+    read out of the dispatching loop's body by construction.  Reads in
+    traced contexts are exempt (they are staging-time constants, not
+    per-dispatch io)."""
+    for loop in ast.walk(ctx.tree):
+        if not isinstance(loop, (ast.For, ast.AsyncFor, ast.While)):
+            continue
+        compiled = None
+        read = None
+        why = None
+        for stmt in loop.body:
+            for sub in ast.walk(stmt):
+                if not isinstance(sub, ast.Call) or ctx.in_traced_context(sub):
+                    continue
+                if compiled is None and _is_compiled_callable(ctx, sub.func, sub):
+                    compiled = sub
+                if read is None:
+                    why = _blocking_host_read(ctx, sub)
+                    if why is not None:
+                        read = sub
+        if compiled is not None and read is not None:
+            yield ctx.finding(
+                "SPMD212", read,
+                "blocking host read in a loop body that also dispatches a "
+                f"compiled program — {why}, so the device idles behind "
+                "storage every iteration",
+                hint="stream the dataset through "
+                "`heat_tpu.io.stream.stream_chunks` (double-buffered "
+                "host→device prefetch overlaps the next read with this "
+                "chunk's compute), or hoist the read out of the loop; mark "
+                "with `# spmdlint: disable=SPMD212` if the serialization "
+                "is deliberate",
+            )
+
+
+# --------------------------------------------------------------------- #
 # SPMD301/302: Pallas tiling and grids                                   #
 # --------------------------------------------------------------------- #
 @rule("SPMD301", "Pallas BlockSpec tiles must respect the hardware tile grid")

@@ -18,6 +18,7 @@ SPMD208    unbucketed dynamic batch shape entering a compiled program in a loop
 SPMD209    serialized ring body: ppermute result consumed in the same round
 SPMD210    request-scoped observability inside traced functions
 SPMD211    retry loop without a deadline around a compiled/guarded call
+SPMD212    blocking host read inside a loop that dispatches compiled programs
 SPMD301    Pallas BlockSpec tiles must respect the hardware tile grid
 SPMD302    pallas_call grids must be static (no traced values)
 SPMD401    jitted() cache keys: hashable, identity-stable parts only

@@ -47,6 +47,20 @@ class KMeans(_KCluster):
         quantized-ring form snapshots the error-feedback residual too.
     checkpoint_path : str or None — HDF5 snapshot target (atomic writes;
         required when ``checkpoint_every > 0``).
+    mini_batch : int or None — rows per chunk for the out-of-core
+        streaming fit (docs/design.md §24).  When set (or when ``fit``
+        receives a :class:`heat_tpu.io.stream.StreamSource`), the fit
+        runs mini-batch incremental-center updates over
+        :func:`heat_tpu.io.stream.stream_chunks`: each chunk is one
+        segment of ONE compiled program with the stream position in the
+        explicit carry, ``max_iter`` counts epochs, and ``tol`` early
+        exit is disabled (a fixed schedule is what keeps resumed and
+        elastic replays bitwise-identical).  The centers after chunk
+        ``t`` move by the running-mean rule
+        ``c += (batch_sum − batch_count·c) / total_count`` (the
+        sklearn/Sculley mini-batch update), so a fit over an
+        :class:`~heat_tpu.io.stream.ArraySource` of in-memory rows is
+        the bitwise twin of the same fit streamed from disk.
     """
 
     _init_plus_plus_alias = "kmeans++"
@@ -60,6 +74,7 @@ class KMeans(_KCluster):
         random_state: Optional[int] = None,
         checkpoint_every: int = 0,
         checkpoint_path: Optional[str] = None,
+        mini_batch: Optional[int] = None,
     ):
         super().__init__(
             metric=_quadratic_cdist,  # module-level: fused-assign cache hit
@@ -71,6 +86,9 @@ class KMeans(_KCluster):
             checkpoint_every=checkpoint_every,
             checkpoint_path=checkpoint_path,
         )
+        if mini_batch is not None and int(mini_batch) < 1:
+            raise ValueError(f"mini_batch must be >= 1, got {mini_batch}")
+        self.mini_batch = None if mini_batch is None else int(mini_batch)
 
     @staticmethod
     @jax.jit
@@ -124,7 +142,7 @@ class KMeans(_KCluster):
         inertia = jnp.sum((arr - centers[labels]) ** 2)
         return labels, inertia
 
-    def fit(self, x: DNDarray, resume=False) -> "KMeans":
+    def fit(self, x: DNDarray, resume=False, comm=None, device=None) -> "KMeans":
         """Lloyd iterations until centroid shift ≤ tol (reference
         kmeans.py:87-120), as a single on-device loop.
 
@@ -136,7 +154,17 @@ class KMeans(_KCluster):
         snapshot taken at a different mesh size, migrating the stacked
         error-feedback residual to the current mesh (device loss: shrink
         the mesh, rebuild the inputs, resume).
+
+        With ``mini_batch=`` set — or ``x`` a
+        :class:`heat_tpu.io.stream.StreamSource` — the fit streams chunks
+        out-of-core instead (same resume/elastic contract, ``max_iter``
+        epochs over a fixed chunk schedule); ``comm``/``device`` pick the
+        mesh for stream inputs (a DNDarray input supplies its own).
         """
+        from ..io import stream as _stream
+
+        if isinstance(x, _stream.StreamSource) or self.mini_batch is not None:
+            return self._fit_minibatch(x, resume, comm=comm, device=device)
         sanitize_in(x)
         if x.ndim != 2:
             raise ValueError(f"input needs to be 2D, but was {x.ndim}D")
@@ -224,6 +252,161 @@ class KMeans(_KCluster):
         # device scalar; inertia_ property syncs lazily on access
         self._inertia = inertia
         return self
+
+    def _fit_minibatch(self, x, resume=False, comm=None, device=None) -> "KMeans":
+        """Out-of-core mini-batch fit: ``max_iter`` epochs of incremental
+        center updates over :func:`heat_tpu.io.stream.stream_chunks`,
+        each chunk ONE dispatch of one compiled segment program with the
+        stream position in the explicit ``(it, centers, counts)`` carry
+        (``it // h`` is the epoch, ``it % h`` the chunk — see
+        :func:`heat_tpu.resilience.resume.stream_position`).
+
+        The segment replicates the (small) chunk and computes on the
+        mesh-independent ``(mb, f)`` slice, so the center trajectory is a
+        pure function of the byte stream — the same snapshot resumes on a
+        grown or shrunk mesh (``resume="elastic"``) bitwise-identical to
+        an uninterrupted fit, and an :class:`ArraySource` twin of on-disk
+        data reproduces the streamed fit exactly."""
+        import numpy as np
+
+        from ..core import devices as _devices, types
+        from ..core.communication import comm_for_device, sanitize_comm
+        from ..io import stream as _stream
+        from ..resilience import elastic as _elastic
+
+        src = _stream.as_source(x)
+        if isinstance(x, DNDarray):
+            device = x.device if device is None else device
+            comm = x.comm if comm is None else comm
+        device = _devices.sanitize_device(device)
+        comm = comm_for_device(device.platform) if comm is None else sanitize_comm(comm)
+        if len(src.shape) != 2:
+            raise ValueError(f"input needs to be 2D, but was {len(src.shape)}D")
+        if self.mini_batch is None:
+            raise ValueError(
+                "streaming fit requires KMeans(mini_batch=<rows per chunk>)"
+            )
+        n, f = src.shape
+        k = self.n_clusters
+        mb = self.mini_batch
+        h = max(1, -(-n // mb))
+        total = int(self.max_iter) * h
+
+        meta = {"n": n, "f": f, "k": k, "mb": mb, "max_iter": int(self.max_iter)}
+        splits = {"it": None, "centers": None, "counts": None}
+        ckpt = self._checkpointer("kmeans-mb", meta, comm=comm, splits=splits)
+
+        if resume:
+            state, _ = ckpt.load(elastic=resume == "elastic")
+            carry = (
+                jnp.int32(state["it"]),
+                jnp.asarray(state["centers"], jnp.float32),
+                jnp.asarray(state["counts"], jnp.float32),
+            )
+        else:
+            centers0 = self._init_minibatch_centers(src, n, f, k, mb)
+            carry = (jnp.int32(0), jnp.asarray(centers0, jnp.float32),
+                     jnp.zeros((k, 1), jnp.float32))
+
+        fn = _kmeans_mb_segment(comm, mb, f, k)
+        while True:
+            it0 = int(carry[0])
+            stop = ckpt.stop(it0, total)
+            with _elastic.dispatch_guard("kmeans.mb", comm):
+                for arrs, nv in _stream.stream_chunks(
+                    src, mb, it0, stop, comm=comm, device=device
+                ):
+                    carry = fn(arrs[0], jnp.int32(nv), *carry)
+            it = int(carry[0])
+            if it >= total or it < stop:
+                break
+            ckpt.tick(it, {"it": carry[0], "centers": carry[1], "counts": carry[2]})
+
+        centers = carry[1]
+        self._n_iter = carry[0]
+        self._cluster_centers = DNDarray(
+            comm.apply_sharding(centers.astype(types.float32.jax_type()), None),
+            (k, f), types.float32, None, device, comm, True,
+        )
+        # labels_/inertia_ stay None: the dataset never materializes in
+        # memory, so the assignment pass is the caller's predict() choice
+        self._labels = None
+        self._inertia = None
+        return self
+
+    def _init_minibatch_centers(self, src, n, f, k, mb):
+        """Initial centers for a streaming fit: a DNDarray of centroids
+        passes through; ``"random"`` draws k distinct rows of the FIRST
+        chunk with a host-side seeded rng — deterministic given
+        ``random_state``, independent of mesh size (the device rng is
+        comm-coupled), and readable without touching the rest of the
+        stream."""
+        import numpy as np
+
+        if isinstance(self.init, DNDarray):
+            if tuple(self.init.shape) != (k, f):
+                raise ValueError(
+                    "passed centroids do not match cluster count or data shape"
+                )
+            return np.asarray(self.init.resplit(None).larray, dtype=np.float32)
+        if self.init == "random":
+            nv0 = min(mb, n)
+            if k > nv0:
+                raise ValueError(
+                    f"n_clusters={k} exceeds the first chunk's {nv0} rows; "
+                    "raise mini_batch or pass explicit centroids"
+                )
+            rng = np.random.default_rng(
+                0 if self.random_state is None else int(self.random_state)
+            )
+            idx = np.sort(rng.choice(nv0, size=k, replace=False))
+            block = np.asarray(src.read(0, nv0), dtype=np.float32)
+            return block[idx]
+        raise ValueError(
+            "mini-batch/streaming fits support init='random' or an explicit "
+            f"DNDarray of centroids, got {self.init!r}"
+        )
+
+
+def _kmeans_mb_segment(comm, mb, f, k):
+    """ONE compiled chunk-update program for the mini-batch fit:
+    ``(chunk, nvalid, it, centers, counts) -> (it+1, centers', counts')``.
+
+    The chunk arrives row-sharded and zero-padded to ``ceil(mb/p)·p``
+    rows; the program replicates it and computes on the static ``[:mb]``
+    slice — a mesh-INDEPENDENT shape, so the center trajectory is
+    bitwise-identical across mesh sizes (the elastic resume gate) at the
+    cost of one small allgather per chunk.  Pad rows and the ragged final
+    chunk are masked by the ``arange(mb) < nvalid`` valid-count row mask
+    (the PR 4 pad discipline): a padded row contributes zero to every
+    batch sum and count.  Keyed on ``(comm, mb, f, k)`` — one compile for
+    the whole stream, every chunk one dispatch of this program."""
+    from ..core._compile import jitted
+
+    rep = comm.sharding(2, None)
+
+    def make():
+        def seg(chunk, nvalid, it, centers, counts):
+            x = jax.lax.with_sharding_constraint(chunk, rep)[:mb]
+            w = (jnp.arange(mb) < nvalid).astype(x.dtype)
+            c2 = jnp.sum(centers * centers, axis=1)[None, :]
+            labels = jnp.argmin(c2 - 2.0 * jnp.matmul(x, centers.T), axis=1)
+            sel = jax.nn.one_hot(labels, k, dtype=x.dtype) * w[:, None]
+            bsums = jnp.matmul(sel.T, x)  # (k, f) masked batch sum
+            bcounts = jnp.sum(sel, axis=0)[:, None]  # (k, 1)
+            counts2 = counts + bcounts
+            # running-mean pull toward the batch mean, weighted by each
+            # center's LIFETIME count: c += (bsum − bcount·c) / total
+            nc = jnp.where(
+                bcounts > 0.0,
+                centers + (bsums - bcounts * centers) / jnp.maximum(counts2, 1.0),
+                centers,
+            )
+            return it + 1, nc, counts2
+
+        return seg
+
+    return jitted(("kmeans.mb_seg", comm, mb, f, k), make)
 
 
 def _kmeans_segment_q(arr, tol, stop, carry, *, comm, mode):

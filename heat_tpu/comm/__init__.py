@@ -23,6 +23,7 @@ stay bitwise-identical to the serial bodies.
 """
 
 from . import compressed, redistribute
+from ._costs import stream_model
 from .overlap import (
     get_overlap,
     overlap,
@@ -87,4 +88,5 @@ __all__ = [
     "set_overlap",
     "set_redistribution",
     "set_redistribution_threshold",
+    "stream_model",
 ]

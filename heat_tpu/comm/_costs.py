@@ -39,6 +39,8 @@ from typing import Callable, Optional, Tuple
 
 __all__ = [
     "BLOCK",
+    "DEFAULT_H2D_GBPS",
+    "DEFAULT_HOST_READ_GBPS",
     "DEFAULT_ICI_GBPS",
     "LayoutSolver",
     "critical_path_ms",
@@ -53,6 +55,7 @@ __all__ = [
     "qdwh_svd_model",
     "resolve_mode",
     "ring_wire_model",
+    "stream_model",
     "summa_grid_model",
 ]
 
@@ -106,6 +109,66 @@ def critical_path_ms(
     if not overlap:
         return h * (step_wire + float(compute_ms_per_step))
     return step_wire + h * max(step_wire, float(compute_ms_per_step))
+
+
+#: Nominal sustained host storage read bandwidth (GB/s) for the
+#: streaming-ingest model when no measured figure is supplied — local
+#: NVMe territory; like :data:`DEFAULT_ICI_GBPS`, a planning constant the
+#: bench always pairs with a same-run measured twin.
+DEFAULT_HOST_READ_GBPS = 2.0
+
+#: Nominal host→device copy bandwidth (GB/s, one direction) — a PCIe-class
+#: placeholder for the ``device_put`` leg of the streaming pipeline.
+DEFAULT_H2D_GBPS = 8.0
+
+
+def stream_model(
+    chunk_bytes: int,
+    chunks: int,
+    compute_ms_per_chunk: float = 0.0,
+    *,
+    read_gbps: float = DEFAULT_HOST_READ_GBPS,
+    h2d_gbps: float = DEFAULT_H2D_GBPS,
+    prefetch: bool = True,
+) -> dict:
+    """Modeled time of an out-of-core streaming fit: ``chunks`` slabs of
+    ``chunk_bytes`` each read from storage, copied host→device, and
+    consumed by one compiled segment of ``compute_ms_per_chunk``.
+
+    The two schedules are :func:`critical_path_ms`'s pair transplanted to
+    the io boundary (docs/design.md §24): serial is
+    ``h·(read + copy + compute)``; the double-buffered schedule hides the
+    ingest stage behind compute after one warm-up slab —
+    ``(read + copy) + h·max(read + copy, compute)``.  ``peak_host_slabs``
+    is the schedule's host-memory bound (two live slabs overlapped, one
+    serial), which :func:`heat_tpu.io.stream.slab_peak` is asserted
+    against.  ``bound`` names the roofline side the overlapped schedule
+    sits on: ``"ingest"`` when the stream cannot feed the device fast
+    enough (read+copy > compute), else ``"compute"``.
+    """
+    h = max(int(chunks), 1)
+    cb = int(chunk_bytes)
+    read_ms = cb / (float(read_gbps) * 1e6)
+    h2d_ms = cb / (float(h2d_gbps) * 1e6)
+    stage_ms = read_ms + h2d_ms
+    compute_ms = float(compute_ms_per_chunk)
+    serial_ms = h * (stage_ms + compute_ms)
+    overlapped_ms = stage_ms + h * max(stage_ms, compute_ms)
+    best_ms = overlapped_ms if prefetch else serial_ms
+    return {
+        "chunks": h,
+        "chunk_bytes": cb,
+        "read_ms_per_chunk": read_ms,
+        "h2d_ms_per_chunk": h2d_ms,
+        "compute_ms_per_chunk": compute_ms,
+        "serial_ms": serial_ms,
+        "overlapped_ms": overlapped_ms,
+        "speedup": serial_ms / overlapped_ms if overlapped_ms > 0.0 else 1.0,
+        "prefetch": bool(prefetch),
+        "peak_host_slabs": 2 if prefetch else 1,
+        "bound": "ingest" if stage_ms >= compute_ms else "compute",
+        "modeled_ms": best_ms,
+    }
 
 
 def itemsize(dtype_name: str) -> int:

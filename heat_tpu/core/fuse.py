@@ -228,7 +228,9 @@ class _FusedFunction:
         key = None
         if self._stable and self._cacheable_statics(leaves):
             # context_token(): process-wide state (collective-compression
-            # policy) that changes what the traced program computes —
+            # policy, comm overlap, io prefetch — every provider behind
+            # _compile.register_key_context) that changes what the traced
+            # program computes or how its dispatches are attributed —
             # fused programs re-trace under a new policy, never replay
             key = (self._fn, self._donate, self._plan_token, treedef,
                    tuple(keyparts), comm, _compile.context_token())

@@ -101,6 +101,23 @@ def _retry_open(fn, site: str):
     return _r.call(fn, policy=_r.IO_POLICY, site=site)
 
 
+def _named_member(path: str, mapping, name: str, kind: str):
+    """Look up ``name`` in a file's member ``mapping`` (h5py File, NetCDF
+    ``.variables``), naming BOTH the file and the missing member on
+    failure — a bare ``KeyError: 'x'`` from a 40-file ingest loop says
+    nothing about which file lacked which dataset."""
+    try:
+        return mapping[name]
+    except KeyError:
+        try:
+            available = ", ".join(sorted(map(str, mapping.keys()))) or "<none>"
+        except Exception:  # noqa: BLE001 — the lookup error is the story
+            available = "<unknown>"
+        raise ValueError(
+            f"{path}: no {kind} named {name!r} (available: {available})"
+        ) from None
+
+
 # --------------------------------------------------------------------- #
 # atomic writes                                                          #
 # --------------------------------------------------------------------- #
@@ -140,15 +157,37 @@ def _sharded_from_reader(shape, np_dtype, split, device, comm, read_slices):
     comm = comm_for_device(device.platform) if comm is None else sanitize_comm(comm)
     split = sanitize_axis(shape, split)
     hdtype = types.canonical_heat_type(np_dtype)
+    # io:read brackets the slab reads, io:h2d the device commit, and both
+    # credit account_bytes("io", ...) — the streaming/bench bandwidth
+    # headlines reconcile against this ledger like every comm headline
+    total_bytes = int(np.prod(shape, dtype=np.int64)) * np.dtype(hdtype._np_type).itemsize
     if split is not None and shape[split] % comm.size == 0 and comm.size > 1:
         sharding = comm.sharding(len(shape), split)
 
         def _cb(index):
+            if _tel.enabled:
+                with _tel.span("io:read", sharded=True):
+                    block = np.asarray(read_slices(index))
+                _tel.account_bytes("io", "read", block.nbytes, block.nbytes)
+                return block
             return read_slices(index)
 
-        garr = jax.make_array_from_callback(tuple(shape), sharding, _cb)
+        if _tel.enabled:
+            with _tel.span("io:h2d", bytes=total_bytes):
+                garr = jax.make_array_from_callback(tuple(shape), sharding, _cb)
+            _tel.account_bytes("io", "h2d", total_bytes, total_bytes)
+        else:
+            garr = jax.make_array_from_callback(tuple(shape), sharding, _cb)
     else:
-        garr = jnp.asarray(read_slices(tuple(slice(None) for _ in shape)))
+        if _tel.enabled:
+            with _tel.span("io:read", sharded=False):
+                block = np.asarray(read_slices(tuple(slice(None) for _ in shape)))
+            _tel.account_bytes("io", "read", block.nbytes, block.nbytes)
+            with _tel.span("io:h2d", bytes=total_bytes):
+                garr = jnp.asarray(block)
+            _tel.account_bytes("io", "h2d", total_bytes, total_bytes)
+        else:
+            garr = jnp.asarray(read_slices(tuple(slice(None) for _ in shape)))
         garr = comm.apply_sharding(garr, split)
     return DNDarray(garr, tuple(shape), hdtype, split, device, comm, True)
 
@@ -174,7 +213,7 @@ def load_hdf5(
     def _probe():
         _faults().io_open(path)
         with h5py.File(path, "r") as handle:
-            return tuple(handle[dataset].shape)
+            return tuple(_named_member(path, handle, dataset, "dataset").shape)
 
     gshape = _retry_open(_probe, "io.load_hdf5")
 
@@ -396,7 +435,9 @@ def load_netcdf(
         def _probe():
             _faults().io_open(path)
             with nc.Dataset(path, "r") as handle:
-                return tuple(handle.variables[variable].shape)
+                return tuple(
+                    _named_member(path, handle.variables, variable, "variable").shape
+                )
 
         def read_slices(index):
             with nc.Dataset(path, "r") as f:
@@ -406,7 +447,9 @@ def load_netcdf(
         def _probe():
             _faults().io_open(path)
             with _scipy_nc(path, "r", mmap=False) as handle:
-                return tuple(handle.variables[variable].shape)
+                return tuple(
+                    _named_member(path, handle.variables, variable, "variable").shape
+                )
 
         def read_slices(index):
             with _scipy_nc(path, "r", mmap=False) as f:

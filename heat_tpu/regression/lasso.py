@@ -67,6 +67,15 @@ class Lasso(RegressionMixin, BaseEstimator):
         residual.
     checkpoint_path : str or None — HDF5 snapshot target (atomic writes;
         required when ``checkpoint_every > 0``).
+    mini_batch : int or None — rows per chunk for the out-of-core
+        streaming fit (gd solver only; docs/design.md §24).  When set —
+        or when ``fit`` receives :class:`heat_tpu.io.stream.StreamSource`
+        inputs — the fit runs proximal-gradient chunk sweeps over
+        :func:`heat_tpu.io.stream.stream_chunks`: each chunk is one
+        segment of ONE compiled program with the stream position in the
+        explicit carry, ``max_iter`` counts epochs over a fixed chunk
+        schedule (``tol`` early exit disabled — determinism), and the
+        ISTA step size comes from a power iteration on the first chunk.
     """
 
     def __init__(
@@ -77,9 +86,19 @@ class Lasso(RegressionMixin, BaseEstimator):
         solver: str = "cd",
         checkpoint_every: int = 0,
         checkpoint_path: Optional[str] = None,
+        mini_batch: Optional[int] = None,
     ):
         if solver not in ("cd", "gd"):
             raise ValueError(f"solver must be 'cd' or 'gd', got {solver!r}")
+        if mini_batch is not None:
+            if solver != "gd":
+                raise ValueError(
+                    "mini_batch streaming requires solver='gd' (coordinate "
+                    "descent sweeps every column over all rows at once)"
+                )
+            if int(mini_batch) < 1:
+                raise ValueError(f"mini_batch must be >= 1, got {mini_batch}")
+        self.mini_batch = None if mini_batch is None else int(mini_batch)
         self.__lam = lam
         self.max_iter = max_iter
         self.tol = tol
@@ -134,7 +153,8 @@ class Lasso(RegressionMixin, BaseEstimator):
 
     @_split_semantics("entry_fit")
     def fit(self, x: DNDarray, y: DNDarray,
-            resume: Union[bool, str] = False) -> "Lasso":
+            resume: Union[bool, str] = False,
+            comm=None, device=None) -> "Lasso":
         """Cyclic coordinate descent (reference lasso.py:104-156).
 
         The per-coordinate update loop is expressed as ``lax.fori_loop``
@@ -150,7 +170,20 @@ class Lasso(RegressionMixin, BaseEstimator):
         *different* mesh size — the sharded carry entries migrate to the
         current mesh through the planned-redistribution pipeline (device
         loss: shrink the mesh, rebuild the inputs, resume).
+
+        With ``mini_batch=`` set — or stream-source inputs — the gd fit
+        streams chunks out-of-core instead (same resume/elastic
+        contract); ``comm``/``device`` pick the mesh for stream inputs
+        (DNDarray inputs supply their own).
         """
+        from ..io import stream as _stream
+
+        if (
+            isinstance(x, _stream.StreamSource)
+            or isinstance(y, _stream.StreamSource)
+            or self.mini_batch is not None
+        ):
+            return self._fit_minibatch_gd(x, y, resume, comm=comm, device=device)
         sanitize_in(x)
         sanitize_in(y)
         if x.ndim != 2:
@@ -356,6 +389,94 @@ class Lasso(RegressionMixin, BaseEstimator):
             ckpt.tick(it, {"it": carry[0], "theta": carry[1], "delta": carry[2]})
         return carry[1], carry[0]
 
+    def _fit_minibatch_gd(self, x, y, resume=False, comm=None, device=None) -> "Lasso":
+        """Out-of-core proximal-gradient fit: ``max_iter`` epochs of ISTA
+        chunk sweeps over :func:`heat_tpu.io.stream.stream_chunks`, each
+        chunk ONE dispatch of one compiled segment with the stream
+        position in the explicit ``(it, theta, delta)`` carry.
+
+        The step size is ``1/L`` from a power iteration over the FIRST
+        chunk's design matrix — recomputed deterministically on every
+        (re)entry, so it never needs to live in the snapshot.  The
+        segment replicates the chunk and computes on the mesh-independent
+        ``(mb, m)`` slice with the valid-count mask doubling as the
+        intercept column, so pad rows of X *and* y contribute exactly
+        zero to the gradient and the trajectory is a pure function of the
+        byte stream — the elastic resume gate (4→8, 8→4 bitwise) follows."""
+        if self.mini_batch is None:
+            raise ValueError(
+                "streaming fit requires Lasso(solver='gd', mini_batch=...)"
+            )
+        from ..core import devices as _devices
+        from ..core.communication import comm_for_device, sanitize_comm
+        from ..io import stream as _stream
+        from ..resilience import elastic as _elastic
+
+        for d in (x, y):
+            if isinstance(d, DNDarray):
+                device = d.device if device is None else device
+                comm = d.comm if comm is None else comm
+        device = _devices.sanitize_device(device)
+        comm = comm_for_device(device.platform) if comm is None else sanitize_comm(comm)
+        srcx = _stream.as_source(x)
+        srcy = _stream.as_source(y)
+        if len(srcx.shape) != 2:
+            raise ValueError(f"x needs to be 2D, but was {len(srcx.shape)}D")
+        ynd = len(srcy.shape)
+        if ynd > 2 or (ynd == 2 and srcy.shape[1] != 1):
+            raise ValueError("y needs to be 1D or a single column")
+
+        n, f = srcx.shape
+        m = f + 1
+        mb = self.mini_batch
+        h = max(1, -(-n // mb))
+        total = int(self.max_iter) * h
+
+        nv0 = min(mb, n)
+        x0 = np.asarray(srcx.read(0, nv0), dtype=np.float32)
+        a0 = np.concatenate([np.ones((nv0, 1), np.float32), x0], axis=1)
+        step = jnp.float32(1.0) / Lasso._lipschitz(jnp.asarray(a0))
+        lam = jnp.float32(self.__lam)
+
+        meta = {
+            "n": n, "m": m, "lam": float(self.__lam), "mb": mb,
+            "max_iter": int(self.max_iter),
+        }
+        ckpt = self._checkpointer(
+            "lasso-mb", meta, comm=comm,
+            splits={"it": None, "theta": None, "delta": None},
+        )
+        if resume:
+            state, _ = ckpt.load(elastic=resume == "elastic")
+            carry = (
+                jnp.int32(state["it"]),
+                jnp.asarray(state["theta"], jnp.float32),
+                jnp.asarray(state["delta"], jnp.float32),
+            )
+        else:
+            carry = (jnp.int32(0), jnp.zeros((m,), jnp.float32), jnp.float32(jnp.inf))
+
+        fn = _lasso_mb_segment(comm, mb, f, ynd)
+        while True:
+            it0 = int(carry[0])
+            stop = ckpt.stop(it0, total)
+            with _elastic.dispatch_guard("lasso.mb", comm):
+                for (xc, yc), nv in _stream.stream_chunks(
+                    (srcx, srcy), mb, it0, stop, comm=comm, device=device
+                ):
+                    carry = fn(xc, yc, jnp.int32(nv), lam, step, *carry)
+            it = int(carry[0])
+            if it >= total or it < stop:
+                break
+            ckpt.tick(it, {"it": carry[0], "theta": carry[1], "delta": carry[2]})
+
+        self.n_iter = int(carry[0])
+        self.__theta = factories.array(
+            np.asarray(carry[1]).reshape(-1, 1), dtype=types.float32,
+            device=device, comm=comm,
+        )
+        return self
+
     @staticmethod
     @jax.jit
     def _lipschitz(arr):
@@ -401,6 +522,43 @@ class Lasso(RegressionMixin, BaseEstimator):
             x, n_features=int(self.__theta.shape[0]) - 1, op="Lasso.predict"
         )
         return _fused_lasso_predict(x, self.__theta)
+
+
+def _lasso_mb_segment(comm, mb, f, ynd):
+    """ONE compiled chunk-sweep program for the mini-batch gd fit:
+    ``(xc, yc, nvalid, lam, step, it, theta, delta) ->
+    (it+1, theta', delta')``.
+
+    The chunks arrive row-sharded and zero-padded; the program replicates
+    them and computes on the mesh-independent ``[:mb]`` slice (see
+    :func:`heat_tpu.cluster.kmeans._kmeans_mb_segment` for why that is
+    the elastic-bitwise move).  The ``arange(mb) < nvalid`` row mask IS
+    the design matrix's intercept column: valid rows get the usual
+    leading 1, pad rows are all-zero in A *and* in the padded y, so they
+    contribute exactly zero to ``Aᵀ(Aθ − y)`` — the ragged final chunk
+    needs no special case.  Keyed on ``(comm, mb, f, ynd)``: one compile
+    for the whole stream, one dispatch per chunk."""
+    from ..core._compile import jitted
+
+    rep2 = comm.sharding(2, None)
+    repy = comm.sharding(ynd, None)
+
+    def make():
+        def seg(xc, yc, nvalid, lam, step, it, th, delta):
+            x = jax.lax.with_sharding_constraint(xc, rep2)[:mb]
+            yv = jnp.reshape(
+                jax.lax.with_sharding_constraint(yc, repy)[:mb], (mb,)
+            )
+            w = (jnp.arange(mb) < nvalid).astype(jnp.float32)
+            a = jnp.concatenate([w[:, None], x], axis=1)
+            grad = a.T @ (a @ th - yv) / nvalid.astype(jnp.float32)
+            t2 = th - step * grad
+            new = jnp.concatenate([t2[:1], Lasso.soft_threshold(t2[1:], step * lam)])
+            return it + 1, new, jnp.max(jnp.abs(new - th))
+
+        return seg
+
+    return jitted(("lasso.mb_seg", comm, mb, f, ynd), make)
 
 
 def _gd_segment_q(arr, yv, lam, tol, stop, step, carry, *, comm, mode):

@@ -36,6 +36,7 @@ __all__ = [
     "MeshMismatchError",
     "load_loop_state",
     "save_loop_state",
+    "stream_position",
 ]
 
 _MANIFEST_ATTR = "heat_tpu_loop_state"
@@ -57,6 +58,22 @@ class MeshMismatchError(ValueError):
             f"but this fit runs at mesh size {self.current_mesh}; pass "
             f'resume="elastic" to migrate the carry to the current mesh'
         )
+
+
+def stream_position(it, chunks_per_epoch: int) -> Tuple[int, int]:
+    """Decode a streaming fit's scalar step counter into
+    ``(epoch, chunk)`` — the stream position a snapshot's ``it`` encodes.
+
+    The mini-batch fits (docs/design.md §24) keep ONE monotone step
+    counter in the compiled carry; chunk ``it % h`` of epoch ``it // h``
+    is the next chunk the fit will read, so a resumed fit re-enters the
+    stream mid-epoch at exactly the snapshotted position without any
+    extra snapshot state."""
+    h = int(chunks_per_epoch)
+    if h < 1:
+        raise ValueError(f"chunks_per_epoch must be >= 1, got {h}")
+    step = int(it)
+    return step // h, step % h
 
 
 def save_loop_state(path: str, state: Dict[str, Any], meta: Optional[Dict[str, Any]] = None) -> None:

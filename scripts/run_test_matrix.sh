@@ -425,6 +425,86 @@ if ! python -m pytest tests/test_splitflow.py tests/test_splitflow_oracle.py -q;
     echo "FAILED splitflow suites with the entry_qr/grid-svd transfer facts"
     fail=1
 fi
+# stream lane (docs/design.md §24): out-of-core streaming fits — chunk
+# geometry/ragged tails, prefetch-on==prefetch-off bitwise, mini-batch
+# KMeans/Lasso vs their in-memory twins, the one-dispatch-per-segment
+# and slab-peak-vs-model gates, kill/resume (elastic 4<->8 included) —
+# at 4 and 8 devices.  Then the chaos scenario: a transient OSError on
+# the chunk-read seam mid-stream PLUS a device loss at a segment
+# boundary with an elastic resume, replayed twice — the healed, resumed
+# trajectory (center bytes + incident sites) must be a pure function of
+# HEAT_CHAOS_SEED and bitwise-equal to the uninterrupted twin.
+echo "=== stream lane (seed=${HEAT_CHAOS_SEED:-0}: prefetch twins, ragged tails, mid-stream resume) ==="
+for n in 4 8; do
+    if ! HEAT_TEST_DEVICES="$n" HEAT_CHAOS_SEED="${HEAT_CHAOS_SEED:-0}" \
+            python -m pytest tests/test_stream.py -q; then
+        echo "FAILED stream suite at $n devices"
+        fail=1
+    fi
+done
+if ! HEAT_CHAOS_SEED="${HEAT_CHAOS_SEED:-0}" python - <<'PY'
+import os
+import tempfile
+
+import numpy as np
+
+import heat_tpu as ht
+from heat_tpu.io import stream
+from heat_tpu.resilience import faults, incidents
+from heat_tpu.resilience import retry as retry_mod
+from heat_tpu.resilience.faults import DeviceLossError
+
+seed = int(os.environ.get("HEAT_CHAOS_SEED", "0"))
+rng = np.random.default_rng(seed)
+data = rng.normal(size=(103, 6)).astype(np.float32)
+# the armed schedule is a pure function of the seed: which chunk read
+# takes the transient OSError and which segment boundary loses a device
+mb, h = 16, -(-103 // 16)
+io_nth = 1 + int(rng.integers(h))          # first-epoch chunk read
+kill_nth = 1 + int(rng.integers(2, h - 1))  # checkpointed boundary
+
+
+def scenario():
+    faults.clear()
+    incidents.clear_incident_log()
+    retry_mod.set_sleep(lambda s: None)
+    ck = os.path.join(tempfile.mkdtemp(prefix="heat-stream-lane-"), "km.h5")
+    kw = dict(n_clusters=4, mini_batch=mb, max_iter=3, random_state=1)
+    clean = ht.cluster.KMeans(**kw).fit(stream.ArraySource(data))
+    est = ht.cluster.KMeans(checkpoint_every=1, checkpoint_path=ck, **kw)
+    try:
+        with faults.inject("io_error", site="stream.read", nth=io_nth,
+                           max_faults=1, seed=seed):
+            with faults.inject("device_loss", site="iteration",
+                               nth=kill_nth, seed=seed):
+                est.fit(stream.ArraySource(data))
+        raise AssertionError("armed device loss never fired")
+    except DeviceLossError:
+        pass
+    est2 = ht.cluster.KMeans(checkpoint_every=1, checkpoint_path=ck, **kw)
+    est2.fit(stream.ArraySource(data), resume="elastic")
+    bits = np.ascontiguousarray(
+        np.asarray(est2.cluster_centers_.larray)).tobytes()
+    twin = np.ascontiguousarray(
+        np.asarray(clean.cluster_centers_.larray)).tobytes()
+    assert bits == twin, "resumed stream fit diverged from uninterrupted twin"
+    sites = tuple(getattr(i, "site", "") for i in incidents.incident_log())
+    faults.clear()
+    retry_mod.set_sleep(None)
+    return bits, sites
+
+
+a, b = scenario(), scenario()
+assert a == b, "stream chaos scenario diverged across identical-seed replays"
+assert any("io.stream.read" in s for s in a[1]), a[1]
+print(f"stream chaos scenario (seed={seed}): OSError healed at chunk "
+      f"{io_nth}, device lost at segment {kill_nth}, elastic resume "
+      f"bitwise-equal to twin; incidents={a[1]} replayed bit-for-bit")
+PY
+then
+    echo "FAILED stream chaos scenario (reproduce with HEAT_CHAOS_SEED=${HEAT_CHAOS_SEED:-0})"
+    fail=1
+fi
 for n in "${sizes[@]}"; do
     echo "=== mesh size $n ==="
     if ! HEAT_TEST_DEVICES="$n" python -m pytest tests/ -q -x; then

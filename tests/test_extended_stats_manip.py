@@ -225,10 +225,13 @@ def test_unique_nan_collapse_and_axis1():
 
 def test_unique_device_resident_scale():
     """VERDICT r1 #5: unique stays on device (distributed ring rank sort +
-    explicit prefix sum + count-only host sync) — 1e7 elements on the
-    8-device mesh.  int32 exercises the one-word ring path; 64-bit dtypes
-    go through the two-word path (covered at smaller sizes above)."""
-    big = RNG.integers(0, 100_000, 10_000_000).astype(np.int32)
+    explicit prefix sum + count-only host sync) at scale on the 8-device
+    mesh.  int32 exercises the one-word ring path; 64-bit dtypes go
+    through the two-word path (covered at smaller sizes above).  3e6 is
+    still orders of magnitude past every host-materialization threshold
+    while keeping this inside the tier-1 wall-clock budget (the ring
+    sort is the suite's single most expensive kernel on CPU)."""
+    big = RNG.integers(0, 100_000, 3_000_000).astype(np.int32)
     u = ht.unique(ht.array(big, split=0))
     assert u.shape[0] == len(np.unique(big))
 

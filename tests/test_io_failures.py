@@ -75,6 +75,48 @@ def test_load_missing_dataset(tmp_path, arr):
         ht.load_hdf5(p, "not_there")
 
 
+def test_load_hdf5_missing_dataset_names_file_and_dataset(tmp_path, arr):
+    # regression: the probe used to surface a bare KeyError — in a
+    # many-file ingest loop that says nothing about which file lacked
+    # which dataset
+    p = str(tmp_path / "x.h5")
+    ht.save_hdf5(arr, p, "data")
+    with pytest.raises(ValueError) as ei:
+        ht.load_hdf5(p, "not_there")
+    msg = str(ei.value)
+    assert p in msg and "not_there" in msg and "dataset" in msg
+    assert "data" in msg  # the available members are listed
+
+
+@pytest.mark.skipif(not ht.io.supports_netcdf(), reason="no NetCDF backend")
+def test_load_netcdf_missing_variable_names_file_and_variable(tmp_path, arr):
+    p = str(tmp_path / "x.nc")
+    ht.save_netcdf(arr, p, "data")
+    with pytest.raises(ValueError) as ei:
+        ht.load_netcdf(p, "not_there")
+    msg = str(ei.value)
+    assert p in msg and "not_there" in msg and "variable" in msg
+
+
+def test_stream_hdf5_source_missing_dataset_names_both(tmp_path, arr):
+    p = str(tmp_path / "x.h5")
+    ht.save_hdf5(arr, p, "data")
+    with pytest.raises(ValueError) as ei:
+        ht.io.HDF5Source(p, "not_there")
+    msg = str(ei.value)
+    assert p in msg and "not_there" in msg and "dataset" in msg
+
+
+@pytest.mark.skipif(not ht.io.supports_netcdf(), reason="no NetCDF backend")
+def test_stream_netcdf_source_missing_variable_names_both(tmp_path, arr):
+    p = str(tmp_path / "x.nc")
+    ht.save_netcdf(arr, p, "data")
+    with pytest.raises(ValueError) as ei:
+        ht.io.NetCDFSource(p, "not_there")
+    msg = str(ei.value)
+    assert p in msg and "not_there" in msg and "variable" in msg
+
+
 def test_save_into_missing_directory_raises(tmp_path, arr):
     bad = str(tmp_path / "no" / "such" / "dir" / "x.h5")
     with pytest.raises(Exception):

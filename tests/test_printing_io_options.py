@@ -147,7 +147,11 @@ def test_hdf5_load_exceptions(tmp_path):
         ht.load_hdf5(1, "data")
     with pytest.raises(TypeError):
         ht.load_hdf5(path, 1)
-    with pytest.raises(KeyError):
+    # missing dataset: the error names file, member, AND what IS there
+    # (was a bare KeyError before the probe gained _named_member)
+    with pytest.raises(ValueError, match="absent"):
+        ht.load_hdf5(path, "absent")
+    with pytest.raises(ValueError, match="data"):
         ht.load_hdf5(path, "absent")
 
 

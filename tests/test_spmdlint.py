@@ -1224,6 +1224,126 @@ def read(path):
 
 
 # --------------------------------------------------------------------- #
+# SPMD212: blocking host read inside a compiled-program loop             #
+# --------------------------------------------------------------------- #
+def test_spmd212_triggers_on_h5py_read_in_compiled_loop():
+    src = """
+import h5py
+import numpy as np
+import jax
+
+@jax.jit
+def step(carry, chunk):
+    return carry + chunk.sum()
+
+def run(path, chunks, carry):
+    f = h5py.File(path, "r")
+    for lo, hi in chunks:
+        chunk = np.asarray(f["data"][lo:hi])
+        carry = step(carry, chunk)
+    return carry
+"""
+    findings = lint(src, "SPMD212")
+    assert findings and "blocking host read" in findings[0].message
+
+
+def test_spmd212_triggers_on_per_iteration_reopen():
+    src = """
+import h5py
+import jax
+
+@jax.jit
+def step(carry, chunk):
+    return carry + chunk.sum()
+
+def run(path, chunks, carry):
+    for lo, hi in chunks:
+        with h5py.File(path, "r") as f:
+            chunk = f["data"][lo:hi]
+        carry = step(carry, chunk)
+    return carry
+"""
+    findings = lint(src, "SPMD212")
+    assert findings and "re-opens the file" in findings[0].message
+
+
+def test_spmd212_triggers_on_netcdf_variable_read():
+    src = """
+import netCDF4 as nc
+import numpy as np
+import jax
+
+@jax.jit
+def step(carry, chunk):
+    return carry + chunk.sum()
+
+def run(path, chunks, carry):
+    f = nc.Dataset(path, "r")
+    for lo, hi in chunks:
+        chunk = np.asarray(f.variables["v"][lo:hi])
+        carry = step(carry, chunk)
+    return carry
+"""
+    findings = lint(src, "SPMD212")
+    assert findings and "blocking host read" in findings[0].message
+
+
+def test_spmd212_clean_on_hoisted_read_and_streamed_loop():
+    # blessed patterns: read once outside the loop; or consume the
+    # streaming generator (the read lives behind the prefetch worker)
+    src = """
+import h5py
+import numpy as np
+import jax
+from heat_tpu.io import stream
+
+@jax.jit
+def step(carry, chunk):
+    return carry + chunk.sum()
+
+def run_hoisted(path, carry, n):
+    with h5py.File(path, "r") as f:
+        data = np.asarray(f["data"][:])
+    for i in range(n):
+        carry = step(carry, data)
+    return carry
+
+def run_streamed(src_, mb, stop, carry):
+    for arrs, nv in stream.stream_chunks(src_, mb, 0, stop):
+        carry = step(carry, arrs[0])
+    return carry
+
+def read_only(path, chunks):
+    out = []
+    f = h5py.File(path, "r")
+    for lo, hi in chunks:
+        out.append(np.asarray(f["data"][lo:hi]))
+    return out
+"""
+    assert lint(src, "SPMD212") == []
+
+
+def test_spmd212_suppression_comment_silences():
+    src = """
+import h5py
+import numpy as np
+import jax
+
+@jax.jit
+def step(carry, chunk):
+    return carry + chunk.sum()
+
+def run(path, chunks, carry):
+    f = h5py.File(path, "r")
+    for lo, hi in chunks:
+        chunk = np.asarray(f["data"][lo:hi])  # spmdlint: disable=SPMD212
+        carry = step(carry, chunk)
+    return carry
+"""
+    assert lint(src, "SPMD212") == []
+
+
+# --------------------------------------------------------------------- #
 # SPMD301/302: Pallas tiling and grids                                   #
 # --------------------------------------------------------------------- #
 def test_spmd301_triggers_on_off_tile_blocks():
@@ -1386,7 +1506,7 @@ def test_every_rule_is_registered():
     assert [r.id for r in all_rules()] == [
         "SPMD001", "SPMD101", "SPMD102", "SPMD201", "SPMD202", "SPMD203",
         "SPMD204", "SPMD205", "SPMD206", "SPMD207", "SPMD208", "SPMD209",
-        "SPMD210", "SPMD211", "SPMD301", "SPMD302",
+        "SPMD210", "SPMD211", "SPMD212", "SPMD301", "SPMD302",
         "SPMD401", "SPMD501", "SPMD502", "SPMD503", "SPMD504", "SPMD505",
     ]
 
