@@ -110,6 +110,7 @@ _HEADLINE = {
     "serve_p99_ms": False,
     "replica_cold_start_ms": False,
     "scale_event_p99_ms": False,
+    "fleet_aggregate_pps": True,
     "stream_fit_rows_per_sec": True,
     "stream_overlap_efficiency": True,
     "qr_svd_tall_skinny_ms": False,
@@ -206,6 +207,14 @@ _GOLDEN_MAP = {
     # control ("div": two latencies move together under a slower host)
     "replica_cold_start_ms": ("roundtrip_ms", "div"),
     "scale_event_p99_ms": ("roundtrip_ms", "div"),
+    # the multi-process plane is IPC-latency bound (one loopback RPC
+    # round trip per request on top of the same micro-batch dispatch);
+    # the PRIMARY control is the in-run single-process FleetEngine twin
+    # (per-reply CRCs vs the fleet ledger, asserted before timing —
+    # fleet_proc_model.twin_ledger_equal) plus the scaling curve itself
+    # (pps(n)/(n*pps(1))); the roundtrip golden is the secondary
+    # machine-health control the _GOLDEN_MAP can express
+    "fleet_aggregate_pps": ("roundtrip_ms", "mul"),
     # the streaming fit is host-ingest-bound (per-rank file reads + H2D
     # landings between segment dispatches); the PRIMARY controls are the
     # in-run bitwise twins (prefetch-on == prefetch-off == the segmented
@@ -403,6 +412,14 @@ _NOT_MODELED = {
         "host-side by design: one autoscaler decision plus the warm "
         "replica's first replies — dominated by replica_cold_start_ms, "
         "same no-chip-work reasoning",
+    "fleet_aggregate_pps":
+        "IPC-bound by design: rows/s through N replica processes behind "
+        "the loopback wire protocol — the binding resource is the RPC "
+        "round trip + WFQ admission + micro-batch queueing, not chip "
+        "work; the scaling curve and its controls live in "
+        "fleet_proc_model (pps_by_replicas, scaling_efficiency, the "
+        "FleetEngine twin CRC gate, zero_compile_spinups) — no "
+        "single-chip roofline applies",
     "stream_fit_rows_per_sec":
         "ingest-bound by design: the binding resource is host file reads "
         "+ H2D landings, not HBM or MXU — the schedule model lives in "
@@ -628,6 +645,17 @@ _FLAG_DISPOSITIONS = {
         "replica — read the two together, and read scale_event_p50_ms in "
         "fleet_model for the body-vs-tail split before calling a slide "
         "real",
+    "fleet_aggregate_pps":
+        "new in r19 (multi-process serving tentpole): closed-loop rows/s "
+        "through the largest replica-process fleet; no prior-round "
+        "history.  PRIMARY controls are in-run: the single-process "
+        "FleetEngine twin must match the fleet reply ledger CRC-for-CRC "
+        "and every replica hello must report zero fuse/compile misses "
+        "(fleet_proc_model.twin_ledger_equal / .zero_compile_spinups) — "
+        "if either flips the number is a correctness signal, not noise.  "
+        "Otherwise the metric is host/IPC work: read it against the "
+        "roundtrip golden and the scaling_efficiency curve before "
+        "calling a slide real",
     "stream_fit_rows_per_sec":
         "new in r18 (out-of-core streaming tentpole): rows/s through the "
         "chunked mini-batch KMeans fit under the auto-resolved prefetch "
@@ -2350,6 +2378,125 @@ def fleet_rates(data):
     return (cold_ms, cold_spread), (p99, scale_spread), model
 
 
+def procfleet_rates(data):
+    """PR-19 tentpole: the multi-process serving plane
+    (heat_tpu.serve.procfleet).  The same KMeans predict pipeline is
+    AOT-exported to the registry sidecar, then driven closed-loop over a
+    fleet of 1 -> 2 -> 4 replica PROCESSES (real OS processes behind the
+    length-prefixed loopback RPC, each warm-started from the sidecar).
+    The headline ``fleet_aggregate_pps`` is rows/s through the largest
+    fleet; ``fleet_proc_model`` carries the whole scaling curve —
+    pps(n) per fleet size and ``scaling_efficiency`` =
+    pps(n) / (n * pps(1)) — plus the zero-compile verdict:
+    ``zero_compile_spinups`` asserts every replica's hello frame
+    reported fuse/compile miss counters of exactly zero after its
+    in-process warm-up predict, i.e. no replica compiled anything,
+    ever, across every spawn at every fleet size.  The PRIMARY golden
+    is the in-process single-process FleetEngine twin driven with the
+    byte-identical seeded payload stream: per-reply CRCs must match the
+    fleet's reply ledger entry-for-entry (``twin_ledger_equal``), so
+    the cross-process hop is proven value-preserving before any
+    throughput number is trusted."""
+    import tempfile
+    import zlib
+
+    import heat_tpu as ht
+    from heat_tpu.serve import (
+        FleetEngine,
+        ModelRegistry,
+        ProcFleet,
+        ServeEngine,
+        loadgen,
+    )
+
+    fit_rows = 2_000 if _SMOKE else 20_000
+    km = ht.cluster.KMeans(n_clusters=K, max_iter=3, random_state=0)
+    km.fit(ht.array(data[:fit_rows], split=0))
+    root = tempfile.mkdtemp(prefix="heat-procfleet-bench-")
+    reg = ModelRegistry(root)
+    reg.publish("bench", "km", km)
+    src = ServeEngine(reg, max_batch_rows=64, min_bucket=8)
+    bundles = src.export_warm("bench", "km", version=1)
+    src.close()
+    reg.publish_executables("bench", "km", 1, bundles)
+
+    n_req = 32 if _SMOKE else 160
+    reps = 2 if _SMOKE else 3
+    seed = loadgen.chaos_seed()
+    arrivals = loadgen.schedule(seed, n_requests=n_req,
+                                min_rows=1, max_rows=32)
+    pays = loadgen.payloads(arrivals, data.shape[1], seed=seed)
+    total_rows = sum(a.rows for a in arrivals)
+
+    def drive(fleet):
+        t0 = time.perf_counter()
+        futs = [
+            fleet.submit("bench", "km", p, version=1,
+                         request_id=f"bench-{i}")
+            for i, p in enumerate(pays)
+        ]
+        fleet.flush()
+        wall = time.perf_counter() - t0
+        for f in futs:
+            f.result()  # surface any transport/engine error
+        return total_rows / wall
+
+    pps_by_n = {}
+    spread_by_n = {}
+    zero_compile = True
+    fleet_crcs = None
+    for n in (1, 2, 4):
+        with ProcFleet(root, n_replicas=n,
+                       warm_models=[("bench", "km", 1)],
+                       max_batch_rows=64, min_bucket=8) as fleet:
+            for rep in fleet.alive():
+                zero_compile &= (
+                    int(rep.hello.get("fuse_misses", 1)) == 0
+                    and int(rep.hello.get("compile_misses", 1)) == 0
+                )
+            drive(fleet)  # warm the route/session maps + client path
+            pps, spread = _summary([drive(fleet) for _ in range(reps)])
+            pps_by_n[n] = pps
+            spread_by_n[n] = spread
+            if n == 1:
+                # the reply ledger of the FIRST drive is the golden
+                # surface: submit-order (rid, crc32(value)) pairs
+                fleet_crcs = [c for _, c in fleet.ledger()[:n_req]]
+    twin = FleetEngine(reg, warm_models=[("bench", "km", 1)],
+                       max_batch_rows=64, min_bucket=8)
+    try:
+        twin_crcs = [
+            zlib.crc32(np.asarray(
+                twin.predict("bench", "km", p, version=1).value
+            ).tobytes())
+            for p in pays
+        ]
+    finally:
+        twin.close()
+    twin_equal = fleet_crcs == twin_crcs
+    assert twin_equal, (
+        "multi-process fleet replies diverged from the single-process "
+        "FleetEngine twin on the identical seeded payload stream"
+    )
+    pps1 = pps_by_n[1]
+    model = {
+        "seed": seed,
+        "requests_per_drive": n_req,
+        "rows_per_drive": total_rows,
+        "pps_by_replicas": {str(n): round(v, 1)
+                            for n, v in pps_by_n.items()},
+        "scaling_efficiency": {
+            str(n): round(v / (n * pps1), 3) if pps1 else None
+            for n, v in pps_by_n.items()
+        },
+        "zero_compile_spinups": bool(zero_compile),
+        "twin_ledger_equal": bool(twin_equal),
+        "exported_bundles": len(bundles),
+    }
+    top = max(pps_by_n)
+    return (pps_by_n[top], spread_by_n[top]), model
+
+
 def stream_rates(data):
     """Out-of-core streaming fits (the PR-18 tentpole,
     heat_tpu/io/stream.py): mini-batch KMeans over a chunked
@@ -2525,6 +2672,7 @@ _METRIC_GROUP = {
     "serve_p99_ms": "serve",
     "replica_cold_start_ms": "serve",
     "scale_event_p99_ms": "serve",
+    "fleet_aggregate_pps": "serve",
     "stream_fit_rows_per_sec": "stream",
     "stream_overlap_efficiency": "stream",
     "qr_svd_tall_skinny_ms": "qr",
@@ -2650,6 +2798,10 @@ def main():
         (fleet_p99_ms, fleet_scale_spread),
         fleet_model,
     ) = fleet_rates(data)
+    (
+        (pf_pps, pf_pps_spread),
+        pf_model,
+    ) = procfleet_rates(data)
     golden.measure("stream")
     (
         (stream_rps, stream_rps_spread),
@@ -2795,6 +2947,16 @@ def main():
                 "replica_cold_start_ms": round(fleet_cold_ms, 3),
                 "scale_event_p99_ms": round(fleet_p99_ms, 3),
                 "fleet_model": fleet_model,
+                # PR-19 tentpole: the multi-process serving plane — the
+                # same predict pipeline behind real replica PROCESSES on
+                # the loopback wire protocol, driven closed-loop at
+                # 1/2/4 replicas.  Ships only after the in-run goldens
+                # hold: every replica hello reports zero fuse/compile
+                # misses and the single-process FleetEngine twin matches
+                # the fleet reply ledger CRC-for-CRC (see
+                # fleet_proc_model for the full scaling curve)
+                "fleet_aggregate_pps": round(pf_pps, 1),
+                "fleet_proc_model": pf_model,
                 # PR-18 tentpole: out-of-core streaming mini-batch fits —
                 # chunked HDF5 reads double-buffered against compiled
                 # segment dispatches under ht.io.set_prefetch.  Both
@@ -2848,6 +3010,7 @@ def main():
                     "serve_predictions_per_sec": serve_pps_spread,
                     "serve_p99_ms": serve_p99_spread,
                     "replica_cold_start_ms": fleet_cold_spread,
+                    "fleet_aggregate_pps": pf_pps_spread,
                     # dispersion of the underlying scale-event windows
                     # (the headline is their p99)
                     "scale_event_p99_ms": fleet_scale_spread,

@@ -1683,6 +1683,107 @@ def check_blocking_read_in_compiled_loop(ctx: FileContext) -> Iterable[Finding]:
 
 
 # --------------------------------------------------------------------- #
+# SPMD213: blocking socket/pipe I/O inside a compiled-program loop       #
+# --------------------------------------------------------------------- #
+#: module-level calls that block the calling thread on a peer process or
+#: pipe — one of these per iteration serializes the device behind IPC
+_BLOCKING_PIPE_CALLS = frozenset({
+    "os.read",
+    "subprocess.run",
+    "subprocess.call",
+    "subprocess.check_call",
+    "subprocess.check_output",
+})
+
+#: socket methods that block until the peer answers
+_SOCKET_BLOCKING_METHODS = frozenset({"recv", "recv_into", "recvfrom", "accept"})
+
+#: constructors whose value is a socket object
+_SOCKET_OPENERS = frozenset({
+    "socket.socket", "socket.create_connection",
+})
+
+#: methods on a ``subprocess.Popen`` value that wait for the child
+_POPEN_WAIT_METHODS = frozenset({"wait", "communicate"})
+
+
+def _value_from_opener(ctx: FileContext, expr, at, openers: frozenset,
+                       depth: int = 0) -> bool:
+    """True when ``expr`` evaluates to a value produced by one of the
+    ``openers``: a direct constructor call or a name once-bound to one."""
+    if depth > 8:
+        return False
+    if isinstance(expr, ast.Call):
+        return (ctx.resolve(expr.func) or "") in openers
+    if isinstance(expr, ast.Name):
+        rec = ctx.lookup(expr.id, at)
+        if rec is not None and rec[0] == "expr":
+            return _value_from_opener(ctx, rec[1], at, openers, depth + 1)
+    return False
+
+
+def _blocking_pipe_io(ctx: FileContext, call: ast.Call) -> Optional[str]:
+    """Why ``call`` blocks on a socket/pipe/child, or None if it doesn't."""
+    dotted = ctx.resolve(call.func) or ""
+    if dotted in _BLOCKING_PIPE_CALLS:
+        return f"`{dotted}` blocks the dispatching thread on a pipe/child"
+    if isinstance(call.func, ast.Attribute):
+        attr = call.func.attr
+        if attr in _SOCKET_BLOCKING_METHODS and _value_from_opener(
+            ctx, call.func.value, call, _SOCKET_OPENERS
+        ):
+            return f"`.{attr}` on a socket blocks until the peer answers"
+        if attr in _POPEN_WAIT_METHODS and _value_from_opener(
+            ctx, call.func.value, call, frozenset({"subprocess.Popen"})
+        ):
+            return f"`.{attr}` waits for the child process to exit"
+    return None
+
+
+@rule("SPMD213", "blocking socket/pipe I/O inside a loop that dispatches compiled programs")
+def check_blocking_ipc_in_compiled_loop(ctx: FileContext) -> Iterable[Finding]:
+    """A loop body that both performs blocking IPC (``socket.recv``,
+    ``os.read``, ``subprocess.run``, ``Popen.wait``/``communicate``) and
+    dispatches a compiled program serializes the device behind the peer:
+    every iteration the accelerator idles for the full round-trip before
+    its next dispatch — the process-boundary twin of SPMD212's storage
+    stall.  The serving plane's shape is the fix: the dispatching loop
+    lives in the replica process and never touches a socket, while the
+    parent's RPC threads (``heat_tpu.serve.procfleet``) own the blocking
+    recv and feed work through queues.  IPC in traced contexts is exempt
+    (staging-time constants, not per-dispatch waits)."""
+    for loop in ast.walk(ctx.tree):
+        if not isinstance(loop, (ast.For, ast.AsyncFor, ast.While)):
+            continue
+        compiled = None
+        ipc = None
+        why = None
+        for stmt in loop.body:
+            for sub in ast.walk(stmt):
+                if not isinstance(sub, ast.Call) or ctx.in_traced_context(sub):
+                    continue
+                if compiled is None and _is_compiled_callable(ctx, sub.func, sub):
+                    compiled = sub
+                if ipc is None:
+                    why = _blocking_pipe_io(ctx, sub)
+                    if why is not None:
+                        ipc = sub
+        if compiled is not None and ipc is not None:
+            yield ctx.finding(
+                "SPMD213", ipc,
+                "blocking socket/pipe I/O in a loop body that also "
+                f"dispatches a compiled program — {why}, so the device "
+                "idles behind IPC every iteration",
+                hint="move the exchange off the dispatch path: a worker "
+                "thread owning the socket feeds a queue the loop drains "
+                "(the `heat_tpu.serve.procfleet` worker/outbox shape), or "
+                "batch the IPC outside the loop; mark with "
+                "`# spmdlint: disable=SPMD213` if the round-trip is "
+                "deliberate",
+            )
+
+
+# --------------------------------------------------------------------- #
 # SPMD301/302: Pallas tiling and grids                                   #
 # --------------------------------------------------------------------- #
 @rule("SPMD301", "Pallas BlockSpec tiles must respect the hardware tile grid")

@@ -1,0 +1,21 @@
+"""heat_tpu.net — shared loopback-only network plane.
+
+Every socket this library opens is an *operational* surface (metrics
+scrape, replica RPC, serving ingress), not a product surface: it carries
+unauthenticated internals — model names, tenant ids, latency
+distributions, raw prediction bytes.  The blanket rule, factored here
+out of ``telemetry/httpz.py`` so the serving plane cannot drift from the
+telemetry plane, is **loopback only**: binds to non-loopback hosts are
+refused at construction time, and fleet deployments front these
+listeners with a node-local authenticated agent.
+
+- ``_base``  — the bind-host policy (``check_loopback``) and the atomic
+  daemon-thread HTTP server lifecycle (``LoopbackHTTPServer``).
+- ``wire``   — length-prefixed framing for the replica RPC (JSON header
+  + raw ndarray blobs, no pickle), blocking and asyncio flavors.
+"""
+
+from ._base import LOOPBACK_HOSTS, LoopbackHTTPServer, check_loopback
+from . import wire
+
+__all__ = ["LOOPBACK_HOSTS", "LoopbackHTTPServer", "check_loopback", "wire"]

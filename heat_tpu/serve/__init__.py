@@ -18,7 +18,13 @@ Four parts, one pipeline:
 - :mod:`fleet` — fleet-scale elasticity on top of the engine: watermark
   autoscaling over the queue/SLO signals, zero-cold-start replicas
   replaying serialized AOT executables from the registry sidecar, and
-  seeded canary rollout with a same-run stable golden twin.
+  seeded canary rollout with a same-run stable golden twin;
+- :mod:`procfleet` / :mod:`ingress` / :mod:`wfq` — the multi-process
+  serving plane: replica OS processes (warm-started from the sidecar,
+  zero-compile asserted in each hello frame) behind a loopback
+  length-prefixed RPC, per-tenant weighted-fair admission, sticky
+  sessions, kill -9 re-queue with a deterministic fleet reply ledger,
+  and an aggregated per-replica Prometheus endpoint.
 
 The contract underneath it all: a batched reply is BITWISE equal to the
 same request's unbatched predict, because every predict program in the
@@ -30,6 +36,8 @@ from .batcher import MicroBatcher, Request, StagingPool, bucket_rows, pad_batch
 from .engine import Reply, ServeEngine
 from .errors import ServeClosedError, ServeOverloadError
 from .fleet import CanaryConfig, FleetEngine, WatermarkAutoscaler
+from .ingress import FleetMetricsServer, Ingress, IngressClient
+from .procfleet import ProcFleet, ReplicaProc
 from .registry import (
     ManifestError,
     ModelNotFoundError,
@@ -37,24 +45,32 @@ from .registry import (
     RegistryError,
     VersionNotFoundError,
 )
+from .wfq import TenantPolicy, WeightedFairQueue
 from . import loadgen
 
 __all__ = [
     "CanaryConfig",
     "FleetEngine",
+    "FleetMetricsServer",
+    "Ingress",
+    "IngressClient",
     "ManifestError",
     "MicroBatcher",
     "ModelNotFoundError",
     "ModelRegistry",
+    "ProcFleet",
     "RegistryError",
     "Reply",
+    "ReplicaProc",
     "Request",
     "ServeClosedError",
     "ServeEngine",
     "ServeOverloadError",
     "StagingPool",
+    "TenantPolicy",
     "VersionNotFoundError",
     "WatermarkAutoscaler",
+    "WeightedFairQueue",
     "bucket_rows",
     "loadgen",
     "pad_batch",

@@ -1,0 +1,343 @@
+"""Loopback asyncio ingress + aggregated fleet metrics (design.md §25).
+
+The fleet door for out-of-process clients: an asyncio TCP server
+speaking the same :mod:`heat_tpu.net.wire` framing as the replica RPC,
+fronting any backend with the fleet ``submit()`` contract (a
+:class:`~heat_tpu.serve.procfleet.ProcFleet`, or its single-process
+``FleetEngine`` golden twin wrapped the same way).  Per the
+:mod:`heat_tpu.net` policy the listener binds loopback ONLY — a
+non-loopback host is refused at construction.
+
+Request flow: one ``predict`` frame in (tenant/model/version/rid/session
++ the ``x`` payload blob), one ``reply`` frame out (``y`` blob + the
+replica index, engine seq, measured latency, trace id, and the replica's
+flight-recorder sequence).  Admission failures surface exactly like
+HTTP: a :class:`~heat_tpu.serve.errors.ServeOverloadError` — whether
+shed at the WFQ door or inside a replica's micro-batcher — becomes an
+``error`` frame with ``code=429`` and ``retry_after_s`` (the
+Retry-After), which :class:`IngressClient` re-raises as the same typed
+exception, so a client cannot tell (and need not care) where in the
+pipeline the shed happened.
+
+Connections pipeline: the server answers each request as its own task,
+serializing frame *writes* per connection, so one slow batch does not
+head-of-line-block an entire connection.
+
+:class:`FleetMetricsServer` is the observability half: one Prometheus
+endpoint aggregating every replica's counters/gauges (scraped over the
+replica RPC) with a ``replica="<index>"`` label per sample, plus the
+fleet's own admission/chaos counters — byte-parseable exposition format,
+scrape-time consistent with the fleet reply ledger.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import socket
+import threading
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+from ..net import wire
+from ..net._base import LoopbackHTTPServer, check_loopback
+from ..telemetry.httpz import _Handler as _MetricsHandler
+from ..telemetry.httpz import _fmt, sanitize_metric_name
+from .errors import ServeClosedError, ServeOverloadError
+
+__all__ = ["FleetMetricsServer", "Ingress", "IngressClient"]
+
+
+class Ingress:
+    """The loopback asyncio fleet door (see module docs).
+
+    ``backend`` needs ``submit(tenant, model, payload, *, version,
+    request_id, session) -> concurrent.futures.Future`` resolving to the
+    ProcFleet reply dict, and optionally ``stats()``.  The event loop
+    runs on a dedicated daemon thread; construction returns with the
+    server listening (read the ephemeral port off ``.port``).
+    """
+
+    def __init__(self, backend, *, host: str = "127.0.0.1", port: int = 0):
+        check_loopback(host, what="Ingress")
+        self.backend = backend
+        self.host = host
+        self._loop = asyncio.new_event_loop()
+        self._started = threading.Event()
+        self._boot_error: Optional[BaseException] = None
+        self._server: Optional[asyncio.AbstractServer] = None
+        self._thread = threading.Thread(
+            target=self._run, args=(host, int(port)),
+            name="heat-ingress", daemon=True,
+        )
+        self._thread.start()
+        if not self._started.wait(timeout=30):
+            raise RuntimeError("ingress event loop failed to start")
+        if self._boot_error is not None:
+            raise self._boot_error
+        self.port = self._port
+
+    # ------------------------------------------------------------------ #
+    # event-loop thread
+    # ------------------------------------------------------------------ #
+    def _run(self, host: str, port: int) -> None:
+        asyncio.set_event_loop(self._loop)
+        try:
+            self._server = self._loop.run_until_complete(
+                asyncio.start_server(self._serve_conn, host, port)
+            )
+            self._port = self._server.sockets[0].getsockname()[1]
+        except BaseException as e:
+            self._boot_error = e
+            self._started.set()
+            return
+        self._started.set()
+        try:
+            self._loop.run_forever()
+        finally:
+            self._server.close()
+            self._loop.run_until_complete(self._server.wait_closed())
+            pending = asyncio.all_tasks(self._loop)
+            for t in pending:
+                t.cancel()
+            if pending:
+                self._loop.run_until_complete(
+                    asyncio.gather(*pending, return_exceptions=True)
+                )
+            self._loop.close()
+
+    async def _serve_conn(self, reader, writer) -> None:
+        wlock = asyncio.Lock()  # frame writes must not interleave
+        tasks = set()
+        try:
+            while True:
+                try:
+                    got = await wire.read_frame(reader)
+                except wire.WireError:
+                    break
+                if got is None:
+                    break
+                t = asyncio.ensure_future(self._handle(got, writer, wlock))
+                tasks.add(t)
+                t.add_done_callback(tasks.discard)
+        finally:
+            for t in tasks:
+                t.cancel()
+            writer.close()
+            try:
+                await writer.wait_closed()
+            except (OSError, ConnectionError):
+                pass
+
+    async def _handle(self, got, writer, wlock) -> None:
+        msg, blobs = got
+        kind = msg.get("kind")
+        rid = msg.get("rid")
+        try:
+            if kind == "predict":
+                fut = self.backend.submit(
+                    msg["tenant"], msg["model"], blobs["x"],
+                    version=msg.get("version"),
+                    request_id=rid,
+                    session=msg.get("session"),
+                )
+                reply = await asyncio.wrap_future(fut)
+                out_msg = {
+                    "kind": "reply", "rid": rid,
+                    "replica": int(reply.get("replica", -1)),
+                    "seq": int(reply.get("seq", 0)),
+                    "degraded": bool(reply.get("degraded", False)),
+                    "latency_s": float(reply.get("latency_s", 0.0)),
+                    "trace_id": reply.get("trace_id"),
+                    "flight_seq": int(reply.get("flight_seq", 0)),
+                }
+                out_blobs = {"y": np.asarray(reply["value"])}
+            elif kind == "stats":
+                stats = await asyncio.get_running_loop().run_in_executor(
+                    None, self.backend.stats
+                )
+                out_msg = {"kind": "stats", "stats": stats}
+                out_blobs = None
+            else:
+                out_msg = {
+                    "kind": "error", "code": 400, "rid": rid,
+                    "error": f"unknown frame kind {kind!r}",
+                }
+                out_blobs = None
+        except ServeOverloadError as e:
+            out_msg = {
+                "kind": "error", "code": 429, "rid": rid,
+                "error": str(e),
+                "retry_after_s": e.retry_after_s,
+                "queue_rows": e.queue_rows,
+                "max_queue_rows": e.max_queue_rows,
+            }
+            out_blobs = None
+        except ServeClosedError as e:
+            out_msg = {"kind": "error", "code": 503, "rid": rid,
+                       "error": str(e)}
+            out_blobs = None
+        except Exception as e:
+            out_msg = {"kind": "error", "code": 500, "rid": rid,
+                       "error": f"{type(e).__name__}: {e}"}
+            out_blobs = None
+        async with wlock:
+            try:
+                await wire.write_frame(writer, out_msg, out_blobs)
+            except (OSError, ConnectionError):
+                pass  # client hung up before its reply; nothing to do
+
+    # ------------------------------------------------------------------ #
+    @property
+    def url(self) -> str:
+        return f"{self.host}:{self.port}"
+
+    def close(self) -> None:
+        if self._loop.is_closed():
+            return
+        self._loop.call_soon_threadsafe(self._loop.stop)
+        self._thread.join(timeout=10)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+
+class IngressClient:
+    """Blocking wire-protocol client for :class:`Ingress` (tests, the
+    loadgen hop, and the tutorial).  One lockstep request per call;
+    thread-safe via an internal lock.  A 429 ``error`` frame re-raises
+    as :class:`ServeOverloadError` with the server's Retry-After."""
+
+    def __init__(self, host: str, port: int, *, timeout_s: float = 120.0):
+        self._sock = socket.create_connection((host, int(port)),
+                                              timeout=timeout_s)
+        self._lock = threading.Lock()
+        self._seq = 0
+
+    def _call(self, msg: dict, blobs: Optional[dict] = None) -> Tuple[dict, dict]:
+        with self._lock:
+            wire.send_frame(self._sock, msg, blobs)
+            got = wire.recv_frame(self._sock)
+        if got is None:
+            raise wire.WireError("ingress hung up")
+        reply, rblobs = got
+        if reply.get("kind") == "error":
+            if reply.get("code") == 429:
+                raise ServeOverloadError(
+                    str(reply.get("error", "overloaded")),
+                    retry_after_s=float(reply.get("retry_after_s", 0.0)),
+                    queue_rows=int(reply.get("queue_rows", 0)),
+                    max_queue_rows=int(reply.get("max_queue_rows", 0)),
+                )
+            raise RuntimeError(
+                f"ingress error {reply.get('code')}: {reply.get('error')}"
+            )
+        return reply, rblobs
+
+    def predict(self, tenant: str, model: str, payload, *,
+                version: Optional[int] = None,
+                request_id: Optional[str] = None,
+                session: Optional[str] = None) -> dict:
+        """One request over the wire; returns the reply dict (``value``
+        plus the routing/tracing fields — see module docs)."""
+        self._seq += 1
+        msg = {
+            "kind": "predict", "tenant": tenant, "model": model,
+            "version": version, "rid": request_id, "session": session,
+        }
+        reply, rblobs = self._call(msg, {"x": np.asarray(payload)})
+        out = dict(reply)
+        out["value"] = rblobs["y"]
+        return out
+
+    def stats(self) -> dict:
+        reply, _ = self._call({"kind": "stats"})
+        return reply["stats"]
+
+    def close(self) -> None:
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+
+# --------------------------------------------------------------------- #
+# aggregated fleet /metrics
+# --------------------------------------------------------------------- #
+def fleet_prometheus_text(fleet) -> str:
+    """The aggregated exposition document: every replica's counters and
+    gauges (scraped over the replica RPC) as one metric family per name
+    with a ``replica="<index>"`` label per sample, then the fleet's own
+    counters.  Values render via the same formatter as the single-process
+    ``/metrics``, so they parse back exactly."""
+    scrapes = fleet.scrape_metrics()
+    lines = []
+    for family, suffix, ptype in (("counters", "_total", "counter"),
+                                  ("gauges", "", "gauge")):
+        names = sorted({n for s in scrapes for n in s.get(family, {})})
+        for name in names:
+            m = sanitize_metric_name(name) + suffix
+            lines.append(f"# HELP {m} heat_tpu fleet {ptype} {name}")
+            lines.append(f"# TYPE {m} {ptype}")
+            for s in scrapes:
+                if name in s.get(family, {}):
+                    lines.append(
+                        f'{m}{{replica="{s["replica"]}"}} '
+                        f'{_fmt(s[family][name])}'
+                    )
+    stats = fleet.stats()
+    lines.append("# HELP heat_fleet_replicas live replica processes")
+    lines.append("# TYPE heat_fleet_replicas gauge")
+    lines.append(f"heat_fleet_replicas {int(stats['replicas'])}")
+    for key in ("accepted", "resolved", "wfq_shed", "requeued",
+                "replica_losses", "respawns"):
+        m = f"heat_fleet_{key}_total"
+        lines.append(f"# HELP {m} heat_tpu fleet counter fleet.{key}")
+        lines.append(f"# TYPE {m} counter")
+        lines.append(f"{m} {int(stats[key])}")
+    return "\n".join(lines) + "\n"
+
+
+class FleetMetricsServer(LoopbackHTTPServer):
+    """Loopback HTTP endpoint serving the aggregated fleet ``/metrics``
+    (plus ``/healthz``); same lifecycle contract as ``MetricsServer``."""
+
+    def __init__(self, fleet, *, port: int = 0, host: str = "127.0.0.1"):
+        def _text() -> str:
+            return fleet_prometheus_text(fleet)
+
+        handler = type(
+            "_FleetHandler", (_FleetMetricsHandler,),
+            {"metrics_fn": staticmethod(_text)},
+        )
+        super().__init__(handler, port=port, host=host, name="heat-fleet-metrics")
+
+
+class _FleetMetricsHandler(_MetricsHandler):
+    metrics_fn = None
+
+    def do_GET(self):  # noqa: N802 - http.server API
+        path = self.path.split("?", 1)[0]
+        if path == "/metrics":
+            try:
+                body = type(self).metrics_fn()
+            except Exception as e:  # scrape failures must not 500 opaquely
+                self._send(503, f"scrape failed: {type(e).__name__}: {e}\n",
+                           "text/plain; charset=utf-8")
+                return
+            self._send(200, body, "text/plain; version=0.0.4; charset=utf-8")
+        elif path == "/healthz":
+            self._send(200, "ok\n", "text/plain; charset=utf-8")
+        else:
+            self._send(404, "not found\n", "text/plain; charset=utf-8")

@@ -34,7 +34,16 @@ import numpy as np
 
 from ..telemetry.hist import Histogram
 
-__all__ = ["Arrival", "LoadReport", "chaos_seed", "payloads", "run", "schedule"]
+__all__ = [
+    "Arrival",
+    "LoadReport",
+    "chaos_seed",
+    "latency_hist_ms",
+    "merge_percentiles_ms",
+    "payloads",
+    "run",
+    "schedule",
+]
 
 
 def chaos_seed() -> int:
@@ -114,6 +123,36 @@ class LoadReport:
     #: order — the handles that walk each request through the event
     #: stream / Perfetto export / flight postmortem
     trace_ids: Tuple[str, ...] = ()
+    #: canonical ``Histogram.state()`` of the millisecond latency stream
+    #: — the mergeable form: fleet-level percentiles come from merging
+    #: these states across sources (see :func:`merge_percentiles_ms`),
+    #: never from concatenating raw latency lists (which a multi-process
+    #: fleet cannot ship without unbounded memory)
+    latency_hist: Optional[dict] = None
+
+
+def latency_hist_ms(latencies_s: Sequence[float]) -> Histogram:
+    """Fold a latency stream (seconds) into a millisecond log8
+    :class:`~heat_tpu.telemetry.hist.Histogram`."""
+    h = Histogram()
+    for lat in latencies_s:
+        h.record(float(lat) * 1e3)
+    return h
+
+
+def merge_percentiles_ms(states: Sequence[dict]) -> Tuple[float, float]:
+    """``(p50_ms, p99_ms)`` across multiple latency sources, by merging
+    their ``Histogram.state()`` dicts (replica RPC frames carry states,
+    never objects).  The log8 merge is byte-exact and associative, so
+    the merged percentiles equal what a single histogram observing the
+    concatenated stream would report — within the same documented
+    ``Histogram.REL_ERROR`` of the true nearest-rank sample, independent
+    of how the stream was sharded.  This replaces the PR 15 approach of
+    concatenating raw latency lists across replicas."""
+    merged = Histogram()
+    for st in states:
+        merged.merge(Histogram.from_state(st))
+    return merged.percentile(50.0), merged.percentile(99.0)
 
 
 def _percentiles_ms(latencies: Sequence[float]) -> Tuple[float, float]:
@@ -123,9 +162,7 @@ def _percentiles_ms(latencies: Sequence[float]) -> Tuple[float, float]:
     exact nearest-rank sample — the documented trade for not retaining
     per-request latency lists).  An empty stream answers ``(0.0, 0.0)``
     instead of raising the way ``np.percentile([])`` does."""
-    h = Histogram()
-    for lat in latencies:
-        h.record(float(lat) * 1e3)
+    h = latency_hist_ms(latencies)
     return h.percentile(50.0), h.percentile(99.0)
 
 
@@ -194,7 +231,8 @@ def run(
     checksum = zlib.crc32(
         b"".join(np.ascontiguousarray(r.value).tobytes() for r in replies)
     )
-    p50, p99 = _percentiles_ms([r.latency_s for r in replies])
+    lat_hist = latency_hist_ms([r.latency_s for r in replies])
+    p50, p99 = lat_hist.percentile(50.0), lat_hist.percentile(99.0)
 
     twin_report = None
     if twin:
@@ -251,4 +289,5 @@ def run(
         reply_bytes=int(after["reply_bytes"] - before["reply_bytes"]),
         twin=twin_report,
         trace_ids=tuple(r.trace_id for r in replies),
+        latency_hist=lat_hist.state(),
     )

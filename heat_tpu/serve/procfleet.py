@@ -1,0 +1,745 @@
+"""Multi-process serving fleet: warm replica processes behind one door.
+
+PR 15's :class:`~heat_tpu.serve.fleet.FleetEngine` proved elasticity,
+canary, and zero-cold-start *in one process* — every replica sharing one
+GIL, so "aggregate predictions/sec vs replica count" was not a real
+number.  :class:`ProcFleet` is the other half: each replica is an OS
+**process** (:mod:`heat_tpu.serve._replica_main`) hosting a sidecar-
+warmed :class:`ServeEngine`, joined to the parent by one loopback TCP
+connection speaking the :mod:`heat_tpu.net.wire` framing.  Processes do
+not share a GIL, so the ``fleet_aggregate_pps`` scaling curve measured
+over 1→2→4 replicas is real even on CPU smoke hardware.
+
+Architecture (design.md §25)::
+
+    submit() ──canary──▶ WeightedFairQueue ──dispatcher──▶ outbox[i]
+                (WFQ admission: per-tenant          │ sticky/RR pick
+                 bounds shed 429 here)              ▼
+                                        worker[i]: send ▸ recv ▸ resolve
+                                           │  (lockstep: ≤1 in flight)
+                                           ▼
+                                   replica process i (warm ServeEngine)
+
+- **Admission** is the :class:`~heat_tpu.serve.wfq.WeightedFairQueue`:
+  per-tenant weighted-fair service with strict priority bands, bounded
+  per-tenant backlogs shedding typed
+  :class:`~heat_tpu.serve.errors.ServeOverloadError` — one hot tenant
+  saturates its own share while a cold tenant's p99 stays bounded.
+- **Routing** is sticky by session: ``submit(..., session=...)`` pins a
+  session to a replica for its lifetime (canary assignment and ``rid=``
+  trace ids are decided *before* the hop and ride the frame, so they
+  survive re-routing; the reply carries the replica's flight-recorder
+  sequence for postmortem stitching).  Sessionless traffic round-robins.
+- **Canary** mirrors ``FleetEngine`` exactly: one draw per eligible
+  request from ``default_rng([seed, 2])`` in submit order, so a
+  ``ProcFleet`` and its single-process golden twin assign identical
+  versions to identical request streams.
+- **Un-acked re-queue** (the kill -9 contract): each worker keeps at
+  most one request in flight, so when a replica dies (EOF / reset on
+  its socket ⇒ :class:`~heat_tpu.net.wire.WireError`) the un-acked set
+  is exactly {the in-flight request} ∪ {its outbox}; those — and only
+  those — are re-queued to survivors.  Predict is stateless and
+  versions are pinned pre-hop, so a request the dead replica answered
+  into the void re-executes byte-identically on a survivor; the future
+  resolves once, hence "no accepted request lost or double-answered".
+- **Ledger**: every resolved request lands as ``(rid, crc32(reply))``;
+  :meth:`ledger` returns them in submit order.  Reply bytes are a pure
+  function of (model version, payload) — independent of which replica
+  answered or when — so the ledger is a pure function of
+  ``HEAT_CHAOS_SEED`` even across kill -9 chaos, replayable twice to
+  byte equality.
+
+Everything binds loopback only; the spawn handshake is parent-listens /
+child-connects with a one-shot token, so there is no port race and no
+foreign process can impersonate a replica.
+"""
+
+from __future__ import annotations
+
+import os
+import queue
+import secrets
+import socket
+import subprocess
+import sys
+import threading
+import time
+import zlib
+from concurrent.futures import Future
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..net import wire
+from ..net._base import check_loopback
+from ..resilience import incidents as _incidents
+from ..telemetry import _core as _tel
+from .errors import ServeClosedError
+from .fleet import CanaryConfig
+from .loadgen import chaos_seed
+from .wfq import TenantPolicy, WeightedFairQueue
+
+__all__ = ["ProcFleet", "ReplicaProc"]
+
+_SPAWN_TIMEOUT_S = 120.0  # jax import + warm install on a loaded CI box
+
+
+def _policy_snapshot() -> dict:
+    """Process-wide policy knobs that feed the compile-cache key context.
+
+    ``aot.fingerprint()`` embeds :func:`~heat_tpu.core._compile.
+    context_token`, so a replica process left on policy *defaults* would
+    soundly refuse every sidecar bundle a non-default parent exported
+    (installed=0, fresh compiles — the zero-compile hello would catch
+    it, but warm spin-ups are the whole point).  The spawn config ships
+    this snapshot and :mod:`_replica_main` re-applies it before engine
+    construction, so the child's fingerprint matches the exporter's."""
+    from ..comm.compressed import (
+        get_collective_precision,
+        get_collective_threshold,
+    )
+    from ..comm.overlap import get_overlap
+    from ..comm.redistribute import (
+        get_redistribution,
+        get_redistribution_threshold,
+    )
+    from ..io.stream import get_prefetch
+    from ..resilience.guards import get_guard_policy, get_overflow_limit
+
+    return {
+        "overlap": get_overlap(),
+        "collective_precision": get_collective_precision(),
+        "collective_threshold": int(get_collective_threshold()),
+        "redistribution": get_redistribution(),
+        "redistribution_threshold": int(get_redistribution_threshold()),
+        "guard_policy": get_guard_policy(),
+        "guard_overflow_limit": float(get_overflow_limit()),
+        "prefetch": get_prefetch(),
+    }
+
+
+@dataclass
+class _Pending:
+    """One admitted request riding the dispatcher."""
+
+    rid: str
+    tenant: str
+    model: str
+    version: Optional[int]
+    session: Optional[str]
+    payload: np.ndarray
+    future: Future
+    submit_index: int
+
+
+class ReplicaProc:
+    """One replica process + its RPC socket (see module docs).
+
+    Use :meth:`spawn`: it owns the listen-then-fork handshake, validates
+    the hello token, and returns only once the replica is warm and
+    serving.  ``call`` is the serialized request/reply primitive the
+    fleet's scrape paths use; the hot path talks to ``sock`` directly
+    from the owning worker thread (lockstep, no lock needed).
+    """
+
+    def __init__(self, index: int, proc: subprocess.Popen,
+                 sock: socket.socket, hello: dict):
+        self.index = index
+        self.proc = proc
+        self.sock = sock
+        self.hello = hello
+        self.pid = int(hello.get("pid", proc.pid))
+        self.dead = False
+        self._lock = threading.Lock()
+
+    @classmethod
+    def spawn(cls, index: int, *, registry_root: str,
+              warm_models: Sequence[Tuple] = (),
+              engine_kwargs: Optional[dict] = None,
+              host: str = "127.0.0.1",
+              spawn_timeout_s: float = _SPAWN_TIMEOUT_S) -> "ReplicaProc":
+        check_loopback(host, what="ReplicaProc")
+        token = secrets.token_hex(16)
+        listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        try:
+            listener.bind((host, 0))
+            listener.listen(1)
+            listener.settimeout(spawn_timeout_s)
+            cfg = {
+                "port": listener.getsockname()[1],
+                "token": token,
+                "replica": int(index),
+                "registry_root": str(registry_root),
+                "warm_models": [list(w) for w in warm_models],
+                "engine_kwargs": dict(engine_kwargs or {}),
+                "policy": _policy_snapshot(),
+            }
+            import json as _json
+
+            # the child must import heat_tpu no matter what the caller's
+            # cwd is (the repo may not be pip-installed): front-load the
+            # package's parent directory onto its PYTHONPATH
+            env = dict(os.environ)
+            pkg_parent = os.path.dirname(
+                os.path.dirname(os.path.abspath(__file__))
+            )
+            pkg_parent = os.path.dirname(pkg_parent)
+            prior = env.get("PYTHONPATH")
+            env["PYTHONPATH"] = (
+                pkg_parent if not prior
+                else pkg_parent + os.pathsep + prior
+            )
+            proc = subprocess.Popen(
+                [sys.executable, "-m", "heat_tpu.serve._replica_main",
+                 _json.dumps(cfg)],
+                env=env,
+            )
+            try:
+                conn, _ = listener.accept()
+            except socket.timeout:
+                proc.kill()
+                raise TimeoutError(
+                    f"replica {index} did not connect within "
+                    f"{spawn_timeout_s}s (pid {proc.pid})"
+                )
+        finally:
+            listener.close()
+        conn.settimeout(spawn_timeout_s)
+        got = wire.recv_frame(conn)
+        if got is None or got[0].get("kind") != "hello" \
+                or got[0].get("token") != token:
+            proc.kill()
+            conn.close()
+            raise ConnectionError(
+                f"replica {index} handshake failed: "
+                f"{'EOF' if got is None else got[0].get('kind')}"
+            )
+        conn.settimeout(None)
+        hello = dict(got[0])
+        hello.pop("token", None)  # one-shot; never store or log it
+        return cls(index, proc, conn, hello)
+
+    def call(self, msg: dict, blobs: Optional[dict] = None) -> Tuple[dict, dict]:
+        """Serialized request/reply (scrape paths; not the hot path)."""
+        with self._lock:
+            wire.send_frame(self.sock, msg, blobs)
+            got = wire.recv_frame(self.sock)
+        if got is None:
+            raise wire.WireError(f"replica {self.index} hung up")
+        return got
+
+    def kill(self) -> None:
+        """SIGKILL — the chaos lane's replica-loss injection."""
+        self.proc.kill()
+
+    def close(self, *, timeout_s: float = 30.0) -> None:
+        if not self.dead:
+            try:
+                self.call({"kind": "close"})
+            except (OSError, wire.WireError):
+                pass
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+        try:
+            self.proc.wait(timeout=timeout_s)
+        except subprocess.TimeoutExpired:
+            self.proc.kill()
+            self.proc.wait(timeout=timeout_s)
+
+
+class ProcFleet:
+    """The multi-process serving fleet (see module docs).
+
+    Parameters
+    ----------
+    registry_root : str — filesystem root the replicas' registries open
+        (the parent never loads estimators itself).
+    n_replicas : int — initial fleet size.
+    warm_models : sequence of (tenant, model[, version]) — models each
+        replica warms from the ``.aotx`` sidecar before taking traffic.
+    tenants : dict tenant -> :class:`TenantPolicy` | None — the WFQ
+        admission policies (weights, priority bands, per-tenant bounds).
+    default_max_queue_rows : int | None — backlog bound for tenants
+        without an explicit policy.
+    canary : CanaryConfig | None — seeded versioned rollout, identical
+        draws to ``FleetEngine`` (the golden-twin contract).
+    seed : int | None — canary stream seed (default ``HEAT_CHAOS_SEED``).
+    auto_respawn : bool — respawn a warm replacement when a replica dies
+        (the chaos lane's recovery leg); the un-acked re-queue happens
+        either way.
+    engine_kwargs — forwarded to every replica's ``ServeEngine``.
+    """
+
+    def __init__(self, registry_root: str, *,
+                 n_replicas: int = 1,
+                 warm_models: Sequence[Tuple] = (),
+                 tenants: Optional[Dict[str, TenantPolicy]] = None,
+                 default_max_queue_rows: Optional[int] = None,
+                 canary: Optional[CanaryConfig] = None,
+                 seed: Optional[int] = None,
+                 auto_respawn: bool = True,
+                 spawn_timeout_s: float = _SPAWN_TIMEOUT_S,
+                 **engine_kwargs):
+        if n_replicas < 1:
+            raise ValueError(f"n_replicas must be >= 1, got {n_replicas}")
+        self.registry_root = str(registry_root)
+        self._warm_models = [tuple(w) for w in warm_models]
+        self._engine_kwargs = dict(engine_kwargs)
+        self._spawn_timeout_s = float(spawn_timeout_s)
+        self.canary = canary
+        self.auto_respawn = bool(auto_respawn)
+        base = canary.seed if canary is not None and canary.seed is not None \
+            else (chaos_seed() if seed is None else int(seed))
+        self._canary_rng = np.random.default_rng([int(base), 2])
+        self.assignments: List[bool] = []
+        self.n_canary = 0
+        self.n_stable = 0
+
+        self.wfq = WeightedFairQueue(
+            tenants, default_max_queue_rows=default_max_queue_rows
+        )
+        self._lock = threading.Lock()
+        self._closed = False
+        self._seq = 0
+        self._next_index = 0
+        self.replicas: List[ReplicaProc] = []
+        self._outboxes: Dict[int, "queue.Queue[_Pending]"] = {}
+        self._workers: Dict[int, threading.Thread] = {}
+        self._in_flight: Dict[int, Optional[_Pending]] = {}
+        self._sessions: Dict[str, int] = {}
+        self._rr = 0
+        self._accepted = 0
+        self._resolved = 0
+        self._resolved_cv = threading.Condition(self._lock)
+        # the fleet reply ledger: submit_index -> (rid, crc32); read back
+        # in submit order by ledger()
+        self._ledger: Dict[int, Tuple[str, int]] = {}
+        self.n_requeued = 0
+        self.n_replica_losses = 0
+        self.n_respawns = 0
+        self.cold_start_ms: List[float] = []
+
+        for _ in range(int(n_replicas)):
+            self._spawn_one()
+        self._dispatcher = threading.Thread(
+            target=self._dispatch_loop, name="procfleet-dispatch", daemon=True
+        )
+        self._dispatcher.start()
+
+    # ------------------------------------------------------------------ #
+    # spawn / death / respawn
+    # ------------------------------------------------------------------ #
+    def _spawn_one(self) -> ReplicaProc:
+        t0 = time.perf_counter()
+        index = self._next_index
+        self._next_index += 1
+        rep = ReplicaProc.spawn(
+            index,
+            registry_root=self.registry_root,
+            warm_models=self._warm_models,
+            engine_kwargs=self._engine_kwargs,
+            spawn_timeout_s=self._spawn_timeout_s,
+        )
+        cold_ms = (time.perf_counter() - t0) * 1e3
+        self.cold_start_ms.append(cold_ms)
+        with self._lock:
+            self.replicas.append(rep)
+            self._outboxes[index] = queue.Queue()
+            self._in_flight[index] = None
+            w = threading.Thread(
+                target=self._worker_loop, args=(rep,),
+                name=f"procfleet-replica{index}", daemon=True,
+            )
+            self._workers[index] = w
+        if _tel.enabled:
+            _tel.gauge("serve.procfleet.replicas", len(self.replicas))
+        w.start()
+        return rep
+
+    def scale_to(self, n: int) -> None:
+        """Grow the fleet to ``n`` live replicas (warm spawns).  Shrink
+        is not implemented — the scaling bench only grows."""
+        while len(self.alive()) < int(n):
+            self._spawn_one()
+
+    def alive(self) -> List[ReplicaProc]:
+        with self._lock:
+            return [r for r in self.replicas if not r.dead]
+
+    def kill_replica(self, index: int) -> None:
+        """Chaos injection: SIGKILL replica ``index``.  Detection,
+        re-queue, and (optionally) respawn happen on the worker path."""
+        with self._lock:
+            rep = next(r for r in self.replicas if r.index == index)
+        rep.kill()
+
+    def _on_replica_death(self, rep: ReplicaProc) -> None:
+        """Worker-thread path: mark dead, re-queue exactly the un-acked
+        set to survivors, rebind its sticky sessions, maybe respawn."""
+        with self._lock:
+            if rep.dead:
+                return
+            rep.dead = True
+            self.n_replica_losses += 1
+            unacked: List[_Pending] = []
+            inflight = self._in_flight.pop(rep.index, None)
+            if inflight is not None:
+                unacked.append(inflight)
+            outbox = self._outboxes.pop(rep.index, None)
+            while outbox is not None and not outbox.empty():
+                try:
+                    unacked.append(outbox.get_nowait())
+                except queue.Empty:
+                    break
+            for sess, idx in list(self._sessions.items()):
+                if idx == rep.index:
+                    del self._sessions[sess]  # rebind on next submit
+            closed = self._closed
+        try:
+            rep.sock.close()
+        except OSError:
+            pass
+        if _tel.enabled:
+            _tel.inc("serve.procfleet.replica_losses")
+        _incidents.record(
+            kind="replica-loss", site="procfleet", policy="requeue",
+            action="requeued",
+            detail=f"replica {rep.index} (pid {rep.pid}) died; "
+            f"{len(unacked)} un-acked request(s) re-queued to survivors",
+        )
+        self.n_requeued += len(unacked)
+        if not closed and self.auto_respawn:
+            try:
+                self._spawn_one()
+                self.n_respawns += 1
+            except (OSError, TimeoutError, ConnectionError) as e:
+                _incidents.record(
+                    kind="respawn-failed", site="procfleet", policy="requeue",
+                    action="degraded", detail=str(e),
+                )
+        # re-dispatch AFTER the replacement is up, so a fleet reduced to
+        # zero survivors still answers every accepted request
+        for p in unacked:
+            self._route(p)
+
+    # ------------------------------------------------------------------ #
+    # canary + admission + dispatch
+    # ------------------------------------------------------------------ #
+    def _version_for(self, tenant: str, model: str,
+                     version: Optional[int]) -> Optional[int]:
+        """Identical math to ``FleetEngine._version_for`` — one seeded
+        draw per eligible request, submit order (the golden-twin
+        contract requires draw-for-draw agreement)."""
+        c = self.canary
+        if c is None or version is not None:
+            return version
+        if tenant != c.tenant or model != c.model:
+            return version
+        is_canary = bool(float(self._canary_rng.random()) < c.fraction)
+        self.assignments.append(is_canary)
+        if is_canary:
+            self.n_canary += 1
+            return c.canary_version
+        self.n_stable += 1
+        return c.stable_version
+
+    def submit(self, tenant: str, model: str, payload, *,
+               version: Optional[int] = None,
+               request_id: Optional[str] = None,
+               session: Optional[str] = None) -> Future:
+        """Admit one request; returns a Future resolving to a dict reply
+        (keys ``value``/``degraded``/``seq``/``latency_s``/``trace_id``/
+        ``replica``/``flight_seq``).  Sheds synchronously with
+        :class:`ServeOverloadError` when the tenant's WFQ backlog is
+        full; canary version and trace id are fixed HERE, before the
+        hop, so routing and re-routing cannot change them."""
+        if self._closed:
+            raise ServeClosedError("ProcFleet is closed")
+        payload = np.asarray(payload)
+        if payload.ndim != 2:
+            raise ValueError(
+                f"payload must be 2-D (rows, features), got {payload.ndim}-D"
+            )
+        version = self._version_for(tenant, model, version)
+        with self._lock:
+            self._seq += 1
+            rid = request_id if request_id is not None else f"pf#{self._seq}"
+            submit_index = self._seq
+        p = _Pending(
+            rid=rid, tenant=tenant, model=model, version=version,
+            session=session, payload=payload, future=Future(),
+            submit_index=submit_index,
+        )
+        # count the acceptance BEFORE the push: a racing worker may
+        # resolve the request instantly, and flush() must never observe
+        # resolved > accepted
+        with self._lock:
+            self._accepted += 1
+        try:
+            # WFQ admission: raises ServeOverloadError (the 429 surface)
+            self.wfq.push(tenant, p, rows=int(payload.shape[0]))
+        except BaseException:
+            with self._lock:
+                self._accepted -= 1
+            raise
+        if _tel.enabled:
+            _tel.inc("serve.procfleet.requests")
+        return p.future
+
+    def _pick_replica(self, p: _Pending) -> Optional[int]:
+        """Sticky-session or round-robin over live replicas (holding the
+        fleet lock)."""
+        live = [r.index for r in self.replicas if not r.dead]
+        if not live:
+            return None
+        if p.session is not None:
+            idx = self._sessions.get(p.session)
+            if idx is not None and idx in live:
+                return idx
+            idx = live[self._rr % len(live)]
+            self._rr += 1
+            self._sessions[p.session] = idx
+            return idx
+        idx = live[self._rr % len(live)]
+        self._rr += 1
+        return idx
+
+    def _route(self, p: _Pending) -> None:
+        """Place one admitted request on a live replica's outbox (or
+        fail its future when the fleet is gone)."""
+        with self._lock:
+            idx = self._pick_replica(p)
+            if idx is None:
+                if not p.future.done():
+                    p.future.set_exception(
+                        ServeClosedError("no live replicas to serve request")
+                    )
+                    self._bump_resolved()
+                return
+            self._outboxes[idx].put(p)
+
+    def _dispatch_loop(self) -> None:
+        while True:
+            got = self.wfq.pop(timeout=0.25)
+            if got is None:
+                if self._closed and len(self.wfq) == 0:
+                    return
+                continue
+            _tenant, p = got
+            self._route(p)
+
+    # ------------------------------------------------------------------ #
+    # per-replica worker: lockstep send ▸ recv ▸ resolve
+    # ------------------------------------------------------------------ #
+    def _bump_resolved(self) -> None:
+        # caller holds self._lock
+        self._resolved += 1
+        self._resolved_cv.notify_all()
+
+    def _worker_loop(self, rep: ReplicaProc) -> None:
+        outbox = self._outboxes[rep.index]
+        while not rep.dead:
+            try:
+                p = outbox.get(timeout=0.25)
+            except queue.Empty:
+                if self._closed:
+                    return
+                # idle liveness probe: a dead pipe with nothing in flight
+                # would otherwise go unnoticed until the next request
+                if rep.proc.poll() is not None:
+                    self._on_replica_death(rep)
+                    return
+                continue
+            with self._lock:
+                if rep.index not in self._in_flight:
+                    # replica was reaped between get() and here
+                    self._route(p)
+                    return
+                self._in_flight[rep.index] = p
+            try:
+                # rep._lock keeps scrape calls (stats/metrics) from
+                # interleaving their frames with this request/reply pair
+                with rep._lock:
+                    wire.send_frame(rep.sock, {
+                        "kind": "predict", "rid": p.rid,
+                        "tenant": p.tenant, "model": p.model,
+                        "version": p.version,
+                    }, {"x": p.payload})
+                    got = wire.recv_frame(rep.sock)
+                if got is None:
+                    raise wire.WireError(f"replica {rep.index} hung up")
+            except (OSError, wire.WireError):
+                self._on_replica_death(rep)
+                return
+            msg, blobs = got
+            with self._lock:
+                if self._in_flight.get(rep.index) is p:
+                    self._in_flight[rep.index] = None
+            self._resolve(p, msg, blobs)
+
+    def _resolve(self, p: _Pending, msg: dict, blobs: dict) -> None:
+        if p.future.done():  # defensive: never double-answer
+            return
+        if msg.get("kind") == "reply":
+            value = blobs["y"]
+            with self._lock:
+                self._ledger[p.submit_index] = (
+                    p.rid, zlib.crc32(value.tobytes())
+                )
+                self._bump_resolved()
+            p.future.set_result({
+                "value": value,
+                "degraded": bool(msg.get("degraded", False)),
+                "seq": int(msg.get("seq", 0)),
+                "latency_s": float(msg.get("latency_s", 0.0)),
+                "trace_id": msg.get("trace_id"),
+                "replica": int(msg.get("replica", -1)),
+                "flight_seq": int(msg.get("flight_seq", 0)),
+            })
+        else:
+            err: Exception
+            if msg.get("code") == 429:
+                from .errors import ServeOverloadError
+
+                err = ServeOverloadError(
+                    str(msg.get("error", "overloaded")),
+                    retry_after_s=float(msg.get("retry_after_s", 0.0)),
+                    queue_rows=int(msg.get("queue_rows", 0)),
+                    max_queue_rows=int(msg.get("max_queue_rows", 0)),
+                )
+            else:
+                err = RuntimeError(
+                    f"replica error {msg.get('code')}: {msg.get('error')}"
+                )
+            with self._lock:
+                self._bump_resolved()
+            p.future.set_exception(err)
+
+    # ------------------------------------------------------------------ #
+    # observability / ledger
+    # ------------------------------------------------------------------ #
+    def flush(self, *, timeout_s: float = 300.0) -> int:
+        """Block until every accepted request has resolved; returns how
+        many resolved during the wait."""
+        deadline = time.monotonic() + timeout_s
+        with self._resolved_cv:
+            start = self._resolved
+            while self._resolved < self._accepted:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise TimeoutError(
+                        f"flush timed out with "
+                        f"{self._accepted - self._resolved} request(s) "
+                        "unresolved"
+                    )
+                self._resolved_cv.wait(timeout=min(remaining, 0.5))
+            return self._resolved - start
+
+    def ledger(self) -> Tuple[Tuple[str, int], ...]:
+        """The fleet reply ledger: ``(rid, crc32(reply bytes))`` for
+        every successfully answered request, in submit order — a pure
+        function of the seeded request stream (module docs)."""
+        with self._lock:
+            return tuple(self._ledger[k] for k in sorted(self._ledger))
+
+    def checksum(self) -> int:
+        """One crc32 over the ledger (order-sensitive) — the scalar the
+        chaos lane compares across replays and against the single-process
+        golden twin's per-reply checksums."""
+        acc = 0
+        for rid, crc in self.ledger():
+            acc = zlib.crc32(f"{rid}:{crc:08x};".encode("ascii"), acc)
+        return acc
+
+    def replica_stats(self) -> List[dict]:
+        """Per-replica ``stats`` frames (engine counters + telemetry
+        counters + histogram states), live replicas only."""
+        out = []
+        for rep in self.alive():
+            msg, _ = rep.call({"kind": "stats"})
+            out.append(msg)
+        return out
+
+    def scrape_metrics(self) -> List[dict]:
+        """Per-replica ``metrics`` frames for the fleet-level Prometheus
+        aggregation (:class:`heat_tpu.serve.ingress.FleetMetricsServer`)."""
+        out = []
+        for rep in self.alive():
+            msg, _ = rep.call({"kind": "metrics"})
+            out.append(msg)
+        return out
+
+    def latency_percentiles_ms(self) -> Tuple[float, float]:
+        """Fleet (p50, p99) latency by merging each replica's
+        ``serve.latency_ms`` histogram STATE — the satellite-2 contract:
+        states merge byte-exactly; raw latency lists never cross the
+        process boundary."""
+        from .loadgen import merge_percentiles_ms
+
+        states = [
+            s["hists"]["serve.latency_ms"]
+            for s in self.replica_stats()
+            if "serve.latency_ms" in s.get("hists", {})
+        ]
+        return merge_percentiles_ms(states)
+
+    def stats(self) -> Dict[str, float]:
+        """Aggregate replica engine counters (the ``FleetEngine.stats``
+        key contract) plus the fleet's own admission/chaos counters."""
+        keys = (
+            "requests", "batches", "rows", "padded_rows", "dispatches",
+            "degraded", "payload_bytes", "reply_bytes", "shed",
+        )
+        agg = {k: 0 for k in keys}
+        for s in self.replica_stats():
+            for k in keys:
+                agg[k] += s["stats"].get(k, 0)
+        agg["dispatches_per_batch"] = (
+            agg["dispatches"] / agg["batches"] if agg["batches"] else 0.0
+        )
+        agg["batch_occupancy"] = (
+            agg["rows"] / agg["padded_rows"] if agg["padded_rows"] else 0.0
+        )
+        with self._lock:
+            agg.update(
+                replicas=len([r for r in self.replicas if not r.dead]),
+                accepted=self._accepted,
+                resolved=self._resolved,
+                wfq_shed=self.wfq.n_shed,
+                requeued=self.n_requeued,
+                replica_losses=self.n_replica_losses,
+                respawns=self.n_respawns,
+                canary=self.n_canary,
+                stable=self.n_stable,
+            )
+        return agg
+
+    def close(self) -> None:
+        """Drain-and-stop: wait for accepted work, stop the dispatcher,
+        close every replica (graceful ``close`` frame, then reap)."""
+        if self._closed:
+            return
+        try:
+            self.flush(timeout_s=60.0)
+        except TimeoutError:
+            pass
+        self._closed = True
+        self.wfq.close()
+        self._dispatcher.join(timeout=10)
+        with self._lock:
+            reps = list(self.replicas)
+            workers = list(self._workers.values())
+        for w in workers:
+            w.join(timeout=10)
+        for rep in reps:
+            rep.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False

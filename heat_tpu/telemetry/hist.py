@@ -217,6 +217,28 @@ class Histogram:
         return out
 
     @classmethod
+    def from_state(cls, state: dict) -> "Histogram":
+        """Rebuild a histogram from a ``state()`` dict — the inverse of
+        :meth:`state`, used to merge histograms shipped across a process
+        boundary (replica RPC frames carry states, never objects).
+
+        ``from_state(h.state()).state() == h.state()`` holds exactly:
+        everything a state carries round-trips, so merging rebuilt
+        replica histograms is byte-for-byte the same as merging the
+        originals."""
+        scheme = state.get("scheme")
+        if scheme != f"log{_BPO}":
+            raise ValueError(f"cannot rebuild scheme {scheme!r} (want 'log{_BPO}')")
+        h = cls()
+        h.count = int(state["count"])
+        h.sum = float(state["sum"])
+        h.min = None if state["min"] is None else float(state["min"])
+        h.max = None if state["max"] is None else float(state["max"])
+        h.zero = int(state["zero"])
+        h.counts = {int(k): int(c) for k, c in state["buckets"].items()}
+        return h
+
+    @classmethod
     def of(cls, values: Iterable[float]) -> "Histogram":
         """Build a histogram from an iterable (test/report convenience)."""
         h = cls()

@@ -25,8 +25,9 @@ device, the serve queues, or the compiled programs.
 **Security note:** the listener binds ``127.0.0.1`` ONLY — it exposes
 operational internals (model names, tenant ids, latency distributions)
 with no authentication, so it must never face a network.  A non-loopback
-bind host is rejected at construction; fleet deployments should scrape
-via a node-local agent or an authenticated sidecar.
+bind host is rejected at construction (the shared ``heat_tpu.net``
+policy); fleet deployments should scrape via a node-local agent or an
+authenticated sidecar.
 """
 
 from __future__ import annotations
@@ -34,16 +35,16 @@ from __future__ import annotations
 import http.server
 import json
 import re
-import threading
 from typing import Callable, Dict, Optional
 
+from ..net._base import LOOPBACK_HOSTS, LoopbackHTTPServer
 from . import _core
 from . import flight as _flight
 
 __all__ = ["MetricsServer", "prometheus_text", "sanitize_metric_name"]
 
 _NAME_RE = re.compile(r"[^a-zA-Z0-9_:]")
-_LOOPBACK = ("127.0.0.1", "localhost", "::1")
+_LOOPBACK = LOOPBACK_HOSTS  # back-compat alias; the policy lives in heat_tpu.net
 
 
 def sanitize_metric_name(name: str) -> str:
@@ -168,14 +169,15 @@ class _Handler(http.server.BaseHTTPRequestHandler):
         pass
 
 
-class MetricsServer:
+class MetricsServer(LoopbackHTTPServer):
     """The loopback-only introspection listener (see module docs).
 
     ``port=0`` (default) picks a free ephemeral port — read it back from
     ``.port``.  ``varz`` is an optional ``() -> dict`` merged into the
     ``/varz`` document (``ServeEngine.start_metrics_server`` passes its
-    ``varz`` method).  The serving thread is a daemon; ``close()`` shuts
-    it down synchronously.  Usable as a context manager.
+    ``varz`` method).  Lifecycle (daemon serving thread, synchronous
+    idempotent ``close()``, context-manager form) comes from the shared
+    ``heat_tpu.net`` base.
     """
 
     def __init__(
@@ -185,38 +187,5 @@ class MetricsServer:
         host: str = "127.0.0.1",
         varz: Optional[Callable[[], Dict]] = None,
     ):
-        if host not in _LOOPBACK:
-            raise ValueError(
-                f"MetricsServer binds loopback only (host={host!r} refused): "
-                "the endpoint is unauthenticated introspection — scrape it "
-                "through a node-local agent instead of exposing it"
-            )
         handler = type("_BoundHandler", (_Handler,), {"varz_fn": staticmethod(varz) if varz else None})
-        self._httpd = http.server.ThreadingHTTPServer((host, int(port)), handler)
-        self._httpd.daemon_threads = True
-        self.host = host
-        self.port = int(self._httpd.server_address[1])
-        self._thread = threading.Thread(
-            target=self._httpd.serve_forever,
-            name=f"heat-metrics:{self.port}",
-            daemon=True,
-        )
-        self._thread.start()
-
-    @property
-    def url(self) -> str:
-        return f"http://{self.host}:{self.port}"
-
-    def close(self) -> None:
-        if self._httpd is not None:
-            self._httpd.shutdown()
-            self._httpd.server_close()
-            self._thread.join(timeout=5)
-            self._httpd = None
-
-    def __enter__(self):
-        return self
-
-    def __exit__(self, *exc):
-        self.close()
-        return False
+        super().__init__(handler, port=port, host=host, name="heat-metrics")

@@ -505,6 +505,133 @@ then
     echo "FAILED stream chaos scenario (reproduce with HEAT_CHAOS_SEED=${HEAT_CHAOS_SEED:-0})"
     fail=1
 fi
+# procfleet lane (docs/design.md §25): the multi-process serving plane —
+# the wire protocol / WFQ / ingress / replica-process suite, then two
+# inline scenarios: (1) the 1→2→4 replica-process scaling sweep with the
+# single-process FleetEngine twin CRC gate and the zero-compile hello
+# assertion at every fleet size, (2) a kill -9 of a live replica
+# mid-stream, replayed twice — un-acked requests re-queued to survivors,
+# a warm respawn, and a reply ledger that is a pure function of
+# HEAT_CHAOS_SEED (identical across both replays, no lost or
+# double-answered request).
+echo "=== procfleet lane (seed=${HEAT_CHAOS_SEED:-0}: wire, WFQ, ingress, replica processes) ==="
+if ! HEAT_CHAOS_SEED="${HEAT_CHAOS_SEED:-0}" python -m pytest tests/test_procfleet.py -q; then
+    echo "FAILED procfleet suite (reproduce with HEAT_CHAOS_SEED=${HEAT_CHAOS_SEED:-0})"
+    fail=1
+fi
+if ! HEAT_CHAOS_SEED="${HEAT_CHAOS_SEED:-0}" python - <<'PY'
+import tempfile
+import zlib
+
+import numpy as np
+
+import heat_tpu as ht
+from heat_tpu.serve import (FleetEngine, ModelRegistry, ProcFleet,
+                            ServeEngine, loadgen)
+
+rng = np.random.default_rng(0)
+km = ht.cluster.KMeans(n_clusters=3, max_iter=5, random_state=0)
+km.fit(ht.array(rng.normal(size=(64, 5)).astype(np.float32), split=0))
+root = tempfile.mkdtemp(prefix="heat-procfleet-lane-")
+reg = ModelRegistry(root)
+reg.publish("ci", "km", km)
+src = ServeEngine(reg, max_batch_rows=32, min_bucket=8)
+bundles = src.export_warm("ci", "km", version=1)
+src.close()
+reg.publish_executables("ci", "km", 1, bundles)
+seed = loadgen.chaos_seed()
+arrivals = loadgen.schedule(seed, n_requests=24, min_rows=1, max_rows=16)
+pays = loadgen.payloads(arrivals, 5, seed=seed)
+rows = sum(a.rows for a in arrivals)
+
+import time
+pps = {}
+crcs = None
+for n in (1, 2, 4):
+    with ProcFleet(root, n_replicas=n, warm_models=[("ci", "km", 1)],
+                   max_batch_rows=32, min_bucket=8) as fleet:
+        for rep in fleet.alive():
+            assert rep.hello["fuse_misses"] == 0, rep.hello
+            assert rep.hello["compile_misses"] == 0, rep.hello
+        t0 = time.perf_counter()
+        futs = [fleet.submit("ci", "km", p, version=1) for p in pays]
+        fleet.flush()
+        pps[n] = rows / (time.perf_counter() - t0)
+        for f in futs:
+            f.result()
+        if n == 1:
+            crcs = [c for _, c in fleet.ledger()]
+twin = FleetEngine(reg, warm_models=[("ci", "km", 1)],
+                   max_batch_rows=32, min_bucket=8)
+twin_crcs = [zlib.crc32(np.asarray(
+    twin.predict("ci", "km", p, version=1).value).tobytes()) for p in pays]
+twin.close()
+assert crcs == twin_crcs, "fleet replies diverged from single-process twin"
+eff = {n: pps[n] / (n * pps[1]) for n in pps}
+print(f"procfleet scaling sweep (seed={seed}): "
+      + ", ".join(f"{n}x={pps[n]:.0f} pps (eff {eff[n]:.2f})"
+                  for n in sorted(pps))
+      + "; twin CRC gate held, every hello zero-compile")
+PY
+then
+    echo "FAILED procfleet scaling sweep (reproduce with HEAT_CHAOS_SEED=${HEAT_CHAOS_SEED:-0})"
+    fail=1
+fi
+if ! HEAT_CHAOS_SEED="${HEAT_CHAOS_SEED:-0}" python - <<'PY'
+import tempfile
+
+import numpy as np
+
+import heat_tpu as ht
+from heat_tpu.resilience import incidents
+from heat_tpu.serve import ModelRegistry, ProcFleet, ServeEngine, loadgen
+
+rng = np.random.default_rng(0)
+km = ht.cluster.KMeans(n_clusters=3, max_iter=5, random_state=0)
+km.fit(ht.array(rng.normal(size=(64, 5)).astype(np.float32), split=0))
+root = tempfile.mkdtemp(prefix="heat-procfleet-chaos-")
+reg = ModelRegistry(root)
+reg.publish("ci", "km", km)
+src = ServeEngine(reg, max_batch_rows=32, min_bucket=8)
+reg.publish_executables("ci", "km", 1, src.export_warm("ci", "km", version=1))
+src.close()
+seed = loadgen.chaos_seed()
+arrivals = loadgen.schedule(seed, n_requests=24, min_rows=1, max_rows=8)
+pays = loadgen.payloads(arrivals, 5, seed=seed)
+
+
+def scenario():
+    incidents.clear_incident_log()
+    with ProcFleet(root, n_replicas=2, warm_models=[("ci", "km", 1)],
+                   max_batch_rows=32, min_bucket=8) as fleet:
+        victim = fleet.alive()[0].index
+        futs = []
+        for i, p in enumerate(pays):
+            futs.append(fleet.submit("ci", "km", p, version=1,
+                                     request_id=f"rid-{i}"))
+            if i == 8:
+                fleet.kill_replica(victim)  # SIGKILL, mid-stream
+        fleet.flush(timeout_s=180)
+        for f in futs:
+            f.result()
+        st = fleet.stats()
+        assert st["replica_losses"] == 1 and st["respawns"] == 1, st
+        assert st["requeued"] >= 1, st
+        led = fleet.ledger()
+        assert len(led) == len(pays) == len({rid for rid, _ in led})
+        return led, fleet.checksum()
+
+
+a, b = scenario(), scenario()
+assert a == b, "kill -9 scenario diverged across identical-seed replays"
+print(f"procfleet kill -9 chaos (seed={seed}): replica SIGKILLed "
+      f"mid-stream, un-acked re-queued to survivor, warm respawn, "
+      f"{len(a[0])} replies — ledger+checksum replayed bit-for-bit")
+PY
+then
+    echo "FAILED procfleet kill -9 chaos (reproduce with HEAT_CHAOS_SEED=${HEAT_CHAOS_SEED:-0})"
+    fail=1
+fi
 for n in "${sizes[@]}"; do
     echo "=== mesh size $n ==="
     if ! HEAT_TEST_DEVICES="$n" python -m pytest tests/ -q -x; then

@@ -175,6 +175,7 @@ def _fake_full_result():
         "serve_p99_ms": 27.32,
         "replica_cold_start_ms": 24.6,
         "scale_event_p99_ms": 36.6,
+        "fleet_aggregate_pps": 8212.4,
         "stream_fit_rows_per_sec": 2100000.5,
         "stream_overlap_efficiency": 1.62,
         "qr_svd_tall_skinny_ms": 2.87,

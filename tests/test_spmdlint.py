@@ -1344,6 +1344,126 @@ def run(path, chunks, carry):
 
 
 # --------------------------------------------------------------------- #
+# SPMD213: blocking socket/pipe I/O inside a compiled-program loop       #
+# --------------------------------------------------------------------- #
+def test_spmd213_triggers_on_socket_recv_in_compiled_loop():
+    src = """
+import socket
+import jax
+
+@jax.jit
+def step(carry, chunk):
+    return carry + chunk.sum()
+
+def run(port, chunks, carry):
+    sock = socket.create_connection(("127.0.0.1", port))
+    for chunk in chunks:
+        carry = step(carry, chunk)
+        ack = sock.recv(4)
+    return carry
+"""
+    findings = lint(src, "SPMD213")
+    assert findings and "blocking socket/pipe I/O" in findings[0].message
+    assert "until the peer answers" in findings[0].message
+
+
+def test_spmd213_triggers_on_os_read_and_subprocess_wait():
+    src = """
+import os
+import subprocess
+import jax
+
+@jax.jit
+def step(carry, chunk):
+    return carry + chunk.sum()
+
+def run_pipe(fd, chunks, carry):
+    for chunk in chunks:
+        carry = step(carry, chunk)
+        header = os.read(fd, 8)
+    return carry
+
+def run_children(cmds, chunks, carry):
+    for cmd, chunk in zip(cmds, chunks):
+        proc = subprocess.Popen(cmd)
+        carry = step(carry, chunk)
+        proc.wait()
+    return carry
+"""
+    findings = lint(src, "SPMD213")
+    assert len(findings) == 2
+    assert "os.read" in findings[0].message
+    assert "waits for the child" in findings[1].message
+
+
+def test_spmd213_clean_on_ipc_without_dispatch_and_worker_shape():
+    # blessed patterns: an RPC loop with no compiled dispatch (the
+    # procfleet worker thread), and a dispatch loop whose input comes
+    # off a queue the socket owner feeds
+    src = """
+import socket
+import jax
+
+@jax.jit
+def step(carry, chunk):
+    return carry + chunk.sum()
+
+def rpc_worker(port, outbox):
+    sock = socket.create_connection(("127.0.0.1", port))
+    while True:
+        frame = sock.recv(4096)
+        if not frame:
+            return
+        outbox.append(frame)
+
+def dispatch_loop(inbox, carry):
+    for chunk in inbox:
+        carry = step(carry, chunk)
+    return carry
+"""
+    assert lint(src, "SPMD213") == []
+
+
+def test_spmd213_traced_context_exempt():
+    src = """
+import socket
+import jax
+
+def build(port, chunks, carry):
+    sock = socket.create_connection(("127.0.0.1", port))
+
+    @jax.jit
+    def step(c, chunk):
+        return c + chunk.sum()
+
+    for chunk in chunks:
+        carry = step(carry, chunk)
+    return carry
+"""
+    # socket exists but is never read in the loop: clean
+    assert lint(src, "SPMD213") == []
+
+
+def test_spmd213_suppression_comment_silences():
+    src = """
+import socket
+import jax
+
+@jax.jit
+def step(carry, chunk):
+    return carry + chunk.sum()
+
+def run(port, chunks, carry):
+    sock = socket.create_connection(("127.0.0.1", port))
+    for chunk in chunks:
+        carry = step(carry, chunk)
+        ack = sock.recv(4)  # spmdlint: disable=SPMD213
+    return carry
+"""
+    assert lint(src, "SPMD213") == []
+
+
+# --------------------------------------------------------------------- #
 # SPMD301/302: Pallas tiling and grids                                   #
 # --------------------------------------------------------------------- #
 def test_spmd301_triggers_on_off_tile_blocks():
@@ -1506,7 +1626,7 @@ def test_every_rule_is_registered():
     assert [r.id for r in all_rules()] == [
         "SPMD001", "SPMD101", "SPMD102", "SPMD201", "SPMD202", "SPMD203",
         "SPMD204", "SPMD205", "SPMD206", "SPMD207", "SPMD208", "SPMD209",
-        "SPMD210", "SPMD211", "SPMD212", "SPMD301", "SPMD302",
+        "SPMD210", "SPMD211", "SPMD212", "SPMD213", "SPMD301", "SPMD302",
         "SPMD401", "SPMD501", "SPMD502", "SPMD503", "SPMD504", "SPMD505",
     ]
 
