@@ -1784,6 +1784,121 @@ def check_blocking_ipc_in_compiled_loop(ctx: FileContext) -> Iterable[Finding]:
 
 
 # --------------------------------------------------------------------- #
+# SPMD214: unbounded blocking wait inside a worker loop                  #
+# --------------------------------------------------------------------- #
+def _opener_call(ctx: FileContext, expr, at, openers: frozenset,
+                 depth: int = 0) -> Optional[ast.Call]:
+    """The opener call that produced ``expr``'s value (a direct
+    constructor call or a name once-bound to one), or None — the
+    call-returning sibling of :func:`_value_from_opener`, kept separate
+    so SPMD214 can inspect the opener's own arguments."""
+    if depth > 8:
+        return None
+    if isinstance(expr, ast.Call):
+        return expr if (ctx.resolve(expr.func) or "") in openers else None
+    if isinstance(expr, ast.Name):
+        rec = ctx.lookup(expr.id, at)
+        if rec is not None and rec[0] == "expr":
+            return _opener_call(ctx, rec[1], at, openers, depth + 1)
+    return None
+
+
+def _socket_has_timeout(ctx: FileContext, recv_call: ast.Call) -> bool:
+    """True when the socket behind ``recv_call`` is visibly bounded: its
+    opener passed a ``timeout`` (keyword, or ``create_connection``'s
+    second positional), or the file calls ``settimeout`` with a
+    non-None value on the same name."""
+    opener = _opener_call(ctx, recv_call.func.value, recv_call,
+                          _SOCKET_OPENERS)
+    if opener is None:
+        return True  # unknown provenance: not ours to flag
+    if any(kw.arg == "timeout" for kw in opener.keywords):
+        return True
+    if (ctx.resolve(opener.func) or "").endswith("create_connection") \
+            and len(opener.args) >= 2:
+        return True
+    if isinstance(recv_call.func.value, ast.Name):
+        name = recv_call.func.value.id
+        for sub in ast.walk(ctx.tree):
+            if (
+                isinstance(sub, ast.Call)
+                and isinstance(sub.func, ast.Attribute)
+                and sub.func.attr == "settimeout"
+                and isinstance(sub.func.value, ast.Name)
+                and sub.func.value.id == name
+                and sub.args
+                and not (isinstance(sub.args[0], ast.Constant)
+                         and sub.args[0].value is None)
+            ):
+                return True
+    return False
+
+
+def _unbounded_wait(ctx: FileContext, call: ast.Call) -> Optional[str]:
+    """Why ``call`` can block its worker thread forever, or None."""
+    if not isinstance(call.func, ast.Attribute):
+        return None
+    attr = call.func.attr
+    if attr in ("wait", "get") and not call.args and not call.keywords:
+        # zero-arg wait()/get(): a Condition/Event/Queue/Popen blocking
+        # call with no timeout at all (dict.get always has arguments,
+        # so mapping reads never match)
+        return (
+            f"`.{attr}()` has no timeout, so the thread blocks forever "
+            "when the notify/put/exit it waits for never comes"
+        )
+    if attr in _SOCKET_BLOCKING_METHODS and not _socket_has_timeout(ctx, call):
+        return (
+            f"`.{attr}` on a timeout-less socket blocks forever when the "
+            "peer stalls without closing (the half-open gray failure)"
+        )
+    return None
+
+
+@rule("SPMD214", "unbounded wait/recv inside a `while True` worker loop")
+def check_unbounded_wait_in_worker_loop(ctx: FileContext) -> Iterable[Finding]:
+    """A ``while True`` worker loop parked on a zero-timeout blocking
+    call — ``cv.wait()``, ``queue.get()``, ``popen.wait()``, or a
+    ``recv``/``accept`` on a socket with no timeout anywhere in sight —
+    can never observe anything but the event it waits for: a peer that
+    stalls without closing (the half-open socket), a producer that died
+    mid-hand-off, or a shutdown flag all leave the thread wedged forever,
+    unjoinable and invisible to deadlines.  That is exactly the gray
+    failure the serving plane's hardening exists to catch, and the fix is
+    always the same shape: wait with a timeout inside the loop and
+    re-check liveness/deadline on each wakeup (the deadline-aware waits
+    in ``serve.procfleet.flush`` / ``serve.wfq.pop``).  Loops that
+    visibly track a bound (deadline/timeout/attempt/budget identifiers,
+    same exemption as SPMD211) are exempt — the author is already
+    watching a clock."""
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.While):
+            continue
+        test = node.test
+        if not (isinstance(test, ast.Constant) and bool(test.value)):
+            continue
+        if _loop_mentions_bound(node):
+            continue
+        for sub in ast.walk(node):
+            if not isinstance(sub, ast.Call) or ctx.in_traced_context(sub):
+                continue
+            why = _unbounded_wait(ctx, sub)
+            if why is None:
+                continue
+            yield ctx.finding(
+                "SPMD214", sub,
+                f"unbounded blocking wait in a `while True` worker loop "
+                f"— {why}",
+                hint="wait with a timeout and re-check liveness/deadline "
+                "each wakeup (compute the deadline once, wait the "
+                "remainder — the `serve.wfq.pop` shape), or bound the "
+                "socket with `settimeout`; mark with "
+                "`# spmdlint: disable=SPMD214` if blocking forever is "
+                "deliberate",
+            )
+
+
+# --------------------------------------------------------------------- #
 # SPMD301/302: Pallas tiling and grids                                   #
 # --------------------------------------------------------------------- #
 @rule("SPMD301", "Pallas BlockSpec tiles must respect the hardware tile grid")

@@ -2,10 +2,10 @@
 
 One frame on the wire is::
 
-    u32 total_len | u32 header_len | header_json | blob_0 | blob_1 | ...
+    u32 total_len | u32 header_len | header_json | blob_0 | ... | u32 crc
 
-(both lengths big-endian, ``total_len`` counts everything after itself).
-The header is UTF-8 JSON with sorted keys::
+(lengths big-endian, ``total_len`` counts everything after itself —
+trailer included).  The header is UTF-8 JSON with sorted keys::
 
     {"msg": {...},                            # arbitrary JSON payload
      "blobs": [["key", "dtype", [shape], nbytes], ...]}
@@ -14,6 +14,16 @@ and each blob is the raw C-order bytes of one ndarray, concatenated in
 header order.  No pickle anywhere: frames are deterministic for a given
 message (sorted keys, raw bytes), safe to hash into reply ledgers, and a
 test can byte-parse them without importing this module.
+
+The trailer is ``crc32`` over everything between ``total_len`` and the
+trailer itself.  A mismatch raises ``WireError`` whose message starts
+with ``corrupt-frame`` — a *distinct* failure class from truncation
+(``mid-frame``/``mid-prefix``): a dead pipe means re-queue to a
+survivor, a corrupt frame means the bytes that DID arrive are lies and
+the connection's framing state cannot be trusted.  The ``corrupt_frame``
+fault kind (:mod:`heat_tpu.resilience.faults`) targets exactly this
+seam: a seeded single-bit flip on the received body, detection asserted
+by the trailer check.
 
 ``MAX_FRAME`` bounds a single frame at 256 MiB — a corrupt or hostile
 length prefix fails fast instead of allocating unbounded memory.
@@ -31,6 +41,7 @@ from __future__ import annotations
 import json
 import socket
 import struct
+import zlib
 from typing import Dict, Optional, Tuple
 
 import numpy as np
@@ -56,7 +67,8 @@ class WireError(ConnectionError):
 
 def encode_frame(msg: dict, blobs: Optional[Dict[str, np.ndarray]] = None) -> bytes:
     """Serialize one frame.  ``blobs`` maps key -> ndarray; arrays are
-    shipped as raw C-order bytes with dtype/shape carried in the header."""
+    shipped as raw C-order bytes with dtype/shape carried in the header.
+    The returned bytes end with the crc32 trailer (module docs)."""
     manifest = []
     parts = []
     for key in sorted(blobs or ()):
@@ -65,7 +77,8 @@ def encode_frame(msg: dict, blobs: Optional[Dict[str, np.ndarray]] = None) -> by
         manifest.append([key, arr.dtype.str, list(arr.shape), len(raw)])
         parts.append(raw)
     header = json.dumps({"msg": msg, "blobs": manifest}, sort_keys=True).encode("utf-8")
-    body = b"".join([_U32.pack(len(header)), header] + parts)
+    inner = b"".join([_U32.pack(len(header)), header] + parts)
+    body = inner + _U32.pack(zlib.crc32(inner))
     if len(body) + 4 > MAX_FRAME:
         raise WireError(f"frame too large: {len(body) + 4} > {MAX_FRAME}")
     return _U32.pack(len(body)) + body
@@ -73,9 +86,19 @@ def encode_frame(msg: dict, blobs: Optional[Dict[str, np.ndarray]] = None) -> by
 
 def decode_frame(body: bytes) -> Tuple[dict, Dict[str, np.ndarray]]:
     """Inverse of ``encode_frame`` given the body (everything after the
-    ``total_len`` prefix).  Returns ``(msg, blobs)``."""
-    if len(body) < 4:
+    ``total_len`` prefix, crc trailer included).  Verifies the trailer
+    first — every byte below is checked before any is parsed — then
+    returns ``(msg, blobs)``."""
+    if len(body) < 8:
         raise WireError(f"truncated frame: {len(body)} bytes")
+    (want,) = _U32.unpack_from(body, len(body) - 4)
+    body = body[:-4]
+    got = zlib.crc32(body)
+    if got != want:
+        raise WireError(
+            f"corrupt-frame: crc32 mismatch (got {got:08x}, "
+            f"trailer says {want:08x}, {len(body)} bytes)"
+        )
     (header_len,) = _U32.unpack_from(body, 0)
     if 4 + header_len > len(body):
         raise WireError(f"header overruns frame: {header_len} > {len(body) - 4}")
@@ -99,6 +122,18 @@ def _check_total(total: int) -> int:
     if total > MAX_FRAME:
         raise WireError(f"frame length {total} exceeds MAX_FRAME={MAX_FRAME}")
     return total
+
+
+def _arrived(body: bytes, site: str) -> bytes:
+    """Receive-side fault seam: an armed ``corrupt_frame`` plan lands its
+    seeded bit flip HERE, on the received body before the trailer check,
+    so the detection the chaos lane asserts is this module's own crc
+    path — not a mock.  No-op (one bool check) when nothing is armed."""
+    from ..resilience import faults
+
+    if not faults.any_active():
+        return body
+    return faults.wire_bytes(site, body)
 
 
 # ---------------------------------------------------------------- blocking
@@ -127,7 +162,7 @@ def recv_frame(sock: socket.socket) -> Optional[Tuple[dict, Dict[str, np.ndarray
         return None
     (total,) = _U32.unpack(prefix)
     body = _recv_exact(sock, _check_total(total), at_boundary=False)
-    return decode_frame(body)
+    return decode_frame(_arrived(body, "wire.recv"))
 
 
 # ----------------------------------------------------------------- asyncio
@@ -153,4 +188,4 @@ async def read_frame(reader) -> Optional[Tuple[dict, Dict[str, np.ndarray]]]:
         body = await reader.readexactly(_check_total(total))
     except asyncio.IncompleteReadError as e:
         raise WireError(f"pipe died mid-frame ({len(e.partial)}/{total} bytes)") from e
-    return decode_frame(body)
+    return decode_frame(_arrived(body, "wire.read"))

@@ -53,6 +53,24 @@ Kinds
     classifies a dispatch blowing its per-site budget as a suspected
     lost rank.  No real sleeping happens — the delay is part of the
     deterministic schedule, not wall time.
+``"slow_replica"``
+    The serving-plane straggler (the gray failure hedging exists for):
+    :func:`serve_delay` reports ``delay`` extra seconds at matching
+    sites (the procfleet worker announces ``site="replica<i>"`` and
+    *does* sleep the reported delay in its own thread, because hedging
+    and deadlines act on real end-to-end latency).  Reply bytes are
+    untouched, so the ledger stays a pure function of the seed.
+``"stalled_socket"``
+    A half-open connection: :func:`socket_stalled` reports True at a
+    matching site and the procfleet worker treats the replica's socket
+    as wedged — a recv that would never return — failing the request
+    over to the breaker/re-queue path instead of hanging forever.
+``"corrupt_frame"``
+    Flips one seeded bit (the 0x40 high bit of one byte — the wire
+    analog of the ``bitflip`` kind's bit 30) of a received wire frame
+    body via :func:`wire_bytes`, *before* the crc32 trailer check in
+    :mod:`heat_tpu.net.wire` — so what the chaos lane asserts is the
+    codec's own ``corrupt-frame`` detection, not a mock.
 
 All injection happens at host-visible boundaries (eager ops on the
 arrays entering/leaving a compiled collective), so armed plans never leak
@@ -89,6 +107,9 @@ _KINDS = (
     "device_loss",
     "device_arrival",
     "slow_rank",
+    "slow_replica",
+    "stalled_socket",
+    "corrupt_frame",
 )
 
 #: trigger sites, by kind, that consume one schedule decision per call
@@ -397,3 +418,49 @@ def extra_latency(site: str):
             total += plan.delay
             suspect = plan.rank if plan.rank is not None else suspect
     return total, suspect
+
+
+def serve_delay(site: str) -> float:
+    """Serving-plane straggler seam: the extra seconds armed
+    ``slow_replica`` plans add at ``site`` (the procfleet worker passes
+    ``"replica<i>"``), 0.0 when nothing fires.  Unlike
+    :func:`extra_latency` the caller IS expected to sleep this — hedged
+    retries and end-to-end deadlines act on real wall latency, and the
+    sleep happens in the one worker thread that owns the slow replica,
+    so nothing else stalls."""
+    total = 0.0
+    for plan in list(_PLANS):
+        if plan.kind == "slow_replica" and plan.should_fire(site):
+            total += plan.delay
+    return total
+
+
+def socket_stalled(site: str) -> bool:
+    """Half-open-socket seam: True when an armed ``stalled_socket`` plan
+    fires at ``site`` — the caller must treat the pipe as one whose next
+    recv would never return (fail over to the breaker/re-queue path
+    rather than blocking forever)."""
+    hit = False
+    for plan in list(_PLANS):
+        if plan.kind == "stalled_socket" and plan.should_fire(site):
+            hit = True
+    return hit
+
+
+def wire_bytes(site: str, body: bytes) -> bytes:
+    """Frame-corruption seam (receive side, *before* the crc32 trailer
+    check in :mod:`heat_tpu.net.wire`): each firing ``corrupt_frame``
+    plan XORs the 0x40 high bit of one seeded byte of ``body`` — the
+    byte-stream analog of the ``bitflip`` kind's bit-30 flip — so the
+    codec's own ``corrupt-frame`` detection is what the chaos lane
+    asserts.  Returns a corrupted copy; the input is never mutated."""
+    out = None
+    for plan in list(_PLANS):
+        if plan.kind != "corrupt_frame" or not plan.should_fire(site):
+            continue
+        if out is None:
+            out = bytearray(body)
+        if out:
+            idx = int(plan.rng.integers(len(out)))
+            out[idx] ^= 0x40
+    return body if out is None else bytes(out)

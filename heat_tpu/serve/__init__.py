@@ -34,9 +34,14 @@ reply leaves the engine.
 
 from .batcher import MicroBatcher, Request, StagingPool, bucket_rows, pad_batch
 from .engine import Reply, ServeEngine
-from .errors import ServeClosedError, ServeOverloadError
+from .errors import (
+    IngressBootError,
+    ServeClosedError,
+    ServeDeadlineError,
+    ServeOverloadError,
+)
 from .fleet import CanaryConfig, FleetEngine, WatermarkAutoscaler
-from .ingress import FleetMetricsServer, Ingress, IngressClient
+from .ingress import FleetMetricsServer, HedgePolicy, Ingress, IngressClient
 from .procfleet import ProcFleet, ReplicaProc
 from .registry import (
     ManifestError,
@@ -52,7 +57,9 @@ __all__ = [
     "CanaryConfig",
     "FleetEngine",
     "FleetMetricsServer",
+    "HedgePolicy",
     "Ingress",
+    "IngressBootError",
     "IngressClient",
     "ManifestError",
     "MicroBatcher",
@@ -64,6 +71,7 @@ __all__ = [
     "ReplicaProc",
     "Request",
     "ServeClosedError",
+    "ServeDeadlineError",
     "ServeEngine",
     "ServeOverloadError",
     "StagingPool",

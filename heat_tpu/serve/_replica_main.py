@@ -42,12 +42,25 @@ Frames the loop answers:
   Prometheus aggregation;
 - ``close`` → drain, ``bye``, exit 0.  EOF on the socket (parent died)
   also exits: a replica never outlives its fleet.
+
+**Graceful drain** (design.md §26): SIGTERM means "finish what you
+hold, then leave".  The handler does two things and returns: sets the
+draining flag and half-closes the socket's read side
+(``shutdown(SHUT_RD)``).  Per PEP 475 the blocking ``recv`` the loop
+sits in retries after the signal and then sees EOF, so the loop falls
+out of its recv *at a frame boundary* — any request already received is
+answered first, because the loop is strictly sequential.  The drain
+branch then closes the engine with ``drain=True``, sends a goodbye
+frame with ``drain: True``, and exits 0.  The parent distinguishes this
+(goodbye + clean EOF + exit 0 ⇒ zero re-queues) from a crash (mid-frame
+``WireError`` / nonzero exit ⇒ exactly the un-acked set re-queues).
 """
 
 from __future__ import annotations
 
 import json
 import os
+import signal
 import socket
 import sys
 
@@ -152,12 +165,47 @@ def main(argv=None) -> int:
 
     sock = socket.create_connection(("127.0.0.1", port), timeout=30)
     sock.settimeout(None)
+
+    draining = {"flag": False}
+
+    def _on_sigterm(signum, frame):  # noqa: ARG001 - signal API
+        # Flag + half-close the read side.  The blocked recv retries
+        # after the signal (PEP 475) and then reads EOF, so the serve
+        # loop exits at the next frame *boundary* — in-flight work is
+        # answered before the goodbye.  Everything here is
+        # async-signal-safe enough for CPython: two attribute writes
+        # and a shutdown(2) syscall.
+        draining["flag"] = True
+        try:
+            sock.shutdown(socket.SHUT_RD)
+        except OSError:
+            pass
+
+    signal.signal(signal.SIGTERM, _on_sigterm)
+
     try:
         wire.send_frame(sock, hello)
         n_replies = 0
         while True:
-            got = wire.recv_frame(sock)
+            try:
+                got = wire.recv_frame(sock)
+            except wire.WireError:
+                # SHUT_RD can land mid-frame when the loop was already
+                # reading; while draining that is the expected EOF, not
+                # corruption
+                if draining["flag"]:
+                    got = None
+                else:
+                    raise
             if got is None:
+                if draining["flag"]:
+                    # graceful drain: everything received was answered
+                    # (the loop is sequential), so say goodbye and
+                    # leave cleanly
+                    engine.close(drain=True)
+                    wire.send_frame(sock, {
+                        "kind": "bye", "replica": replica, "drain": True,
+                    })
                 break  # parent is gone; do not outlive the fleet
             msg, blobs = got
             kind = msg.get("kind")

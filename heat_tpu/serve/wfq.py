@@ -37,6 +37,7 @@ Thread-safe; ``pop`` blocks until an item arrives or ``close`` wakes it.
 from __future__ import annotations
 
 import threading
+import time
 from collections import deque
 from typing import Any, Dict, Optional, Tuple
 
@@ -145,7 +146,13 @@ class WeightedFairQueue:
 
     def pop(self, *, timeout: Optional[float] = None):
         """The next ``(tenant, item)`` in WFQ order; ``None`` on timeout
-        or when the queue closes empty."""
+        or when the queue closes empty.  The wait is deadline-aware: the
+        deadline is computed once up front and each wakeup waits only
+        the remainder, so spurious notify storms cannot stretch a 0.25s
+        pop into an unbounded one."""
+        deadline = (
+            None if timeout is None else time.monotonic() + float(timeout)
+        )
         with self._cond:
             while True:
                 best: Optional[Tuple[int, float, str]] = None
@@ -166,7 +173,11 @@ class WeightedFairQueue:
                     return tenant, item
                 if self._closed:
                     return None
-                if not self._cond.wait(timeout=timeout):
+                if deadline is None:
+                    self._cond.wait()
+                    continue
+                remaining = deadline - time.monotonic()
+                if remaining <= 0 or not self._cond.wait(timeout=remaining):
                     return None
 
     def close(self) -> None:

@@ -632,6 +632,18 @@ then
     echo "FAILED procfleet kill -9 chaos (reproduce with HEAT_CHAOS_SEED=${HEAT_CHAOS_SEED:-0})"
     fail=1
 fi
+# hardening lane (docs/design.md §26): fault-domain hardening of the
+# serving plane — deadlines/hedges/breakers/drains suite PLUS the slow
+# gray-failure chaos scenario (straggler + stalled socket + corrupt
+# frame + deadline shed + hedge-cancel + drain + kill -9, all seeded,
+# disposition ledger replayed twice bit-for-bit).  The chaos test
+# carries the `slow` marker and is excluded from the tier-1 gate, so
+# this lane runs the file WITHOUT a marker filter to pull it in.
+echo "=== hardening lane (seed=${HEAT_CHAOS_SEED:-0}: deadlines, hedges, breakers, drains, gray-failure chaos) ==="
+if ! HEAT_CHAOS_SEED="${HEAT_CHAOS_SEED:-0}" python -m pytest tests/test_procfleet_hardening.py -q; then
+    echo "FAILED hardening lane (reproduce with HEAT_CHAOS_SEED=${HEAT_CHAOS_SEED:-0})"
+    fail=1
+fi
 for n in "${sizes[@]}"; do
     echo "=== mesh size $n ==="
     if ! HEAT_TEST_DEVICES="$n" python -m pytest tests/ -q -x; then

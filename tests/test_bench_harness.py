@@ -176,6 +176,8 @@ def _fake_full_result():
         "replica_cold_start_ms": 24.6,
         "scale_event_p99_ms": 36.6,
         "fleet_aggregate_pps": 8212.4,
+        "hedged_tail_p99_ms": 48.7,
+        "unhedged_tail_p99_ms": 262.4,
         "stream_fit_rows_per_sec": 2100000.5,
         "stream_overlap_efficiency": 1.62,
         "qr_svd_tall_skinny_ms": 2.87,

@@ -1,0 +1,620 @@
+"""Fault-domain hardening of the serving plane (design.md §26).
+
+Layers under test, cheapest first:
+
+- **wire CRC**: the crc32 trailer turns a flipped bit into a typed
+  ``corrupt-frame`` error, distinct from truncation, and the
+  ``corrupt_frame`` fault seam lands its seeded flip on the real
+  receive path — the detection asserted is wire.py's own crc check;
+- **ingress hardening surface** (stub backend — no processes): hedged
+  requests win on the second connection and cancel the loser over the
+  wire, 429 retries honor the server's Retry-After plus the seeded
+  jitter schedule, the shared token budget fails fast when dry, the
+  deadline rides the frame header end-to-end and a 504 maps back to
+  :class:`ServeDeadlineError` with the stage breakdown, and a bind
+  failure surfaces as :class:`IngressBootError` with its cause;
+- **process fleet**: end-to-end deadlines shed at the queue and
+  dispatch stages with the millisecond breakdown, cancel resolves a
+  queued request without a replica slot, a flush timeout names the
+  rids it was still waiting on, and SIGTERM drain (goodbye + exit 0 +
+  zero re-queues) diverges from kill -9 (exactly the un-acked set
+  re-queues);
+- **breaker**: consecutive failures trip a replica's circuit open
+  (quarantine + half-open warm respawn), recovery closes it, and
+  consecutive quarantines walk the seeded flap-backoff schedule —
+  replayed exactly via the injectable sleep;
+- **chaos** (slow; the hardening CI lane): one gray-failure scenario —
+  slow replica, corrupt frame, stalled socket, deadline shed, cancel,
+  SIGTERM drain, kill -9, all seeded — replays bit-for-bit: the
+  disposition ledger and reply checksum of two runs are equal.
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+import time
+from concurrent import futures as cf
+
+import numpy as np
+import pytest
+
+import heat_tpu as ht
+from heat_tpu import telemetry
+from heat_tpu.net import wire
+from heat_tpu.resilience import faults, incidents
+from heat_tpu.resilience import retry as retry_mod
+from heat_tpu.serve import (
+    HedgePolicy,
+    Ingress,
+    IngressBootError,
+    IngressClient,
+    ModelRegistry,
+    ProcFleet,
+    ServeDeadlineError,
+    ServeEngine,
+    ServeOverloadError,
+)
+
+RNG = np.random.default_rng(42)
+Xn = RNG.normal(size=(64, 5)).astype(np.float32)
+
+
+@pytest.fixture(autouse=True)
+def _clean_harness():
+    def _scrub():
+        faults.clear()
+        incidents.clear_incident_log()
+        retry_mod.set_sleep(None)
+        telemetry.disable()
+        telemetry.reset()
+
+    _scrub()
+    yield
+    _scrub()
+
+
+@pytest.fixture(scope="module")
+def fitted():
+    X = ht.array(Xn, split=0)
+    km = ht.cluster.KMeans(n_clusters=3, max_iter=5, random_state=0)
+    km.fit(X)
+    return km
+
+
+@pytest.fixture(scope="module")
+def fleet_root(tmp_path_factory, fitted):
+    """One registry on disk shared by every fleet in this module, with
+    the v1 ``.aotx`` sidecar the replicas warm from."""
+    root = str(tmp_path_factory.mktemp("hardening-models"))
+    reg = ModelRegistry(root)
+    reg.publish("acme", "km", fitted)
+    src = ServeEngine(reg, max_batch_rows=32, min_bucket=8)
+    bundles = src.export_warm("acme", "km", version=1)
+    src.close()
+    assert bundles, "AOT capture produced no serializable programs"
+    reg.publish_executables("acme", "km", 1, bundles)
+    return root
+
+
+def payload(rows, seed=0):
+    return np.random.default_rng(seed).normal(size=(rows, 5)).astype(np.float32)
+
+
+def _await(cond, *, timeout_s=60.0, what="condition"):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if cond():
+            return
+        time.sleep(0.05)
+    raise TimeoutError(f"timed out waiting for {what}")
+
+
+# --------------------------------------------------------------------- #
+# wire CRC trailer                                                       #
+# --------------------------------------------------------------------- #
+def test_wire_crc_trailer_flags_bitflip_not_truncation():
+    frame = wire.encode_frame(
+        {"kind": "reply", "rid": "r1"}, {"y": np.arange(6, dtype=np.float32)}
+    )
+    body = bytearray(frame[4:])
+    body[len(body) // 2] ^= 0x01  # one flipped bit anywhere in the body
+    with pytest.raises(wire.WireError, match="corrupt-frame"):
+        wire.decode_frame(bytes(body))
+    # truncation is a DIFFERENT failure class: the socket layer reports
+    # a pipe death mid-frame, never a crc mismatch
+    a, b = socket.socketpair()
+    try:
+        a.sendall(frame[: len(frame) - 5])
+        a.close()
+        with pytest.raises(wire.WireError, match="mid-frame") as ei:
+            wire.recv_frame(b)
+        assert "corrupt-frame" not in str(ei.value)
+    finally:
+        b.close()
+    # and the untouched frame still decodes (trailer stripped, not leaked)
+    msg, blobs = wire.decode_frame(frame[4:])
+    assert msg["rid"] == "r1" and blobs["y"].shape == (6,)
+
+
+def test_wire_corrupt_frame_fault_seam_hits_recv_path():
+    a, b = socket.socketpair()
+    try:
+        msg = {"kind": "reply", "rid": "r2"}
+        wire.send_frame(a, msg, {"y": np.ones(4, np.float32)})
+        with faults.inject("corrupt_frame", site="wire.recv", nth=1, seed=3):
+            with pytest.raises(wire.WireError, match="corrupt-frame"):
+                wire.recv_frame(b)
+        # disarmed: the next frame is untouched
+        wire.send_frame(a, msg, {"y": np.ones(4, np.float32)})
+        got, blobs = wire.recv_frame(b)
+        assert got == msg and np.allclose(blobs["y"], 1.0)
+    finally:
+        a.close()
+        b.close()
+
+
+# --------------------------------------------------------------------- #
+# ingress hardening surface (stub backend — no replica processes)        #
+# --------------------------------------------------------------------- #
+def _reply_for(payload, request_id):
+    return {
+        "value": np.asarray(payload).sum(axis=1),
+        "degraded": False, "seq": 1, "latency_s": 0.001,
+        "trace_id": request_id, "replica": 0, "flight_seq": 1,
+    }
+
+
+class _SlowPrimaryStub:
+    """Primary rids hang until cancelled; ``~h`` hedge rids answer at
+    once — the deterministic 'replica 0 is wedged' double."""
+
+    def __init__(self):
+        self.cancelled = []
+        self._lock = threading.Lock()
+        self._futs = {}
+
+    def submit(self, tenant, model, payload, *, version=None,
+               request_id=None, session=None, deadline_ms=None):
+        fut = cf.Future()
+        if request_id is not None and request_id.endswith("~h"):
+            fut.set_result(_reply_for(payload, request_id))
+        else:
+            with self._lock:
+                self._futs[request_id] = fut  # hangs until cancel()
+        return fut
+
+    def cancel(self, rid):
+        with self._lock:
+            fut = self._futs.pop(rid, None)
+            self.cancelled.append(rid)
+        return fut is not None and fut.cancel()
+
+    def stats(self):
+        return {"replicas": 1}
+
+
+def test_ingress_hedge_wins_and_cancels_loser_over_the_wire():
+    stub = _SlowPrimaryStub()
+    with Ingress(stub) as ing:
+        with IngressClient(
+            "127.0.0.1", ing.port, timeout_s=30.0,
+            hedge=HedgePolicy(min_hedge_delay_s=0.02, budget_tokens=4.0,
+                              seed=3),
+        ) as cli:
+            r = cli.predict("acme", "km", np.ones((2, 5), np.float32),
+                            request_id="p1")
+            assert r["rid"] == "p1~h"  # the hedge leg answered
+            assert np.allclose(r["value"], 5.0)
+            st = cli.hedge_stats()
+            assert st["hedges"] == 1 and st["hedge_wins"] == 1
+            # one token spent on the hedge, 0.1 refilled on the win
+            assert st["budget_tokens"] == pytest.approx(3.1)
+    # the loser was cancelled over the winner's socket, by base rid
+    assert stub.cancelled == ["p1"]
+
+
+class _ShedNTimesStub:
+    """Sheds the first ``n`` submits with the fixed Retry-After hint,
+    then answers."""
+
+    def __init__(self, n):
+        self.n = n
+        self.calls = 0
+
+    def submit(self, tenant, model, payload, *, version=None,
+               request_id=None, session=None, deadline_ms=None):
+        self.calls += 1
+        if self.calls <= self.n:
+            raise ServeOverloadError(
+                "stub backlog full", retry_after_s=0.125,
+                queue_rows=6, max_queue_rows=8,
+            )
+        fut = cf.Future()
+        fut.set_result(_reply_for(payload, request_id))
+        return fut
+
+
+def test_ingress_429_retry_honors_retry_after_plus_seeded_jitter():
+    slept = []
+    retry_mod.set_sleep(slept.append)
+    stub = _ShedNTimesStub(1)
+    with Ingress(stub) as ing:
+        with IngressClient(
+            "127.0.0.1", ing.port,
+            # huge hedge delay: this test isolates the retry loop
+            hedge=HedgePolicy(min_hedge_delay_s=30.0, retry_attempts=2,
+                              seed=11),
+        ) as cli:
+            r = cli.predict("acme", "km", np.ones((2, 5), np.float32),
+                            request_id="rt1")
+            assert np.allclose(r["value"], 5.0)
+            st = cli.hedge_stats()
+            assert st["retries"] == 1 and st["budget_exhausted"] == 0
+    assert stub.calls == 2
+    # the one sleep is the server's hint plus step 0 of the client's
+    # seeded jitter schedule — byte-reproducible under the policy seed
+    jitter = retry_mod.backoff_schedule(retry_mod.RetryPolicy(
+        attempts=3, base_delay=1e-3, multiplier=2.0, max_delay=0.05,
+        jitter=0.5, seed=11,
+    ))
+    assert slept == [pytest.approx(0.125 + jitter[0])]
+
+
+def test_ingress_retry_budget_exhaustion_fails_fast():
+    retry_mod.set_sleep(lambda _s: None)
+    stub = _ShedNTimesStub(10**6)  # a persistent brownout
+    with Ingress(stub) as ing:
+        with IngressClient(
+            "127.0.0.1", ing.port,
+            hedge=HedgePolicy(min_hedge_delay_s=30.0, retry_attempts=5,
+                              budget_tokens=1.0, seed=1),
+        ) as cli:
+            with pytest.raises(ServeOverloadError):
+                cli.predict("acme", "km", np.ones((2, 5), np.float32),
+                            request_id="bx1")
+            st = cli.hedge_stats()
+            # one token bought one retry; the second attempt found the
+            # bucket dry and failed fast instead of amplifying
+            assert st["retries"] == 1
+            assert st["budget_exhausted"] == 1
+            assert st["budget_tokens"] == 0.0
+    assert stub.calls == 2
+
+
+class _DeadlineStub:
+    """Records the deadline riding the wire, then sheds on it."""
+
+    def __init__(self):
+        self.seen = []
+
+    def submit(self, tenant, model, payload, *, version=None,
+               request_id=None, session=None, deadline_ms=None):
+        self.seen.append(deadline_ms)
+        raise ServeDeadlineError(
+            "rid x: deadline exceeded at queue",
+            deadline_ms=deadline_ms, elapsed_ms=61.25, stage="queue",
+            queue_ms=61.25, dispatch_ms=0.0, compute_ms=0.0,
+        )
+
+
+def test_ingress_deadline_rides_wire_and_504_maps_back():
+    stub = _DeadlineStub()
+    with Ingress(stub) as ing:
+        with IngressClient("127.0.0.1", ing.port) as cli:
+            with pytest.raises(ServeDeadlineError) as ei:
+                cli.predict("acme", "km", np.ones((2, 5), np.float32),
+                            request_id="dl1", deadline_ms=50.0)
+    assert stub.seen == [50.0]  # the header field reached the backend
+    e = ei.value
+    assert e.stage == "queue"
+    assert e.deadline_ms == 50.0
+    assert e.elapsed_ms == pytest.approx(61.25)
+    assert e.queue_ms == pytest.approx(61.25)
+
+
+def test_ingress_boot_failure_is_typed_with_cause():
+    blocker = socket.socket()
+    try:
+        blocker.bind(("127.0.0.1", 0))
+        blocker.listen(1)
+        port = blocker.getsockname()[1]
+        with pytest.raises(IngressBootError) as ei:
+            Ingress(_DeadlineStub(), port=port)
+        assert isinstance(ei.value.cause, OSError)
+        assert str(port) in str(ei.value)
+    finally:
+        blocker.close()
+
+
+# --------------------------------------------------------------------- #
+# the process fleet: deadlines, cancel, flush diagnostics, drain/crash   #
+# --------------------------------------------------------------------- #
+def test_fleet_deadlines_cancel_drain_and_crash(fleet_root):
+    """One single-replica fleet carries the deterministic-routing
+    assertions (spawns are the expensive part): stage-typed deadline
+    sheds, queued-cancel, the flush timeout naming its stuck rids, and
+    the drain-vs-crash divergence — SIGTERM re-queues nothing, kill -9
+    re-queues exactly the un-acked request."""
+    fleet = ProcFleet(fleet_root, n_replicas=1,
+                      warm_models=[("acme", "km", 1)],
+                      max_batch_rows=32, min_bucket=8)
+    try:
+        r = fleet.submit("acme", "km", payload(2), version=1,
+                         request_id="ok-0").result(timeout=60)
+        assert r["trace_id"] == "ok-0"
+
+        # queue-stage shed: expired before the dispatcher ever popped it
+        with pytest.raises(ServeDeadlineError) as ei:
+            fleet.submit("acme", "km", payload(2), version=1,
+                         request_id="dl-q", deadline_ms=1e-3
+                         ).result(timeout=60)
+        e = ei.value
+        assert e.stage == "queue" and e.deadline_ms == 1e-3
+        assert e.elapsed_ms >= e.deadline_ms
+        assert e.queue_ms == pytest.approx(e.elapsed_ms)
+        assert e.compute_ms == 0.0
+
+        # dispatch-stage shed: admitted in time, but the one replica is
+        # held by an injected straggler until the budget is gone
+        with faults.inject("slow_replica", site="replica0", nth=1,
+                           delay=0.3):
+            slow = fleet.submit("acme", "km", payload(2), version=1,
+                                request_id="slow-0")
+            late = fleet.submit("acme", "km", payload(2), version=1,
+                                request_id="dl-d", deadline_ms=120.0)
+            assert slow.result(timeout=60)["trace_id"] == "slow-0"
+            with pytest.raises(ServeDeadlineError) as ei:
+                late.result(timeout=60)
+        e = ei.value
+        assert e.stage == "dispatch"
+        assert e.elapsed_ms >= 120.0
+        assert e.dispatch_ms > 0.0
+        assert e.elapsed_ms == pytest.approx(e.queue_ms + e.dispatch_ms)
+
+        # cancel: lands while the request is queued behind a straggler,
+        # so no replica slot is ever spent on it
+        with faults.inject("slow_replica", site="replica0", nth=1,
+                           delay=0.4):
+            hold = fleet.submit("acme", "km", payload(2), version=1,
+                                request_id="hold-0")
+            gone = fleet.submit("acme", "km", payload(2), version=1,
+                                request_id="cx-0")
+            assert fleet.cancel("cx-0") is True
+            assert fleet.cancel("cx-0") is False  # already resolved
+            assert hold.result(timeout=60)["trace_id"] == "hold-0"
+            with pytest.raises(cf.CancelledError):
+                gone.result(timeout=60)
+
+        # a flush that times out names WHICH rids were still unresolved
+        with faults.inject("slow_replica", site="replica0", nth=1,
+                           delay=0.8):
+            stuck = fleet.submit("acme", "km", payload(2), version=1,
+                                 request_id="stuck-rid-7")
+            time.sleep(0.05)
+            with pytest.raises(TimeoutError, match="stuck-rid-7"):
+                fleet.flush(timeout_s=0.05)
+            stuck.result(timeout=60)
+
+        # SIGTERM drain: goodbye + exit 0, nothing re-queues
+        requeued_before = fleet.n_requeued
+        rep = fleet.drain_replica(0)
+        _await(lambda: rep.drained, what="replica 0 drain")
+        _await(lambda: len(fleet.alive()) == 1, what="post-drain respawn")
+        assert rep.proc.poll() == 0
+        assert fleet.drain_exit_codes == [0]
+        assert fleet.n_drains == 1
+        assert fleet.n_requeued == requeued_before
+        r = fleet.submit("acme", "km", payload(2), version=1,
+                         request_id="post-drain-0").result(timeout=60)
+        assert r["trace_id"] == "post-drain-0"
+
+        # kill -9 mid-request: the divergent leg — exactly the un-acked
+        # request re-queues, survives, and answers after the respawn
+        with faults.inject("slow_replica", site="replica1", nth=1,
+                           delay=0.6):
+            f = fleet.submit("acme", "km", payload(2), version=1,
+                             request_id="crash-0")
+            time.sleep(0.15)  # let it dispatch into the injected sleep
+            fleet.kill_replica(1)
+            assert f.result(timeout=120)["trace_id"] == "crash-0"
+        _await(lambda: len(fleet.alive()) == 1, what="post-crash respawn")
+        assert fleet.n_requeued == requeued_before + 1
+        assert fleet.n_replica_losses == 1
+        assert fleet.drain_exit_codes == [0]  # the crash is not a drain
+
+        disp = {rid: d for rid, d, _crc in fleet.disposition_ledger()}
+        assert disp["ok-0"] == "ok"
+        assert disp["dl-q"] == "shed-deadline-queue"
+        assert disp["dl-d"] == "shed-deadline-dispatch"
+        assert disp["cx-0"] == "cancelled"
+        assert disp["crash-0"] == "requeued-ok"
+        crcs = {rid: c for rid, _d, c in fleet.disposition_ledger()}
+        assert crcs["ok-0"] != 0 and crcs["crash-0"] != 0
+        assert crcs["dl-q"] == 0 and crcs["cx-0"] == 0
+
+        st = fleet.stats()
+        assert st["deadline_shed"] == 2
+        assert st["cancelled"] == 1
+        assert st["drains"] == 1
+        assert st["requeued"] == 1
+        assert st["breaker_opens"] == 0
+    finally:
+        fleet.close()
+
+
+# --------------------------------------------------------------------- #
+# circuit breaker: open → quarantine → half-open → close, flap backoff   #
+# --------------------------------------------------------------------- #
+def test_fleet_breaker_quarantine_half_open_recovery_and_flap(fleet_root):
+    """threshold=1 makes every 500 a quarantine: three consecutive
+    failures walk the seeded flap-backoff schedule (replayed through the
+    injectable sleep — no wall time), one success closes the half-open
+    replacement and resets the streak."""
+    slept = []
+    retry_mod.set_sleep(slept.append)
+    fleet = ProcFleet(fleet_root, n_replicas=1,
+                      warm_models=[("acme", "km", 1)],
+                      breaker_failure_threshold=1, seed=5,
+                      max_batch_rows=32, min_bucket=8)
+    try:
+        r = fleet.submit("acme", "km", payload(2), version=1,
+                         request_id="g0").result(timeout=60)
+        assert r["trace_id"] == "g0"
+
+        for i in range(1, 4):  # three consecutive quarantines
+            with pytest.raises(RuntimeError, match="replica error 500"):
+                fleet.submit("acme", "missing", payload(2),
+                             request_id=f"b{i}").result(timeout=60)
+            _await(lambda i=i: fleet.n_respawns >= i
+                   and len(fleet.alive()) == 1,
+                   what=f"quarantine respawn {i}")
+
+        # the replacement is half-open; one success closes it
+        r = fleet.submit("acme", "km", payload(2), version=1,
+                         request_id="g1").result(timeout=60)
+        assert r["trace_id"] == "g1"
+
+        assert fleet.n_breaker_opens == 3
+        assert fleet.n_replica_losses == 3
+        assert fleet.n_requeued == 0  # every 500 was answered, not lost
+
+        # streak 1 respawns hot; streaks 2 and 3 slept the first two
+        # steps of the seeded schedule — exactly, because the fleet
+        # seed pins it
+        expected = retry_mod.backoff_schedule(retry_mod.RetryPolicy(
+            attempts=6, base_delay=0.05, multiplier=2.0, max_delay=2.0,
+            jitter=0.5, seed=5,
+        ))
+        assert slept == [pytest.approx(expected[0]),
+                         pytest.approx(expected[1])]
+
+        kinds = [i.kind for i in incidents.incident_log()]
+        assert kinds.count("breaker-open") == 3
+        assert kinds.count("flap-backoff") == 2
+        assert kinds.count("breaker-closed") == 1
+        assert kinds.count("replica-loss") == 3
+
+        # recovery reset the streak: the NEXT quarantine is hot again
+        with pytest.raises(RuntimeError, match="replica error 500"):
+            fleet.submit("acme", "missing", payload(2),
+                         request_id="b4").result(timeout=60)
+        _await(lambda: fleet.n_respawns >= 4 and len(fleet.alive()) == 1,
+               what="post-recovery respawn")
+        assert fleet.n_breaker_opens == 4
+        assert len(slept) == 2  # streak restarted at 1: no new backoff
+    finally:
+        fleet.close()
+
+
+# --------------------------------------------------------------------- #
+# chaos: the gray-failure scenario replays bit-for-bit                   #
+# --------------------------------------------------------------------- #
+def _gray_failure_scenario(fleet_root):
+    """One seeded pass through every hardening path: slow replica,
+    corrupt frame, stalled socket, deadline shed, queued cancel, SIGTERM
+    drain, kill -9.  Phases are flush-separated so each fault plan sees
+    exactly one in-flight request — opportunity counting, and therefore
+    the ledger, is then a pure function of the seeds."""
+    fleet = ProcFleet(fleet_root, n_replicas=2,
+                      warm_models=[("acme", "km", 1)], seed=7,
+                      max_batch_rows=32, min_bucket=8)
+    try:
+        for i in range(4):
+            fleet.submit("acme", "km", payload(2 + i, seed=i), version=1,
+                         request_id=f"c{i}")
+        fleet.flush()
+
+        with faults.inject("slow_replica", nth=1, delay=0.12, seed=7):
+            fleet.submit("acme", "km", payload(3, seed=10), version=1,
+                         request_id="slow0").result(timeout=60)
+
+        with faults.inject("corrupt_frame", site="wire.recv", nth=1,
+                           seed=7):
+            fleet.submit("acme", "km", payload(3, seed=11), version=1,
+                         request_id="corrupt0").result(timeout=120)
+        _await(lambda: len(fleet.alive()) == 2, what="corrupt respawn")
+
+        with faults.inject("stalled_socket", nth=1, seed=7):
+            fleet.submit("acme", "km", payload(3, seed=12), version=1,
+                         request_id="stall0").result(timeout=120)
+        _await(lambda: len(fleet.alive()) == 2, what="stall respawn")
+
+        with pytest.raises(ServeDeadlineError):
+            fleet.submit("acme", "km", payload(3, seed=13), version=1,
+                         request_id="late0", deadline_ms=1e-3
+                         ).result(timeout=60)
+
+        # sticky session pins both to one replica: gone0 queues behind
+        # the straggler, so the cancel always lands first
+        with faults.inject("slow_replica", nth=1, delay=0.4, seed=7):
+            hold = fleet.submit("acme", "km", payload(3, seed=14),
+                                version=1, request_id="hold0",
+                                session="s-cancel")
+            gone = fleet.submit("acme", "km", payload(3, seed=15),
+                                version=1, request_id="gone0",
+                                session="s-cancel")
+            assert fleet.cancel("gone0") is True
+            hold.result(timeout=60)
+            with pytest.raises(cf.CancelledError):
+                gone.result(timeout=60)
+
+        requeued_before_drain = fleet.n_requeued
+        idx = min(r.index for r in fleet.alive())
+        rep = fleet.drain_replica(idx)
+        _await(lambda: rep.drained, what="drain goodbye")
+        _await(lambda: len(fleet.alive()) == 2, what="drain respawn")
+        drain_delta = fleet.n_requeued - requeued_before_drain
+        assert drain_delta == 0  # a drain NEVER re-queues
+        assert fleet.drain_exit_codes[-1] == 0
+        for i in range(2):
+            fleet.submit("acme", "km", payload(2 + i, seed=20 + i),
+                         version=1, request_id=f"d{i}")
+        fleet.flush()
+
+        requeued_before_kill = fleet.n_requeued
+        idx = min(r.index for r in fleet.alive())
+        with faults.inject("slow_replica", site=f"replica{idx}", nth=1,
+                           delay=0.6, seed=7):
+            f = fleet.submit("acme", "km", payload(3, seed=30), version=1,
+                             request_id="k0")
+            time.sleep(0.15)
+            fleet.kill_replica(idx)
+            f.result(timeout=120)
+        _await(lambda: len(fleet.alive()) == 2, what="kill respawn")
+        kill_delta = fleet.n_requeued - requeued_before_kill
+        fleet.flush()
+
+        return {
+            "dispositions": fleet.disposition_ledger(),
+            "checksum": fleet.checksum(),
+            "drain_delta": drain_delta,
+            "kill_delta": kill_delta,
+            "drains": fleet.n_drains,
+            "losses": fleet.n_replica_losses,
+            "deadline_shed": fleet.n_deadline_shed,
+            "cancelled": fleet.n_cancelled,
+        }
+    finally:
+        fleet.close()
+
+
+@pytest.mark.slow
+def test_chaos_gray_failure_ledger_replays_bit_for_bit(fleet_root):
+    first = _gray_failure_scenario(fleet_root)
+    faults.clear()
+    second = _gray_failure_scenario(fleet_root)
+    assert first == second  # checksum included: bit-for-bit
+
+    disp = {rid: d for rid, d, _crc in first["dispositions"]}
+    assert disp["slow0"] == "ok"  # late, but answered — no re-queue
+    assert disp["corrupt0"] == "requeued-ok"
+    assert disp["stall0"] == "requeued-ok"
+    assert disp["late0"] == "shed-deadline-queue"
+    assert disp["gone0"] == "cancelled"
+    assert first["drain_delta"] == 0
+    assert first["kill_delta"] in (0, 1)  # routing-dependent, but seeded
+    assert first["drains"] == 1
+    assert first["deadline_shed"] == 1
+    assert first["cancelled"] == 1
+    assert first["losses"] >= 2  # corrupt + stall (+ maybe the kill)

@@ -1464,6 +1464,123 @@ def run(port, chunks, carry):
 
 
 # --------------------------------------------------------------------- #
+# SPMD214: unbounded wait/recv inside a `while True` worker loop         #
+# --------------------------------------------------------------------- #
+def test_spmd214_triggers_on_zero_timeout_waits():
+    src = """
+import socket
+import threading
+
+def cv_worker(cond, inbox, out):
+    while True:
+        with cond:
+            cond.wait()
+        out.append(inbox.pop())
+
+def queue_worker(q, out):
+    while True:
+        item = q.get()
+        if item is None:
+            return
+        out.append(item)
+
+def sock_worker(port, out):
+    sock = socket.create_connection(("127.0.0.1", port))
+    while True:
+        frame = sock.recv(4096)
+        if not frame:
+            return
+        out.append(frame)
+"""
+    findings = lint(src, "SPMD214")
+    assert len(findings) == 3
+    assert "`.wait()` has no timeout" in findings[0].message
+    assert "`.get()` has no timeout" in findings[1].message
+    assert "timeout-less socket" in findings[2].message
+
+
+def test_spmd214_clean_on_bounded_waits():
+    # blessed shapes: timeout-carrying waits with a deadline re-check
+    # (the serve.wfq.pop idiom), a socket opened with a timeout, and a
+    # settimeout-bounded socket
+    src = """
+import socket
+import time
+
+def cv_worker(cond, ready, out, timeout):
+    deadline = time.monotonic() + timeout
+    while True:
+        with cond:
+            if ready():
+                return
+            remaining = deadline - time.monotonic()
+            if remaining <= 0 or not cond.wait(timeout=remaining):
+                return
+
+def queue_worker(q, out):
+    while True:
+        item = q.get(timeout=0.25)
+        if item is None:
+            return
+        out.append(item)
+
+def sock_worker(port, out):
+    sock = socket.create_connection(("127.0.0.1", port), timeout=5.0)
+    while True:
+        frame = sock.recv(4096)
+        if not frame:
+            return
+        out.append(frame)
+
+def settimeout_worker(port, out):
+    sock = socket.create_connection(("127.0.0.1", port))
+    sock.settimeout(2.0)
+    while True:
+        frame = sock.recv(4096)
+        if not frame:
+            return
+        out.append(frame)
+"""
+    assert lint(src, "SPMD214") == []
+
+
+def test_spmd214_dict_get_and_bounded_loops_exempt():
+    # mapping reads always pass a key, so `.get` in a frame-dispatch
+    # loop never matches; loops that visibly track an attempt budget
+    # are exempt even with a bare wait (the SPMD211 marker contract)
+    src = """
+def frame_loop(recv_frame, out):
+    while True:
+        msg = recv_frame()
+        if msg is None:
+            return
+        out.append(msg.get("kind"))
+
+def counted_worker(cond, max_attempts):
+    attempts = 0
+    while True:
+        attempts += 1
+        if attempts > max_attempts:
+            return
+        with cond:
+            cond.wait()
+"""
+    assert lint(src, "SPMD214") == []
+
+
+def test_spmd214_suppression_comment_silences():
+    src = """
+def pump(q, out):
+    while True:
+        item = q.get()  # spmdlint: disable=SPMD214
+        if item is None:
+            return
+        out.append(item)
+"""
+    assert lint(src, "SPMD214") == []
+
+
+# --------------------------------------------------------------------- #
 # SPMD301/302: Pallas tiling and grids                                   #
 # --------------------------------------------------------------------- #
 def test_spmd301_triggers_on_off_tile_blocks():
@@ -1626,7 +1743,8 @@ def test_every_rule_is_registered():
     assert [r.id for r in all_rules()] == [
         "SPMD001", "SPMD101", "SPMD102", "SPMD201", "SPMD202", "SPMD203",
         "SPMD204", "SPMD205", "SPMD206", "SPMD207", "SPMD208", "SPMD209",
-        "SPMD210", "SPMD211", "SPMD212", "SPMD213", "SPMD301", "SPMD302",
+        "SPMD210", "SPMD211", "SPMD212", "SPMD213", "SPMD214", "SPMD301",
+        "SPMD302",
         "SPMD401", "SPMD501", "SPMD502", "SPMD503", "SPMD504", "SPMD505",
     ]
 
